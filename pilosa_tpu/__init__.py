@@ -1,0 +1,23 @@
+"""pilosa_tpu — a TPU-native distributed bitmap index.
+
+A from-scratch framework with the capabilities of Pilosa v1.2 (the reference
+at /root/reference): a distributed boolean matrix stored as bitmaps, sharded
+by column into 2^20-wide fragments, queried through PQL
+(Row/Union/Intersect/Difference/Xor/Not, Count, TopN, BSI Range/Sum/Min/Max,
+Rows, GroupBy), with replication, elastic resize and anti-entropy.
+
+Architecture (TPU-first, not a port):
+  * data plane  — dense shard bitvectors in HBM; XLA bitwise kernels and
+    fused popcounts (ops/); per-shard fan-out expressed as sharded
+    computation over a `jax.sharding.Mesh` with `psum`-style reductions on
+    ICI (parallel/), replacing the reference's goroutine+HTTP scatter-gather
+    (executor.go:2183-2321).
+  * storage     — host-side authoritative roaring files + op-log WAL in the
+    reference's on-disk format (storage/), with HBM treated as a query cache.
+  * control plane — membership, placement (jump hash over 256 partitions),
+    replication, resize, anti-entropy stay host-side (parallel/, server.py).
+"""
+
+__version__ = "0.1.0"
+
+from pilosa_tpu.constants import SHARD_WIDTH, WORDS_PER_SHARD  # noqa: F401

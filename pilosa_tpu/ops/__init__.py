@@ -1,0 +1,23 @@
+"""TPU compute kernels: the data plane of the bitmap index.
+
+The reference's data plane is roaring container pairwise kernels plus popcount
+(roaring/roaring.go:2162-3353, 3801-3818). Here the equivalent compute runs on
+dense, HBM-resident shard bitvectors: uint32 lanes, bitwise XLA ops, fused
+popcount reductions, `lax.top_k` ranking, and bit-plane (BSI) arithmetic.
+"""
+
+from pilosa_tpu.ops.bitvector import (  # noqa: F401
+    band,
+    bandnot,
+    bnot,
+    bor,
+    bxor,
+    columns_from_dense,
+    dense_from_columns,
+    difference_count,
+    intersect_count,
+    popcount,
+    row_popcounts,
+    union_count,
+    xor_count,
+)

@@ -1,0 +1,12 @@
+"""Host-side authoritative storage: roaring files, op-log WAL, fragments.
+
+The TPU design keeps mutation host-side (random single-bit writes are the
+wrong shape for XLA) and treats HBM as a query cache over dense row
+materializations — the analog of the reference's rowCache (fragment.go:112),
+with the roaring file + op-log as the durable source of truth
+(fragment.go:190-247). The on-disk format is the reference's Pilosa-variant
+roaring format (docs/architecture.md, roaring/roaring.go:812-1010) so
+fixtures, inspect/check tooling and import/export payloads stay compatible.
+"""
+
+from pilosa_tpu.storage.roaring import Bitmap  # noqa: F401

@@ -1,0 +1,642 @@
+"""64-bit roaring bitmap, numpy-backed, Pilosa file-format compatible.
+
+Clean-room implementation of the storage-side bitmap. The reference keeps
+three container encodings and 45 hand-specialized pairwise op kernels
+(roaring/roaring.go:1273, 2162-3353) because containers are also its *compute*
+representation. Here compute happens on TPU over dense bitvectors
+(pilosa_tpu.ops), so the host bitmap only needs: mutation, bulk build,
+dense-range materialization (the OffsetRange analog, roaring/roaring.go:320,
+used by fragment row reads, fragment.go:361), set algebra for merges, and
+serialization.
+
+In-memory model: two container kinds — a sorted uint16 numpy array
+(cardinality ≤ 4096, ARRAY_MAX_SIZE as roaring/roaring.go:1258) or a 1024-word
+uint64 little-endian bitmap. Run containers exist only on disk
+(roaring/roaring.go:56-62 containerRun): they are inflated on read and chosen
+at write time when the run encoding is smallest, which the format permits
+because container types are explicit in the descriptive header
+(docs/architecture.md: "Container types are NOT inferred").
+
+File format (docs/architecture.md, roaring/roaring.go:812-985):
+  bytes 0-1  magic 12348        (u16 LE)
+  bytes 2-3  storage version 0  (u16 LE)
+  bytes 4-7  container count    (u32 LE)
+  per container: key u64 | container type u16 | cardinality-1 u16   (12 B)
+  per container: absolute file offset u32                            (4 B)
+  container payloads: array = n×u16; bitmap = 1024×u64;
+                      run = count u16 then count×(start u16, last u16)
+  trailing: op-log — 13-byte records [type u8 | value u64 | fnv1a32 u32]
+  (roaring/roaring.go:3354-3420), replayed on open.
+"""
+
+from __future__ import annotations
+
+import io
+import struct
+from typing import Iterator, Optional
+
+import numpy as np
+
+from pilosa_tpu.constants import (
+    ARRAY_MAX_SIZE,
+    CONTAINER_BITS,
+    MAGIC_NUMBER,
+    STORAGE_VERSION,
+)
+
+BITMAP_WORDS = CONTAINER_BITS // 64  # 1024 x uint64
+HEADER_BASE_SIZE = 8
+
+TYPE_ARRAY = 1
+TYPE_BITMAP = 2
+TYPE_RUN = 3
+
+OP_ADD = 0
+OP_REMOVE = 1
+OP_SIZE = 13
+
+
+def fnv1a32(data: bytes) -> int:
+    h = 2166136261
+    for b in data:
+        h = ((h ^ b) * 16777619) & 0xFFFFFFFF
+    return h
+
+
+def _array_to_words(arr: np.ndarray) -> np.ndarray:
+    bits = np.zeros(CONTAINER_BITS, dtype=np.uint8)
+    bits[arr] = 1
+    return np.packbits(bits, bitorder="little").view("<u8").copy()
+
+
+def _words_to_array(words: np.ndarray) -> np.ndarray:
+    bits = np.unpackbits(words.view(np.uint8), bitorder="little")
+    return np.flatnonzero(bits).astype(np.uint16)
+
+
+class Container:
+    """One 2^16-bit container: sorted uint16 array or uint64[1024] bitmap."""
+
+    __slots__ = ("kind", "data")
+
+    def __init__(self, kind: str, data: np.ndarray):
+        self.kind = kind  # "array" | "bitmap"
+        self.data = data
+
+    # -- constructors -------------------------------------------------------
+
+    @classmethod
+    def empty(cls) -> "Container":
+        return cls("array", np.empty(0, dtype=np.uint16))
+
+    @classmethod
+    def from_values(cls, values: np.ndarray) -> "Container":
+        """values: sorted unique uint16."""
+        values = np.asarray(values, dtype=np.uint16)
+        if values.size > ARRAY_MAX_SIZE:
+            return cls("bitmap", _array_to_words(values))
+        return cls("array", values)
+
+    # -- basics -------------------------------------------------------------
+
+    @property
+    def n(self) -> int:
+        if self.kind == "array":
+            return int(self.data.size)
+        return int(np.sum(np.bitwise_count(self.data)))
+
+    def values(self) -> np.ndarray:
+        """Sorted uint16 members."""
+        if self.kind == "array":
+            return self.data
+        return _words_to_array(self.data)
+
+    def words(self) -> np.ndarray:
+        """uint64[1024] little-endian dense form."""
+        if self.kind == "bitmap":
+            return self.data
+        return _array_to_words(self.data)
+
+    def contains(self, v: int) -> bool:
+        if self.kind == "array":
+            i = np.searchsorted(self.data, v)
+            return i < self.data.size and self.data[i] == v
+        return bool((int(self.data[v >> 6]) >> (v & 63)) & 1)
+
+    def _normalize(self) -> "Container":
+        """Re-pick encoding after mutation (optimize(), roaring/roaring.go:1594)."""
+        if self.kind == "bitmap" and self.n <= ARRAY_MAX_SIZE:
+            return Container("array", _words_to_array(self.data))
+        if self.kind == "array" and self.data.size > ARRAY_MAX_SIZE:
+            return Container("bitmap", _array_to_words(self.data))
+        return self
+
+    # -- mutation (returns possibly re-encoded container) -------------------
+
+    def add_many(self, vals: np.ndarray) -> "Container":
+        vals = np.asarray(vals, dtype=np.uint16)
+        if self.kind == "array":
+            merged = np.union1d(self.data, vals)
+            return Container.from_values(merged)
+        words = self.data.copy()
+        idx = vals.astype(np.int64)
+        np.bitwise_or.at(words, idx >> 6, np.uint64(1) << (idx & 63).astype(np.uint64))
+        return Container("bitmap", words)._normalize()
+
+    def remove_many(self, vals: np.ndarray) -> "Container":
+        vals = np.asarray(vals, dtype=np.uint16)
+        if self.kind == "array":
+            keep = self.data[~np.isin(self.data, vals)]
+            return Container("array", keep)
+        words = self.data.copy()
+        idx = np.unique(vals).astype(np.int64)
+        np.bitwise_and.at(words, idx >> 6, ~(np.uint64(1) << (idx & 63).astype(np.uint64)))
+        return Container("bitmap", words)._normalize()
+
+    # -- set algebra --------------------------------------------------------
+
+    def op(self, other: "Container", kind: str) -> "Container":
+        if self.kind == "array" and other.kind == "array":
+            a, b = self.data, other.data
+            if kind == "and":
+                out = np.intersect1d(a, b, assume_unique=True)
+            elif kind == "or":
+                out = np.union1d(a, b)
+            elif kind == "andnot":
+                out = np.setdiff1d(a, b, assume_unique=True)
+            else:  # xor
+                out = np.setxor1d(a, b, assume_unique=True)
+            return Container.from_values(out)
+        aw, bw = self.words(), other.words()
+        if kind == "and":
+            out = aw & bw
+        elif kind == "or":
+            out = aw | bw
+        elif kind == "andnot":
+            out = aw & ~bw
+        else:
+            out = aw ^ bw
+        return Container("bitmap", out)._normalize()
+
+    def op_count(self, other: "Container", kind: str) -> int:
+        if self.kind == "array" and other.kind == "array" and kind == "and":
+            return int(np.intersect1d(self.data, other.data, assume_unique=True).size)
+        aw, bw = self.words(), other.words()
+        if kind == "and":
+            out = aw & bw
+        elif kind == "or":
+            out = aw | bw
+        elif kind == "andnot":
+            out = aw & ~bw
+        else:
+            out = aw ^ bw
+        return int(np.sum(np.bitwise_count(out)))
+
+    # -- serialization ------------------------------------------------------
+
+    def _runs(self) -> np.ndarray:
+        """[nruns, 2] (start, last) intervals of the sorted member array."""
+        vals = self.values().astype(np.int64)
+        if vals.size == 0:
+            return np.empty((0, 2), dtype=np.uint16)
+        breaks = np.flatnonzero(np.diff(vals) != 1)
+        starts = np.concatenate(([0], breaks + 1))
+        ends = np.concatenate((breaks, [vals.size - 1]))
+        return np.stack([vals[starts], vals[ends]], axis=1).astype(np.uint16)
+
+    def best_encoding(self):
+        """(type_code, payload_bytes) — smallest of array/bitmap/run."""
+        n = self.n
+        runs = self._runs()
+        sizes = {
+            TYPE_ARRAY: 2 * n,
+            TYPE_BITMAP: 8 * BITMAP_WORDS,
+            TYPE_RUN: 2 + 4 * runs.shape[0],
+        }
+        code = min(sizes, key=lambda k: (sizes[k], k))
+        if code == TYPE_ARRAY:
+            payload = self.values().astype("<u2").tobytes()
+        elif code == TYPE_BITMAP:
+            payload = self.words().astype("<u8").tobytes()
+        else:
+            payload = struct.pack("<H", runs.shape[0]) + runs.astype("<u2").tobytes()
+        return code, payload
+
+    @classmethod
+    def from_payload(cls, type_code: int, n: int, buf: memoryview) -> tuple["Container", int]:
+        """Parse one container payload; returns (container, bytes consumed)."""
+        def need(nbytes: int) -> None:
+            if len(buf) < nbytes:
+                raise ValueError(
+                    f"container payload truncated: need {nbytes} bytes, have {len(buf)}"
+                )
+
+        if type_code == TYPE_ARRAY:
+            need(2 * n)
+            arr = np.frombuffer(buf[: 2 * n], dtype="<u2").astype(np.uint16)
+            return cls("array", arr), 2 * n
+        if type_code == TYPE_BITMAP:
+            need(8 * BITMAP_WORDS)
+            words = np.frombuffer(buf[: 8 * BITMAP_WORDS], dtype="<u8").copy()
+            return cls("bitmap", words)._normalize(), 8 * BITMAP_WORDS
+        if type_code == TYPE_RUN:
+            need(2)
+            (nruns,) = struct.unpack_from("<H", buf, 0)
+            need(2 + 4 * nruns)
+            iv = np.frombuffer(buf[2 : 2 + 4 * nruns], dtype="<u2").reshape(nruns, 2)
+            total = int(np.sum(iv[:, 1].astype(np.int64) - iv[:, 0].astype(np.int64) + 1)) if nruns else 0
+            vals = np.empty(total, dtype=np.uint16)
+            pos = 0
+            for start, last in iv.astype(np.int64):
+                ln = last - start + 1
+                vals[pos : pos + ln] = np.arange(start, last + 1, dtype=np.uint16)
+                pos += ln
+            return cls.from_values(vals), 2 + 4 * nruns
+        raise ValueError(f"unknown container type {type_code}")
+
+
+class Bitmap:
+    """64-bit roaring bitmap: {key = position >> 16} -> Container.
+
+    Mirrors the reference Bitmap's public behavior (roaring/roaring.go:115)
+    minus compute kernels. `op_writer` is the WAL hook: when set, single-value
+    add/remove append 13-byte op records (the OpWriter field,
+    roaring/roaring.go:119-122).
+    """
+
+    def __init__(self, values=None):
+        self.containers: dict[int, Container] = {}
+        self.op_writer: Optional[io.RawIOBase] = None
+        self.op_n = 0
+        if values is not None:
+            self.add_many(np.asarray(values, dtype=np.uint64))
+
+    # -- mutation -----------------------------------------------------------
+
+    def _with_key(self, key: int) -> Container:
+        c = self.containers.get(key)
+        if c is None:
+            c = Container.empty()
+        return c
+
+    def _store(self, key: int, c: Container) -> None:
+        if c.n == 0:
+            self.containers.pop(key, None)
+        else:
+            self.containers[key] = c
+
+    def add_many(self, values: np.ndarray) -> None:
+        """Bulk insert (no op-log; callers snapshot, as reference bulk paths)."""
+        values = np.unique(np.asarray(values, dtype=np.uint64))
+        if values.size == 0:
+            return
+        keys = (values >> np.uint64(16)).astype(np.int64)
+        lows = (values & np.uint64(0xFFFF)).astype(np.uint16)
+        boundaries = np.flatnonzero(np.diff(keys)) + 1
+        for chunk_keys, chunk_lows in zip(
+            np.split(keys, boundaries), np.split(lows, boundaries)
+        ):
+            key = int(chunk_keys[0])
+            self._store(key, self._with_key(key).add_many(chunk_lows))
+
+    def remove_many(self, values: np.ndarray) -> None:
+        values = np.unique(np.asarray(values, dtype=np.uint64))
+        keys = (values >> np.uint64(16)).astype(np.int64)
+        lows = (values & np.uint64(0xFFFF)).astype(np.uint16)
+        if values.size == 0:
+            return
+        boundaries = np.flatnonzero(np.diff(keys)) + 1
+        for chunk_keys, chunk_lows in zip(
+            np.split(keys, boundaries), np.split(lows, boundaries)
+        ):
+            key = int(chunk_keys[0])
+            if key in self.containers:
+                self._store(key, self.containers[key].remove_many(chunk_lows))
+
+    def add(self, value: int) -> bool:
+        """Single add; appends to the op-log when attached (DirectAdd +
+        writeOp, roaring/roaring.go:154,977). Returns True if changed."""
+        changed = not self.contains(value)
+        if changed:
+            key, low = value >> 16, value & 0xFFFF
+            self._store(key, self._with_key(key).add_many(np.array([low], dtype=np.uint16)))
+        self._write_op(OP_ADD, value)
+        return changed
+
+    def remove(self, value: int) -> bool:
+        changed = self.contains(value)
+        if changed:
+            key, low = value >> 16, value & 0xFFFF
+            self._store(key, self.containers[key].remove_many(np.array([low], dtype=np.uint16)))
+        self._write_op(OP_REMOVE, value)
+        return changed
+
+    def _write_op(self, typ: int, value: int) -> None:
+        if self.op_writer is None:
+            return
+        body = struct.pack("<BQ", typ, value)
+        self.op_writer.write(body + struct.pack("<I", fnv1a32(body)))
+        self.op_n += 1
+
+    # -- queries ------------------------------------------------------------
+
+    def contains(self, value: int) -> bool:
+        c = self.containers.get(value >> 16)
+        return c is not None and c.contains(value & 0xFFFF)
+
+    def count(self) -> int:
+        return sum(c.n for c in self.containers.values())
+
+    def count_range(self, start: int, stop: int) -> int:
+        total = 0
+        for key in self._keys_in(start, stop):
+            c = self.containers[key]
+            base = key << 16
+            lo, hi = max(start - base, 0), min(stop - base, CONTAINER_BITS)
+            if lo <= 0 and hi >= CONTAINER_BITS:
+                total += c.n
+            else:
+                v = c.values().astype(np.int64)
+                total += int(np.count_nonzero((v >= lo) & (v < hi)))
+        return total
+
+    def slice(self, start: int = 0, stop: Optional[int] = None) -> np.ndarray:
+        """All set positions in [start, stop) as uint64."""
+        out = []
+        stop = stop if stop is not None else (1 << 64)
+        if stop <= start:
+            return np.empty(0, dtype=np.uint64)
+        # inclusive upper bound so stop == 2^64 doesn't overflow uint64 compare
+        last = np.uint64(stop - 1)
+        for key in self._keys_in(start, stop):
+            c = self.containers[key]
+            base = np.uint64(key << 16)
+            vals = c.values().astype(np.uint64) + base
+            out.append(vals[(vals >= np.uint64(start)) & (vals <= last)])
+        if not out:
+            return np.empty(0, dtype=np.uint64)
+        return np.concatenate(out)
+
+    def _keys_in(self, start: int, stop: int) -> list[int]:
+        if stop <= start:
+            return []
+        lo, hi = start >> 16, (stop - 1) >> 16
+        return sorted(k for k in self.containers if lo <= k <= hi)
+
+    def min(self) -> Optional[int]:
+        if not self.containers:
+            return None
+        key = min(self.containers)
+        return (key << 16) | int(self.containers[key].values()[0])
+
+    def max(self) -> Optional[int]:
+        if not self.containers:
+            return None
+        key = max(self.containers)
+        return (key << 16) | int(self.containers[key].values()[-1])
+
+    def any(self) -> bool:
+        return bool(self.containers)
+
+    def __iter__(self) -> Iterator[int]:
+        for key in sorted(self.containers):
+            base = key << 16
+            for v in self.containers[key].values():
+                yield base | int(v)
+
+    # -- dense materialization (OffsetRange analog) -------------------------
+
+    def to_dense_words(self, start: int, stop: int) -> np.ndarray:
+        """Dense little-endian uint32 bitvector of positions [start, stop).
+
+        start/stop must be container-aligned (multiples of 2^16) — true for
+        row materialization since SHARD_WIDTH is container-aligned
+        (fragment.go:361 OffsetRange usage).
+        """
+        if start % CONTAINER_BITS or stop % CONTAINER_BITS:
+            raise ValueError("range must be container-aligned")
+        n_words = (stop - start) // 32
+        out = np.zeros(n_words, dtype=np.uint32)
+        for key in range(start >> 16, stop >> 16):
+            c = self.containers.get(key)
+            if c is None:
+                continue
+            woff = ((key << 16) - start) // 32
+            out[woff : woff + CONTAINER_BITS // 32] = c.words().view("<u4")
+        return out
+
+    @classmethod
+    def from_dense_words(cls, words: np.ndarray, base: int = 0) -> "Bitmap":
+        """Inverse of to_dense_words: build from a dense uint32 bitvector
+        whose bit 0 is absolute position `base` (container-aligned)."""
+        if base % CONTAINER_BITS:
+            raise ValueError("base must be container-aligned")
+        b = cls()
+        words = np.ascontiguousarray(np.asarray(words, dtype=np.uint32))
+        wpc = CONTAINER_BITS // 32
+        for i in range(0, words.size, wpc):
+            chunk = words[i : i + wpc]
+            if not chunk.any():
+                continue
+            w64 = chunk.astype("<u4").tobytes()
+            w64 = np.frombuffer(w64.ljust(8 * BITMAP_WORDS, b"\0"), dtype="<u8").copy()
+            c = Container("bitmap", w64)._normalize()
+            b._store((base >> 16) + i // wpc, c)
+        return b
+
+    # -- set algebra --------------------------------------------------------
+
+    def _binary(self, other: "Bitmap", kind: str) -> "Bitmap":
+        out = Bitmap()
+        if kind in ("and",):
+            keys = set(self.containers) & set(other.containers)
+        elif kind == "andnot":
+            keys = set(self.containers)
+        else:
+            keys = set(self.containers) | set(other.containers)
+        for key in keys:
+            a = self.containers.get(key)
+            b = other.containers.get(key)
+            if a is None and b is None:
+                continue
+            if a is None:
+                res = b if kind in ("or", "xor") else None
+            elif b is None:
+                res = a if kind in ("or", "xor", "andnot") else None
+            else:
+                res = a.op(b, kind)
+            if res is not None and res.n:
+                out.containers[key] = Container(res.kind, res.data.copy())
+        return out
+
+    def intersect(self, other: "Bitmap") -> "Bitmap":
+        return self._binary(other, "and")
+
+    def union(self, other: "Bitmap") -> "Bitmap":
+        return self._binary(other, "or")
+
+    def difference(self, other: "Bitmap") -> "Bitmap":
+        return self._binary(other, "andnot")
+
+    def xor(self, other: "Bitmap") -> "Bitmap":
+        return self._binary(other, "xor")
+
+    def intersection_count(self, other: "Bitmap") -> int:
+        total = 0
+        for key in set(self.containers) & set(other.containers):
+            total += self.containers[key].op_count(other.containers[key], "and")
+        return total
+
+    # -- serialization ------------------------------------------------------
+
+    def write_to(self, w) -> int:
+        """Serialize in Pilosa roaring format (no op-log section — a fresh
+        snapshot has an empty WAL, fragment.go:1737)."""
+        keys = sorted(k for k, c in self.containers.items() if c.n > 0)
+        encs = []
+        for k in keys:
+            c = self.containers[k]
+            code, payload = c.best_encoding()
+            encs.append((k, code, c.n, payload))
+        header = struct.pack("<HHI", MAGIC_NUMBER, STORAGE_VERSION, len(keys))
+        desc = b"".join(struct.pack("<QHH", k, code, n - 1) for k, code, n, _ in encs)
+        offset = HEADER_BASE_SIZE + len(keys) * 12 + len(keys) * 4
+        offsets = []
+        for _, _, _, payload in encs:
+            offsets.append(struct.pack("<I", offset))
+            offset += len(payload)
+        data = header + desc + b"".join(offsets) + b"".join(p for *_, p in encs)
+        w.write(data)
+        return len(data)
+
+    def to_bytes(self) -> bytes:
+        buf = io.BytesIO()
+        self.write_to(buf)
+        return buf.getvalue()
+
+    @classmethod
+    def from_bytes(cls, data: bytes) -> "Bitmap":
+        """Parse either Pilosa format (magic 12348, + trailing op-log replay,
+        roaring/roaring.go:886-975) or the official RoaringFormatSpec
+        (cookies 12346/12347, roaring/roaring.go:3825-3985)."""
+        if len(data) < HEADER_BASE_SIZE:
+            raise ValueError("data too small")
+        (magic,) = struct.unpack_from("<H", data, 0)
+        if magic != MAGIC_NUMBER:
+            return cls._from_official_bytes(data)
+        _, version, key_n = struct.unpack_from("<HHI", data, 0)
+        if version != STORAGE_VERSION:
+            raise ValueError(f"wrong roaring version, file is v{version}")
+        b = cls()
+        mv = memoryview(data)
+        desc_off = HEADER_BASE_SIZE
+        off_off = desc_off + key_n * 12
+        ops_offset = off_off + key_n * 4
+        if ops_offset > len(data):
+            raise ValueError(
+                f"header overruns buffer: {key_n} containers need {ops_offset} bytes, have {len(data)}"
+            )
+        for i in range(key_n):
+            key, code, n_minus_1 = struct.unpack_from("<QHH", data, desc_off + i * 12)
+            (offset,) = struct.unpack_from("<I", data, off_off + i * 4)
+            if offset >= len(data):
+                raise ValueError(f"offset out of bounds: off={offset}, len={len(data)}")
+            c, consumed = Container.from_payload(code, n_minus_1 + 1, mv[offset:])
+            b._store(int(key), c)
+            ops_offset = offset + consumed
+        # Trailing op-log replay.
+        pos = ops_offset
+        while pos < len(data):
+            if pos + OP_SIZE > len(data):
+                raise ValueError(f"op data out of bounds: len={len(data) - pos}")
+            body = data[pos : pos + 9]
+            (chk,) = struct.unpack_from("<I", data, pos + 9)
+            if chk != fnv1a32(body):
+                raise ValueError("checksum mismatch")
+            typ, value = struct.unpack("<BQ", body)
+            saved, b.op_writer = b.op_writer, None
+            if typ == OP_ADD:
+                b.add(value)
+            elif typ == OP_REMOVE:
+                b.remove(value)
+            else:
+                raise ValueError(f"invalid op type: {typ}")
+            b.op_writer = saved
+            b.op_n += 1
+            pos += OP_SIZE
+        return b
+
+    # Official RoaringFormatSpec cookies (readOfficialHeader,
+    # roaring/roaring.go:3825): 12347 = with runs, 12346 = without.
+    _SERIAL_COOKIE = 12347
+    _SERIAL_COOKIE_NO_RUN = 12346
+
+    @classmethod
+    def _from_official_bytes(cls, data: bytes) -> "Bitmap":
+        """Official 32-bit RoaringFormatSpec reader. Note the official run
+        encoding is (start, length), unlike Pilosa's (start, last)."""
+        if len(data) < 8:
+            raise ValueError("buffer too small")
+        (cookie32,) = struct.unpack_from("<I", data, 0)
+        pos = 4
+        run_flags = None
+        if cookie32 == cls._SERIAL_COOKIE_NO_RUN:
+            (size,) = struct.unpack_from("<I", data, pos)
+            pos += 4
+        elif cookie32 & 0xFFFF == cls._SERIAL_COOKIE:
+            size = (cookie32 >> 16) + 1
+            nbytes = (size + 7) // 8
+            run_flags = data[pos : pos + nbytes]
+            pos += nbytes
+        else:
+            raise ValueError("did not find expected serialCookie in header")
+        if size > (1 << 16):
+            raise ValueError("more than 2^16 containers is impossible")
+        keys, cards, kinds = [], [], []
+        for i in range(size):
+            key, card_m1 = struct.unpack_from("<HH", data, pos + 4 * i)
+            keys.append(key)
+            cards.append(card_m1 + 1)
+            is_run = run_flags is not None and (run_flags[i // 8] >> (i % 8)) & 1
+            kinds.append(TYPE_RUN if is_run else (TYPE_ARRAY if card_m1 + 1 <= ARRAY_MAX_SIZE else TYPE_BITMAP))
+        pos += 4 * size
+        b = cls()
+        mv = memoryview(data)
+        if run_flags is None:
+            # offset section always present
+            offsets = [struct.unpack_from("<I", data, pos + 4 * i)[0] for i in range(size)]
+            for key, card, kind, off in zip(keys, cards, kinds, offsets):
+                if off >= len(data):
+                    raise ValueError(f"offset out of bounds: off={off}")
+                c, _ = Container.from_payload(kind, card, mv[off:])
+                b._store(key, c)
+        else:
+            # Spec: with the run cookie, an offset header is still present when
+            # size >= NO_OFFSET_THRESHOLD (4). (The reference's readWithRuns
+            # omits this and would misparse such files; we follow the spec.)
+            if size >= 4:
+                pos += 4 * size
+            # sequential payloads, runs as (start, length)
+            for i, (key, card, kind) in enumerate(zip(keys, cards, kinds)):
+                if kind == TYPE_RUN:
+                    (nruns,) = struct.unpack_from("<H", data, pos)
+                    iv = np.frombuffer(mv[pos + 2 : pos + 2 + 4 * nruns], dtype="<u2").reshape(nruns, 2).astype(np.int64)
+                    vals = np.concatenate(
+                        [np.arange(s, s + ln + 1, dtype=np.uint16) for s, ln in iv]
+                    ) if nruns else np.empty(0, dtype=np.uint16)
+                    b._store(key, Container.from_values(vals))
+                    pos += 2 + 4 * nruns
+                else:
+                    c, consumed = Container.from_payload(kind, card, mv[pos:])
+                    b._store(key, c)
+                    pos += consumed
+        return b
+
+    def check(self) -> None:
+        """Consistency check (Bitmap.Check, roaring/roaring.go:1015)."""
+        for key, c in self.containers.items():
+            if c.n == 0:
+                raise ValueError(f"empty container at key {key}")
+            if c.kind == "array":
+                if c.data.size and not np.all(np.diff(c.data.astype(np.int64)) > 0):
+                    raise ValueError(f"unsorted/duplicate array container at key {key}")

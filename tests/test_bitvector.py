@@ -1,0 +1,103 @@
+"""L0 kernel tests: dense bitvector algebra vs. numpy ground truth.
+
+Mirrors the role of the reference's roaring container-op matrix tests
+(roaring/roaring_internal_test.go) — here the matrix is dense, so the ground
+truth is plain numpy set algebra over column lists.
+"""
+
+import numpy as np
+import pytest
+
+from pilosa_tpu import ops
+from pilosa_tpu.constants import SHARD_WIDTH, WORDS_PER_SHARD
+from pilosa_tpu.ops.bitvector import range_mask, set_range, xor_range, zero_range
+
+RNG = np.random.default_rng(42)
+
+
+def random_columns(n, width=SHARD_WIDTH):
+    return np.unique(RNG.integers(0, width, size=n))
+
+
+def test_dense_roundtrip():
+    cols = random_columns(5000)
+    dense = ops.dense_from_columns(cols)
+    assert dense.shape == (WORDS_PER_SHARD,)
+    assert dense.dtype == np.uint32
+    back = ops.columns_from_dense(dense)
+    np.testing.assert_array_equal(back, cols)
+
+
+def test_dense_empty_and_bounds():
+    dense = ops.dense_from_columns(np.array([], dtype=np.int64))
+    assert ops.columns_from_dense(dense).size == 0
+    with pytest.raises(ValueError):
+        ops.dense_from_columns(np.array([SHARD_WIDTH]))
+    with pytest.raises(ValueError):
+        ops.dense_from_columns(np.array([-1]))
+
+
+@pytest.mark.parametrize("na,nb", [(0, 100), (100, 0), (3000, 5000), (1, 1)])
+def test_pairwise_ops_match_set_algebra(na, nb):
+    a_cols, b_cols = random_columns(na), random_columns(nb)
+    a, b = ops.dense_from_columns(a_cols), ops.dense_from_columns(b_cols)
+    sa, sb = set(a_cols.tolist()), set(b_cols.tolist())
+
+    cases = {
+        "and": (ops.band, sa & sb),
+        "or": (ops.bor, sa | sb),
+        "xor": (ops.bxor, sa ^ sb),
+        "andnot": (ops.bandnot, sa - sb),
+    }
+    for name, (fn, expect) in cases.items():
+        got = set(ops.columns_from_dense(np.asarray(fn(a, b))).tolist())
+        assert got == expect, name
+
+
+def test_counts():
+    a_cols, b_cols = random_columns(4000), random_columns(6000)
+    a, b = ops.dense_from_columns(a_cols), ops.dense_from_columns(b_cols)
+    sa, sb = set(a_cols.tolist()), set(b_cols.tolist())
+    assert int(ops.popcount(a)) == len(sa)
+    assert int(ops.intersect_count(a, b)) == len(sa & sb)
+    assert int(ops.union_count(a, b)) == len(sa | sb)
+    assert int(ops.difference_count(a, b)) == len(sa - sb)
+    assert int(ops.xor_count(a, b)) == len(sa ^ sb)
+
+
+def test_batched_broadcasting():
+    # Stacked [rows, words] slab: kernels must broadcast over leading axes.
+    rows = np.stack([ops.dense_from_columns(random_columns(n)) for n in (10, 500, 4096)])
+    counts = np.asarray(ops.row_popcounts(rows))
+    expect = [len(ops.columns_from_dense(r)) for r in rows]
+    np.testing.assert_array_equal(counts, expect)
+
+    other = ops.dense_from_columns(random_columns(2000))
+    inter = np.asarray(ops.intersect_count(rows, other))
+    expect = [
+        len(set(ops.columns_from_dense(r).tolist()) & set(ops.columns_from_dense(other).tolist()))
+        for r in rows
+    ]
+    np.testing.assert_array_equal(inter, expect)
+
+
+def test_complement_count():
+    cols = random_columns(1234)
+    a = ops.dense_from_columns(cols)
+    assert int(ops.popcount(ops.bnot(a))) == SHARD_WIDTH - len(cols)
+
+
+@pytest.mark.parametrize("start,end", [(0, 0), (0, 64), (5, 37), (100, 100000), (0, 1 << 16)])
+def test_range_ops(start, end):
+    width = 1 << 16
+    n_words = width // 32
+    mask = np.asarray(range_mask(np.uint32(start), np.uint32(end), n_words))
+    expect = set(range(start, min(end, width)))
+    assert set(ops.columns_from_dense(mask).tolist()) == expect
+
+    base_cols = random_columns(500, width=1 << 16)
+    base = ops.dense_from_columns(base_cols, width=1 << 16)
+    sbase = set(base_cols.tolist())
+    assert set(ops.columns_from_dense(np.asarray(set_range(base, mask))).tolist()) == sbase | expect
+    assert set(ops.columns_from_dense(np.asarray(zero_range(base, mask))).tolist()) == sbase - expect
+    assert set(ops.columns_from_dense(np.asarray(xor_range(base, mask))).tolist()) == sbase ^ expect

@@ -1,0 +1,106 @@
+"""BSI kernel tests vs. numpy integer ground truth.
+
+Mirrors the reference's fragment BSI tests (fragment_internal_test.go:
+setValue/sum/min/max/range cases) with randomized values.
+"""
+
+import numpy as np
+import pytest
+
+from pilosa_tpu.ops import bitvector as bv
+from pilosa_tpu.ops import bsi
+
+WIDTH = 1 << 16  # small shard width for test speed
+DEPTH = 12
+RNG = np.random.default_rng(7)
+
+
+def make_planes(values: dict[int, int], depth=DEPTH, width=WIDTH):
+    """Build dense bit planes + existence row from {column: value}."""
+    planes = np.zeros((depth, width // 32), dtype=np.uint32)
+    exists_cols = np.array(sorted(values), dtype=np.int64)
+    for i in range(depth):
+        cols = [c for c, v in values.items() if (v >> i) & 1]
+        planes[i] = bv.dense_from_columns(np.array(cols, dtype=np.int64), width)
+    exists = bv.dense_from_columns(exists_cols, width)
+    return planes, exists
+
+
+@pytest.fixture(scope="module")
+def data():
+    cols = np.unique(RNG.integers(0, WIDTH, size=800))
+    values = {int(c): int(RNG.integers(0, 1 << DEPTH)) for c in cols}
+    planes, exists = make_planes(values)
+    return values, planes, exists
+
+
+def test_sum(data):
+    values, planes, exists = data
+    counts = np.asarray(bsi.plane_counts(planes, exists))
+    assert bsi.counts_to_sum(counts) == sum(values.values())
+    assert int(bv.popcount(exists)) == len(values)
+
+
+def test_sum_with_filter(data):
+    values, planes, exists = data
+    keep = [c for c in values if c % 3 == 0]
+    filt = bv.dense_from_columns(np.array(keep, dtype=np.int64), WIDTH)
+    filt = np.asarray(bv.band(filt, exists))
+    counts = np.asarray(bsi.plane_counts(planes, filt))
+    assert bsi.counts_to_sum(counts) == sum(values[c] for c in keep)
+
+
+def test_min_max(data):
+    values, planes, exists = data
+    bits, cnt = bsi.bsi_min(planes, exists)
+    vmin = min(values.values())
+    assert bsi.bits_to_value(np.asarray(bits)) == vmin
+    assert int(cnt) == sum(1 for v in values.values() if v == vmin)
+
+    bits, cnt = bsi.bsi_max(planes, exists)
+    vmax = max(values.values())
+    assert bsi.bits_to_value(np.asarray(bits)) == vmax
+    assert int(cnt) == sum(1 for v in values.values() if v == vmax)
+
+
+def test_min_max_empty_candidate(data):
+    _, planes, _ = data
+    empty = np.zeros(WIDTH // 32, dtype=np.uint32)
+    _, cnt = bsi.bsi_min(planes, empty)
+    assert int(cnt) == 0
+    _, cnt = bsi.bsi_max(planes, empty)
+    assert int(cnt) == 0
+
+
+@pytest.mark.parametrize("op,pyop", [
+    (bsi.LT, lambda v, p: v < p),
+    (bsi.LTE, lambda v, p: v <= p),
+    (bsi.GT, lambda v, p: v > p),
+    (bsi.GTE, lambda v, p: v >= p),
+    (bsi.EQ, lambda v, p: v == p),
+    (bsi.NEQ, lambda v, p: v != p),
+])
+@pytest.mark.parametrize("pred", [0, 1, 1000, (1 << DEPTH) - 1, 2048])
+def test_compare(data, op, pyop, pred):
+    values, planes, exists = data
+    pred_bits = bsi.value_to_bits(pred, DEPTH)
+    got = set(bv.columns_from_dense(np.asarray(bsi.compare(planes, exists, pred_bits, op))).tolist())
+    expect = {c for c, v in values.items() if pyop(v, pred)}
+    assert got == expect
+
+
+def test_between(data):
+    values, planes, exists = data
+    a, b = 500, 3000
+    lo = bsi.compare(planes, exists, bsi.value_to_bits(a, DEPTH), bsi.GTE)
+    hi = bsi.compare(planes, exists, bsi.value_to_bits(b, DEPTH), bsi.LTE)
+    got = set(bv.columns_from_dense(np.asarray(bv.band(lo, hi))).tolist())
+    expect = {c for c, v in values.items() if a <= v <= b}
+    assert got == expect
+
+
+def test_value_bits_roundtrip():
+    for v in (0, 1, 12345, (1 << 40) + 17):
+        assert bsi.bits_to_value(bsi.value_to_bits(v, 48)) == v
+    with pytest.raises(ValueError):
+        bsi.value_to_bits(-1, 8)

@@ -1,0 +1,37 @@
+"""Golden-fixture compatibility: parse real files written by the reference
+implementation (gated on the read-only reference checkout being present).
+
+`testdata/sample_view/0` is a Pilosa-format fragment storage file;
+`roaring/testdata/bitmapcontainer.roaringbitmap` is official RoaringFormatSpec
+(cookie 12346) — the reference reads both (roaring/roaring.go:3887).
+"""
+
+import os
+
+import pytest
+
+from pilosa_tpu.storage.roaring import Bitmap
+
+REF = "/root/reference"
+
+pytestmark = pytest.mark.skipif(
+    not os.path.isdir(REF), reason="reference checkout not available"
+)
+
+
+def test_parse_reference_fragment_file():
+    data = open(f"{REF}/testdata/sample_view/0", "rb").read()
+    b = Bitmap.from_bytes(data)
+    assert len(b.containers) == 14207
+    assert b.count() == 35001
+    # re-serialize -> re-parse is lossless
+    b2 = Bitmap.from_bytes(b.to_bytes())
+    assert b2.count() == b.count()
+    assert b2.min() == b.min() and b2.max() == b.max()
+
+
+def test_parse_official_format_file():
+    data = open(f"{REF}/roaring/testdata/bitmapcontainer.roaringbitmap", "rb").read()
+    b = Bitmap.from_bytes(data)
+    assert b.count() == 10000
+    assert b.min() == 1 and b.max() == 65537
