@@ -1,0 +1,70 @@
+"""Benchmark: PQL Intersect+Count on TPU vs CPU-numpy reference baseline.
+
+Config 2 of BASELINE.md: synthetic set field, two rows spanning S shards,
+Count(Intersect(Row, Row)) — the hot path the reference serves with roaring
+container kernels + goroutine fan-out (executor.go:2183, roaring
+intersectionCount kernels). No Go toolchain exists in this image, so the
+baseline is a measured CPU implementation of the same dense kernel in numpy
+(vectorized AND + popcount — an upper bound on the Go implementation's
+single-node throughput for dense data, and the same algorithmic work).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+import json
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    import jax
+    from pilosa_tpu.constants import WORDS_PER_SHARD
+    from pilosa_tpu.parallel.mesh import eval_count_total
+
+    n_shards = 1024  # 1024 shards x 2^20 cols = 1.07B columns per operand
+    rng = np.random.default_rng(7)
+    slab_np = rng.integers(0, 2**32, size=(2, n_shards, WORDS_PER_SHARD), dtype=np.uint32)
+    program = ("and", ("leaf", 0), ("leaf", 1))
+
+    # --- TPU path: HBM-resident slab, fused and+popcount ---
+    slab = jax.device_put(slab_np)
+    total = int(eval_count_total(slab, program))  # compile + warm
+    iters = 30
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        r = eval_count_total(slab, program)
+    jax.block_until_ready(r)
+    tpu_s = (time.perf_counter() - t0) / iters
+
+    # --- CPU baseline: same kernel in numpy ---
+    a, b = slab_np[0], slab_np[1]
+    cpu_total = int(np.bitwise_count(a & b).sum())
+    assert cpu_total == total
+    cpu_iters = 3
+    t0 = time.perf_counter()
+    for _ in range(cpu_iters):
+        np.bitwise_count(a & b).sum()
+    cpu_s = (time.perf_counter() - t0) / cpu_iters
+
+    cols = n_shards * (WORDS_PER_SHARD * 32)
+    qps = 1.0 / tpu_s
+    result = {
+        "metric": "intersect_count_qps_1Bcol",
+        "value": round(qps, 2),
+        "unit": "queries/s/chip",
+        "vs_baseline": round(cpu_s / tpu_s, 2),
+        "detail": {
+            "tpu_ms_per_query": round(tpu_s * 1e3, 4),
+            "cpu_numpy_ms_per_query": round(cpu_s * 1e3, 4),
+            "columns_per_operand": cols,
+            "tpu_gcols_per_s": round(cols / tpu_s / 1e9, 2),
+            "hbm_gb_per_s": round(2 * cols / 8 / tpu_s / 1e9, 1),
+            "device": str(jax.devices()[0]),
+        },
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,655 @@
+"""Query executor: PQL call dispatch over device-evaluated shard slabs.
+
+Reference: executor.go. The reference evaluates each call per shard inside a
+goroutine fan-out, with roaring container kernels doing the bitwise work
+(executor.go:2183-2321, 1173-1520). The TPU redesign batches instead of
+threading: for a query the executor
+
+  1. walks the bitmap call tree and collects *leaf* operands
+     (Row / BSI-compare results / existence rows),
+  2. materializes each leaf as a dense bitvector for every shard in the
+     query's shard set — through a generation-keyed device cache, so repeat
+     queries touch HBM-resident slabs without host transfers,
+  3. compiles the call tree to a static nested-tuple program and evaluates
+     it on device in one fused XLA program over the [leaves, shards, words]
+     slab (pilosa_tpu.parallel.mesh),
+  4. reduces: per-shard popcounts / dense rows come back int32/uint32; the
+     host assembles exact Python ints and Row segments — the associative
+     reduceFn role (executor.go:2209-2242).
+
+Writes (Set/Clear/Store/attrs) stay host-side against the WAL-backed
+fragments, invalidating cached slabs by generation, exactly as the
+reference's rowCache is invalidated on mutation (fragment.go:435-440).
+"""
+
+from __future__ import annotations
+
+from datetime import datetime
+from typing import Optional
+
+import numpy as np
+
+from pilosa_tpu.constants import SHARD_WIDTH
+from pilosa_tpu.models import timequantum
+from pilosa_tpu.models.cache import merge_pairs
+from pilosa_tpu.models.field import FieldType
+from pilosa_tpu.models.index import Index
+from pilosa_tpu.models.row import Row
+from pilosa_tpu.models.view import VIEW_STANDARD
+from pilosa_tpu.ops import bsi as bsi_ops
+from pilosa_tpu.ops.bitvector import columns_from_dense
+from pilosa_tpu.parallel.mesh import DeviceRunner
+from pilosa_tpu.pql import Call, Condition, Query, parse_string
+from pilosa_tpu.pql.ast import BETWEEN, EQ, GT, GTE, LT, LTE, NEQ
+
+WORDS = SHARD_WIDTH // 32
+
+BITMAP_CALLS = {"Row", "Union", "Intersect", "Difference", "Xor", "Not", "Range"}
+
+
+class ExecutionError(ValueError):
+    pass
+
+
+class ValCount:
+    """Sum/Min/Max result (reference ValCount, executor.go:363)."""
+
+    __slots__ = ("val", "count")
+
+    def __init__(self, val: int = 0, count: int = 0):
+        self.val = val
+        self.count = count
+
+    def to_json_dict(self):
+        return {"value": self.val, "count": self.count}
+
+    def __eq__(self, other):
+        return isinstance(other, ValCount) and (self.val, self.count) == (other.val, other.count)
+
+    def __repr__(self):
+        return f"ValCount(val={self.val}, count={self.count})"
+
+
+class Executor:
+    def __init__(self, holder, runner: Optional[DeviceRunner] = None,
+                 translator=None):
+        self.holder = holder
+        self.runner = runner or DeviceRunner()
+        self.translator = translator
+        # device slab cache: (index, field, view, shard, row, generation) ->
+        # host dense row; slabs assembled per query then device_put (the
+        # HBM residency layer; see DeviceRunner.put_slab)
+        self._row_cache: dict[tuple, np.ndarray] = {}
+
+    # ------------------------------------------------------------------ API
+
+    def execute(self, index_name: str, query, shards: Optional[list[int]] = None):
+        """Execute a PQL query; returns a list of per-call results
+        (executor.Execute, executor.go:84)."""
+        if isinstance(query, str):
+            query = parse_string(query)
+        if not isinstance(query, Query):
+            raise TypeError("query must be a PQL string or Query")
+        index = self.holder.index(index_name)
+        if index is None:
+            raise ExecutionError(f"index not found: {index_name}")
+        results = []
+        for call in query.calls:
+            results.append(self._execute_call(index, call, shards))
+        return results
+
+    # ------------------------------------------------------------ dispatch
+
+    def _execute_call(self, index: Index, call: Call, shards):
+        # Options() wrapper (executor.go:317)
+        if call.name == "Options":
+            return self._execute_options(index, call, shards)
+        handler = {
+            "Count": self._execute_count,
+            "TopN": self._execute_topn,
+            "Sum": self._execute_sum,
+            "Min": self._execute_min,
+            "Max": self._execute_max,
+            "Rows": self._execute_rows,
+            "GroupBy": self._execute_group_by,
+            "Set": self._execute_set,
+            "Clear": self._execute_clear,
+            "ClearRow": self._execute_clear_row,
+            "Store": self._execute_store,
+            "SetRowAttrs": self._execute_set_row_attrs,
+            "SetColumnAttrs": self._execute_set_column_attrs,
+        }.get(call.name)
+        if handler is not None:
+            return handler(index, call, shards)
+        if call.name in BITMAP_CALLS:
+            return self._execute_bitmap_call(index, call, shards)
+        raise ExecutionError(f"unknown call: {call.name}")
+
+    def _query_shards(self, index: Index, shards) -> list[int]:
+        if shards is not None:
+            return sorted(shards)
+        return [int(s) for s in index.available_shards().slice()]
+
+    # ----------------------------------------------------- bitmap programs
+
+    def _compile(self, index: Index, call: Call, shards: list[int]):
+        """Walk the call tree -> (program, leaves[L, S, W] numpy slab)."""
+        leaves: list[np.ndarray] = []
+
+        def leaf(slab_rows: np.ndarray):
+            leaves.append(slab_rows)
+            return ("leaf", len(leaves) - 1)
+
+        def walk(c: Call):
+            if c.name == "Row":
+                return leaf(self._materialize_row_call(index, c, shards))
+            if c.name == "Range":
+                return leaf(self._materialize_range_call(index, c, shards))
+            if c.name == "Union":
+                return ("or", *[walk(ch) for ch in c.children])
+            if c.name == "Intersect":
+                if not c.children:
+                    raise ExecutionError("empty Intersect query is currently not supported")
+                return ("and", *[walk(ch) for ch in c.children])
+            if c.name == "Difference":
+                return ("andnot", *[walk(ch) for ch in c.children])
+            if c.name == "Xor":
+                return ("xor", *[walk(ch) for ch in c.children])
+            if c.name == "Not":
+                if len(c.children) != 1:
+                    raise ExecutionError("Not() takes exactly one argument")
+                # Not = existence &~ child (executor.go:1478-1520)
+                ex = leaf(self._materialize_existence(index, shards))
+                return ("andnot", ex, walk(c.children[0]))
+            raise ExecutionError(f"expected bitmap call, got {c.name}")
+
+        program = walk(call)
+        if leaves:
+            slab = np.stack(leaves, axis=0)
+        else:
+            slab = np.zeros((1, len(shards), WORDS), dtype=np.uint32)
+        return program, slab
+
+    def _execute_bitmap_call(self, index: Index, call: Call, shards) -> Row:
+        shards = self._query_shards(index, shards)
+        program, slab = self._compile(index, call, shards)
+        dense = self.runner.row(slab, program)
+        out = Row()
+        for i, shard in enumerate(shards):
+            cols = columns_from_dense(dense[i])
+            if cols.size:
+                out.segments[shard] = cols.astype(np.uint64) + np.uint64(shard * SHARD_WIDTH)
+        return out
+
+    def _execute_count(self, index: Index, call: Call, shards) -> int:
+        if len(call.children) != 1:
+            raise ExecutionError("Count() takes exactly one argument")
+        shards = self._query_shards(index, shards)
+        program, slab = self._compile(index, call.children[0], shards)
+        return self.runner.count_total(slab, program)
+
+    # ------------------------------------------------- leaf materialization
+
+    def _cached_row(self, index: Index, field_name: str, view_name: str,
+                    shard: int, row_id: int) -> np.ndarray:
+        f = index.field(field_name)
+        view = f.view(view_name) if f else None
+        frag = view.fragment(shard) if view else None
+        if frag is None:
+            return np.zeros(WORDS, dtype=np.uint32)
+        key = (index.name, field_name, view_name, shard, row_id,
+               frag.row_generation(row_id))
+        cached = self._row_cache.get(key)
+        if cached is None:
+            cached = frag.row_dense(row_id)
+            self._row_cache[key] = cached
+        return cached
+
+    def _materialize_row_call(self, index: Index, c: Call, shards) -> np.ndarray:
+        field_name = c.field_arg()
+        row_val = c.args[field_name]
+        f = index.field(field_name)
+        if f is None:
+            raise ExecutionError(f"field not found: {field_name}")
+        row_id = self._translate_row(index, f, row_val)
+        if f.options.type == FieldType.BOOL and isinstance(row_val, bool):
+            row_id = 1 if row_val else 0
+        # Row(f=r, from/to) time bounds are handled by Range in v1.2
+        return np.stack([
+            self._cached_row(index, field_name, VIEW_STANDARD, s, row_id)
+            for s in shards
+        ])
+
+    def _materialize_existence(self, index: Index, shards) -> np.ndarray:
+        from pilosa_tpu.constants import EXISTENCE_FIELD_NAME
+        if index.existence_field() is None:
+            raise ExecutionError(f"index {index.name} does not support existence tracking")
+        return np.stack([
+            self._cached_row(index, EXISTENCE_FIELD_NAME, VIEW_STANDARD, s, 0)
+            for s in shards
+        ])
+
+    def _materialize_range_call(self, index: Index, c: Call, shards) -> np.ndarray:
+        # time range: Range(f=row, start, end) (executor.go executeRange)
+        if "_start" in c.args or "_end" in c.args:
+            field_name = c.field_arg()
+            f = index.field(field_name)
+            if f is None:
+                raise ExecutionError(f"field not found: {field_name}")
+            row_id = self._translate_row(index, f, c.args[field_name])
+            start, end = c.args.get("_start"), c.args.get("_end")
+            if not isinstance(start, datetime) or not isinstance(end, datetime):
+                raise ExecutionError("Range() requires start and end timestamps")
+            views = timequantum.views_by_time_range(
+                VIEW_STANDARD, start, end, f.options.time_quantum)
+            out = np.zeros((len(shards), WORDS), dtype=np.uint32)
+            for vname in views:
+                for i, s in enumerate(shards):
+                    out[i] |= self._cached_row(index, field_name, vname, s, row_id)
+            return out
+        # BSI condition: Range(f < 10) etc.
+        cond_field, cond = None, None
+        for k, v in c.args.items():
+            if isinstance(v, Condition):
+                cond_field, cond = k, v
+        if cond is None:
+            raise ExecutionError("Range() requires a condition or time bounds")
+        return self._bsi_compare(index, cond_field, cond, shards)
+
+    # ------------------------------------------------------------- BSI ops
+
+    def _bsi_field(self, index: Index, field_name: str):
+        f = index.field(field_name)
+        if f is None:
+            raise ExecutionError(f"field not found: {field_name}")
+        if f.options.type != FieldType.INT:
+            raise ExecutionError(f"field {field_name} is not an int field")
+        return f
+
+    def _bsi_planes(self, index: Index, f, shards) -> tuple[np.ndarray, np.ndarray]:
+        """(planes[depth, S, W], exists[S, W]) dense slabs for an int field."""
+        depth = f.bit_depth
+        vname = f.bsi_view_name
+        planes = np.stack([
+            np.stack([self._cached_row(index, f.name, vname, s, i) for s in shards])
+            for i in range(depth)
+        ])
+        exists = np.stack([
+            self._cached_row(index, f.name, vname, s, depth) for s in shards])
+        return planes, exists
+
+    def _bsi_compare(self, index: Index, field_name: str, cond: Condition,
+                     shards) -> np.ndarray:
+        f = self._bsi_field(index, field_name)
+        planes, exists = self._bsi_planes(index, f, shards)
+        depth = f.bit_depth
+        op = cond.op
+
+        # != null -> not-null row (executor.go:1344)
+        if op == NEQ and cond.value is None:
+            return exists
+
+        import jax
+        if op == BETWEEN:
+            lo, hi = cond.int_slice_value()
+            # clamp to field range (baseValueBetween, field.go:1410)
+            if hi < f.options.min or lo > f.options.max:
+                return np.zeros_like(exists)
+            if lo <= f.options.min and hi >= f.options.max:
+                return exists
+            blo = max(lo - f.base, 0)
+            bhi = min(hi, f.options.max) - f.base
+            dlo = bsi_ops.compare(planes, exists, bsi_ops.value_to_bits(blo, depth), bsi_ops.GTE)
+            dhi = bsi_ops.compare(planes, exists, bsi_ops.value_to_bits(bhi, depth), bsi_ops.LTE)
+            return np.asarray(jax.numpy.bitwise_and(dlo, dhi))
+
+        value = cond.value
+        if isinstance(value, bool) or not isinstance(value, int):
+            raise ExecutionError("Range(): conditions only support integer values")
+        op_map = {LT: bsi_ops.LT, LTE: bsi_ops.LTE, GT: bsi_ops.GT,
+                  GTE: bsi_ops.GTE, EQ: bsi_ops.EQ, NEQ: bsi_ops.NEQ}
+        if op not in op_map:
+            raise ExecutionError(f"unsupported condition op: {op}")
+        # out-of-range clamps (baseValue, field.go:1385)
+        if op in (GT, GTE) and value > f.options.max:
+            return np.zeros_like(exists)
+        if op in (LT, LTE) and value < f.options.min:
+            return np.zeros_like(exists)
+        if op in (EQ,) and (value < f.options.min or value > f.options.max):
+            return np.zeros_like(exists)
+        if op == NEQ and (value < f.options.min or value > f.options.max):
+            return exists
+        if (op == LT and value > f.options.max) or (op == LTE and value >= f.options.max):
+            return exists
+        if (op == GT and value < f.options.min) or (op == GTE and value <= f.options.min):
+            return exists
+        base_value = min(max(value - f.base, 0), f.options.max - f.base)
+        pred = bsi_ops.value_to_bits(base_value, depth)
+        return np.asarray(bsi_ops.compare(planes, exists, pred, op_map[op]))
+
+    def _bsi_filter(self, index: Index, call: Call, shards) -> Optional[np.ndarray]:
+        """Optional filter child for Sum/Min/Max."""
+        if not call.children:
+            return None
+        program, slab = self._compile(index, call.children[0], shards)
+        return self.runner.row(slab, program)
+
+    def _execute_sum(self, index: Index, call: Call, shards) -> ValCount:
+        field_name = call.args.get("field")
+        if field_name is None:
+            raise ExecutionError("Sum(): field required")
+        f = self._bsi_field(index, field_name)
+        shards = self._query_shards(index, shards)
+        planes, exists = self._bsi_planes(index, f, shards)
+        filt = self._bsi_filter(index, call, shards)
+        if filt is not None:
+            exists = exists & filt
+        counts = np.asarray(bsi_ops.plane_counts(planes, exists))  # [depth, S]
+        from pilosa_tpu.ops.bitvector import popcount
+        n = int(np.asarray(popcount(exists)).sum())
+        raw_sum = bsi_ops.counts_to_sum(counts.sum(axis=1))
+        # add base back per counted value (val = raw + base*count)
+        return ValCount(val=raw_sum + f.base * n, count=n)
+
+    def _execute_min(self, index: Index, call: Call, shards) -> ValCount:
+        return self._execute_min_max(index, call, shards, is_min=True)
+
+    def _execute_max(self, index: Index, call: Call, shards) -> ValCount:
+        return self._execute_min_max(index, call, shards, is_min=False)
+
+    def _execute_min_max(self, index: Index, call: Call, shards, is_min: bool) -> ValCount:
+        field_name = call.args.get("field")
+        if field_name is None:
+            raise ExecutionError(f"{'Min' if is_min else 'Max'}(): field required")
+        f = self._bsi_field(index, field_name)
+        shards = self._query_shards(index, shards)
+        planes, exists = self._bsi_planes(index, f, shards)
+        filt = self._bsi_filter(index, call, shards)
+        if filt is not None:
+            exists = exists & filt
+        fn = bsi_ops.bsi_min if is_min else bsi_ops.bsi_max
+        bits, cnt = fn(planes, exists)  # [depth, S], [S]
+        bits, cnt = np.asarray(bits), np.asarray(cnt)
+        best_val, best_cnt = None, 0
+        for i in range(len(shards)):
+            if cnt[i] == 0:
+                continue
+            v = bsi_ops.bits_to_value(bits[:, i]) + f.base
+            if best_val is None or (v < best_val if is_min else v > best_val):
+                best_val, best_cnt = v, int(cnt[i])
+            elif v == best_val:
+                best_cnt += int(cnt[i])
+        if best_val is None:
+            return ValCount(0, 0)
+        return ValCount(best_val, best_cnt)
+
+    # --------------------------------------------------------------- TopN
+
+    def _execute_topn(self, index: Index, call: Call, shards) -> list[tuple[int, int]]:
+        """Two-phase TopN (executor.go:694-761): phase 1 ranks per-shard
+        candidates; phase 2 recounts the merged winners exactly."""
+        field_name = call.args.get("_field")
+        f = index.field(field_name)
+        if f is None:
+            raise ExecutionError(f"field not found: {field_name}")
+        n = call.uint_arg("n")
+        shards = self._query_shards(index, shards)
+
+        src_dense = None
+        if call.children:
+            program, slab = self._compile(index, call.children[0], shards)
+            src_dense = self.runner.row(slab, program)
+
+        ids_arg = call.uint_slice_arg("ids")
+        threshold = call.uint_arg("threshold") or 0
+        tanimoto = call.uint_arg("tanimotoThreshold") or 0
+
+        candidates = self._topn_candidates(index, f, shards, ids_arg)
+        if not candidates:
+            return []
+        pairs = self._exact_counts(index, f, shards, candidates, src_dense, tanimoto)
+        if threshold:
+            pairs = [(i, c) for i, c in pairs if c >= threshold]
+        merged = merge_pairs([pairs])
+        if n is not None and ids_arg is None:
+            # phase 2: recount the top ~n ids exactly across all shards —
+            # already exact here since candidates span all query shards.
+            merged = merged[:n]
+        return [(i, c) for i, c in merged if c > 0]
+
+    def _topn_candidates(self, index: Index, f, shards, ids_arg) -> list[int]:
+        if ids_arg is not None:
+            return list(ids_arg)
+        out: set[int] = set()
+        view = f.view(VIEW_STANDARD)
+        if view is None:
+            return []
+        for s in shards:
+            cache = view.rank_caches.get(s)
+            if cache is not None and len(cache):
+                out.update(cache.ids())
+            else:
+                frag = view.fragment(s)
+                if frag is not None:
+                    out.update(frag.row_ids())
+        return sorted(out)
+
+    def _exact_counts(self, index: Index, f, shards, row_ids: list[int],
+                      src_dense: Optional[np.ndarray], tanimoto: int):
+        """Batched device recount: rows x shards slab -> exact counts."""
+        from pilosa_tpu.ops.topn import tanimoto_counts, tanimoto_mask
+        from pilosa_tpu.ops.bitvector import popcount, intersect_count
+        import jax.numpy as jnp
+
+        pairs = []
+        CHUNK = 256  # bound slab memory: 256 rows x S x 128KiB
+        for start in range(0, len(row_ids), CHUNK):
+            chunk = row_ids[start : start + CHUNK]
+            slab = np.stack([
+                np.stack([self._cached_row(index, f.name, VIEW_STANDARD, s, rid)
+                          for s in shards])
+                for rid in chunk
+            ])  # [R, S, W]
+            if src_dense is not None:
+                inter = np.asarray(intersect_count(slab, src_dense[None]))  # [R, S]
+                counts = inter.sum(axis=1)
+                if tanimoto:
+                    rcounts = np.asarray(popcount(slab)).sum(axis=1)
+                    scount = int(np.asarray(popcount(src_dense)).sum())
+                    keep = 100 * counts >= tanimoto * (rcounts + scount - counts)
+                    counts = np.where(keep, counts, 0)
+            else:
+                counts = np.asarray(popcount(slab)).sum(axis=1)  # [R]
+            pairs.extend((rid, int(c)) for rid, c in zip(chunk, counts))
+        return pairs
+
+    # ------------------------------------------------------- Rows / GroupBy
+
+    def _execute_rows(self, index: Index, call: Call, shards) -> list[int]:
+        field_name = call.args.get("_field") or call.args.get("field")
+        f = index.field(field_name)
+        if f is None:
+            raise ExecutionError(f"field not found: {field_name}")
+        shards = self._query_shards(index, shards)
+        limit = call.uint_arg("limit")
+        previous = call.args.get("previous")
+        column = call.uint_arg("column")
+        view = f.view(VIEW_STANDARD)
+        out: set[int] = set()
+        if view is not None:
+            for s in shards:
+                frag = view.fragment(s)
+                if frag is None:
+                    continue
+                if column is not None and column // SHARD_WIDTH != s:
+                    continue
+                for rid in frag.row_ids():
+                    if column is not None and not frag.contains(rid, column % SHARD_WIDTH):
+                        continue
+                    out.add(rid)
+        rows = sorted(out)
+        if previous is not None:
+            rows = [r for r in rows if r > previous]
+        if limit is not None:
+            rows = rows[:limit]
+        return rows
+
+    def _execute_group_by(self, index: Index, call: Call, shards) -> list[dict]:
+        """GroupBy(Rows(...), ..., limit=, filter=) — cross product of row
+        iterators with intersection counts (executor.go:897-1090)."""
+        shards = self._query_shards(index, shards)
+        limit = call.uint_arg("limit")
+        rows_calls = [c for c in call.children if c.name == "Rows"]
+        if not rows_calls:
+            raise ExecutionError("GroupBy requires at least one Rows() call")
+        filt_calls = [c for c in call.children if c.name != "Rows"]
+        if len(filt_calls) > 1:
+            raise ExecutionError("GroupBy supports at most one filter call")
+        filter_dense = None
+        filter_call = filt_calls[0] if filt_calls else None
+        if filter_call is not None:
+            program, slab = self._compile(index, filter_call, shards)
+            filter_dense = self.runner.row(slab, program)
+
+        # per Rows call: list of (field, row_id, dense[S, W])
+        axes = []
+        for rc in rows_calls:
+            fname = rc.args.get("_field") or rc.args.get("field")
+            f = index.field(fname)
+            if f is None:
+                raise ExecutionError(f"field not found: {fname}")
+            row_ids = self._execute_rows(index, rc, shards)
+            slabs = [
+                np.stack([self._cached_row(index, fname, VIEW_STANDARD, s, rid)
+                          for s in shards])
+                for rid in row_ids
+            ]
+            axes.append([(fname, rid, slab) for rid, slab in zip(row_ids, slabs)])
+
+        from pilosa_tpu.ops.bitvector import popcount
+        results = []
+
+        def recurse(i: int, acc: Optional[np.ndarray], group):
+            if limit is not None and len(results) >= limit:
+                return
+            if i == len(axes):
+                dense = acc if filter_dense is None else acc & filter_dense
+                count = int(np.asarray(popcount(dense)).sum())
+                if count > 0:
+                    results.append({
+                        "group": [{"field": fn, "rowID": rid} for fn, rid in group],
+                        "count": count,
+                    })
+                return
+            for fname, rid, slab in axes[i]:
+                nxt = slab if acc is None else acc & slab
+                # prune empty prefixes (groupByIterator early-exit)
+                if acc is not None and not nxt.any():
+                    continue
+                recurse(i + 1, nxt, group + [(fname, rid)])
+
+        recurse(0, None, [])
+        return results
+
+    # -------------------------------------------------------------- writes
+
+    def _translate_col(self, index: Index, value):
+        if isinstance(value, str):
+            if self.translator is None:
+                raise ExecutionError("string keys require a translator")
+            return self.translator.translate_column(index.name, value)
+        return int(value)
+
+    def _translate_row(self, index: Index, f, value):
+        if isinstance(value, bool):
+            return 1 if value else 0
+        if isinstance(value, str):
+            if self.translator is None:
+                raise ExecutionError("string keys require a translator")
+            return self.translator.translate_row(index.name, f.name, value)
+        return int(value)
+
+    def _execute_set(self, index: Index, call: Call, shards) -> bool:
+        col = self._translate_col(index, call.args["_col"])
+        field_name = call.field_arg()
+        f = index.field(field_name)
+        if f is None:
+            raise ExecutionError(f"field not found: {field_name}")
+        if f.options.type == FieldType.INT:
+            changed = f.set_value(col, int(call.args[field_name]))
+        else:
+            row_id = self._translate_row(index, f, call.args[field_name])
+            ts = call.args.get("_timestamp")
+            changed = f.set_bit(row_id, col, timestamp=ts)
+        index.mark_exists(col)
+        return changed
+
+    def _execute_clear(self, index: Index, call: Call, shards) -> bool:
+        col = self._translate_col(index, call.args["_col"])
+        field_name = call.field_arg()
+        f = index.field(field_name)
+        if f is None:
+            raise ExecutionError(f"field not found: {field_name}")
+        if f.options.type == FieldType.INT:
+            return f.clear_value(col)
+        row_id = self._translate_row(index, f, call.args[field_name])
+        return f.clear_bit(row_id, col)
+
+    def _execute_clear_row(self, index: Index, call: Call, shards) -> bool:
+        field_name = call.field_arg()
+        f = index.field(field_name)
+        if f is None:
+            raise ExecutionError(f"field not found: {field_name}")
+        row_id = self._translate_row(index, f, call.args[field_name])
+        changed = False
+        for v in f.views.values():
+            if v.name.startswith("bsig_"):
+                continue
+            for s in list(v.fragments):
+                changed |= v.fragments[s].clear_row(row_id) > 0
+        return changed
+
+    def _execute_store(self, index: Index, call: Call, shards) -> bool:
+        """Store(bitmap, f=row): overwrite row with computed bitmap
+        (executeSetRow, executor.go:2050-2140)."""
+        field_name = call.field_arg()
+        f = index.field(field_name)
+        if f is None:
+            f = index.create_field(field_name)
+        row_id = self._translate_row(index, f, call.args[field_name])
+        row = self._execute_bitmap_call(index, call.children[0], shards)
+        view = f.create_view_if_not_exists(VIEW_STANDARD)
+        qshards = self._query_shards(index, shards)
+        for s in qshards:
+            frag = view.create_fragment_if_not_exists(s)
+            seg = row.segments.get(s)
+            cols = (np.asarray(seg, dtype=np.uint64) % SHARD_WIDTH) if seg is not None else np.empty(0, dtype=np.uint64)
+            frag.set_row(row_id, cols)
+            view.refresh_rank_cache(s)
+            f.add_available_shard(s)
+        return True
+
+    def _execute_set_row_attrs(self, index: Index, call: Call, shards) -> None:
+        f = index.field(call.args["_field"])
+        if f is None:
+            raise ExecutionError(f"field not found: {call.args['_field']}")
+        row_id = self._translate_row(index, f, call.args["_row"])
+        attrs = {k: v for k, v in call.args.items() if not k.startswith("_")}
+        f.row_attrs.set_attrs(row_id, attrs)
+
+    def _execute_set_column_attrs(self, index: Index, call: Call, shards) -> None:
+        col = self._translate_col(index, call.args["_col"])
+        attrs = {k: v for k, v in call.args.items() if not k.startswith("_")}
+        index.column_attrs.set_attrs(col, attrs)
+
+    # -------------------------------------------------------------- options
+
+    def _execute_options(self, index: Index, call: Call, shards):
+        if len(call.children) != 1:
+            raise ExecutionError("Options() takes exactly one query argument")
+        if call.args.get("shards") is not None:
+            shards = [int(s) for s in call.uint_slice_arg("shards")]
+        result = self._execute_call(index, call.children[0], shards)
+        if call.bool_arg("excludeColumns") and isinstance(result, Row):
+            result = Row()
+        return result

@@ -1,0 +1,12 @@
+"""Data model: the schema tree Holder -> Index -> Field -> View -> Fragment.
+
+Mirrors the reference's domain objects (holder.go, index.go, field.go,
+view.go, row.go) with the TPU split: this layer is host-side metadata +
+storage routing; all query compute flows through the executor's device
+kernels over dense row materializations.
+"""
+
+from pilosa_tpu.models.field import Field, FieldOptions, FieldType  # noqa: F401
+from pilosa_tpu.models.holder import Holder  # noqa: F401
+from pilosa_tpu.models.index import Index  # noqa: F401
+from pilosa_tpu.models.row import Row  # noqa: F401

@@ -1,0 +1,104 @@
+"""TopN row caches: candidate-row tracking per fragment.
+
+Reference: cache.go — rankCache (threshold-buffered re-rank, default for set
+fields), lruCache, nopCache; persisted per-fragment and used by TopN to avoid
+full row scans (fragment.go:1018-1150).
+
+TPU redesign: exact counts are cheap on device (one fused popcount pass over
+a stacked slab), so the cache's only job is *candidate selection* — bounding
+how many rows get materialized into the TopN slab when a field has millions
+of rows. It tracks approximate per-row counts host-side; TopN re-ranks
+exactly on device (matching the reference's two-phase exact recount,
+executor.go:694-761).
+"""
+
+from __future__ import annotations
+
+import heapq
+import json
+import os
+from typing import Iterable
+
+CACHE_TYPE_RANKED = "ranked"
+CACHE_TYPE_LRU = "lru"
+CACHE_TYPE_NONE = "none"
+
+# re-rank when the buffer grows past cache_size * this factor
+# (cache.go thresholdFactor semantics)
+THRESHOLD_FACTOR = 1.5
+
+
+class RankCache:
+    """Tracks per-row approximate counts; prunes to cache_size by rank.
+
+    Used for both "ranked" and "lru" cache types — LRU eviction differs in
+    the reference (cache.go:58-130) but its observable role in queries is the
+    same: a bounded candidate set for TopN.
+    """
+
+    def __init__(self, cache_size: int = 50000):
+        self.cache_size = cache_size
+        self.counts: dict[int, int] = {}
+
+    def add(self, row_id: int, count: int) -> None:
+        if count <= 0:
+            self.counts.pop(row_id, None)
+            return
+        self.counts[row_id] = count
+        if len(self.counts) > self.cache_size * THRESHOLD_FACTOR:
+            self.invalidate()
+
+    def bulk_add(self, pairs: Iterable[tuple[int, int]]) -> None:
+        for row_id, count in pairs:
+            if count > 0:
+                self.counts[row_id] = count
+        if len(self.counts) > self.cache_size * THRESHOLD_FACTOR:
+            self.invalidate()
+
+    def invalidate(self) -> None:
+        """Prune to the top cache_size rows by count."""
+        if len(self.counts) <= self.cache_size:
+            return
+        top = heapq.nlargest(self.cache_size, self.counts.items(), key=lambda kv: kv[1])
+        self.counts = dict(top)
+
+    def top(self, n: int | None = None) -> list[tuple[int, int]]:
+        """(row_id, count) pairs sorted by count desc, id asc (Pairs order,
+        cache.go:317-397)."""
+        items = sorted(self.counts.items(), key=lambda kv: (-kv[1], kv[0]))
+        return items[:n] if n is not None else items
+
+    def ids(self) -> list[int]:
+        return sorted(self.counts)
+
+    def __len__(self) -> int:
+        return len(self.counts)
+
+    # -- persistence (fragment .cache file, fragment.go:1790-1821; JSON here
+    # instead of protobuf — the cache is node-local and rebuildable) --------
+
+    def save(self, path: str) -> None:
+        tmp = path + ".tmp"
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(tmp, "w") as f:
+            json.dump({"cacheSize": self.cache_size,
+                       "counts": {str(k): v for k, v in self.counts.items()}}, f)
+        os.replace(tmp, path)
+
+    @classmethod
+    def load(cls, path: str) -> "RankCache":
+        with open(path) as f:
+            data = json.load(f)
+        c = cls(data.get("cacheSize", 50000))
+        c.counts = {int(k): v for k, v in data.get("counts", {}).items()}
+        return c
+
+
+def merge_pairs(lists: Iterable[list[tuple[int, int]]]) -> list[tuple[int, int]]:
+    """Sum counts by row id across per-shard pair lists, sort by count desc —
+    the distributed TopN reduce (Pairs.Add, cache.go:317-397)."""
+    acc: dict[int, int] = {}
+    for pairs in lists:
+        for row_id, count in pairs:
+            acc[row_id] = acc.get(row_id, 0) + count
+    return sorted(acc.items(), key=lambda kv: (-kv[1], kv[0]))

@@ -1,0 +1,67 @@
+"""Holder: the root container of all indexes on a node.
+
+Reference: holder.go — open/close directory walk (holder.go:132-192), schema
+(holder.go:267), create/delete index. The TPU build keeps the same on-disk
+tree: <data_dir>/<index>/<field>/views/<view>/fragments/<shard>.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+from typing import Optional
+
+from pilosa_tpu.models.index import Index, validate_name
+
+
+class Holder:
+    def __init__(self, path: str):
+        self.path = path
+        self.indexes: dict[str, Index] = {}
+        self.opened = False
+
+    def open(self) -> "Holder":
+        os.makedirs(self.path, exist_ok=True)
+        for name in sorted(os.listdir(self.path)):
+            ipath = os.path.join(self.path, name)
+            if os.path.isdir(ipath) and not name.startswith("."):
+                self.indexes[name] = Index(ipath, name).open()
+        self.opened = True
+        return self
+
+    def close(self) -> None:
+        for idx in self.indexes.values():
+            idx.close()
+        self.indexes.clear()
+        self.opened = False
+
+    def index(self, name: str) -> Optional[Index]:
+        return self.indexes.get(name)
+
+    def create_index(self, name: str, keys: bool = False,
+                     track_existence: bool = True) -> Index:
+        validate_name(name)
+        if name in self.indexes:
+            raise ValueError(f"index already exists: {name}")
+        idx = Index(os.path.join(self.path, name), name, keys=keys,
+                    track_existence=track_existence)
+        idx.save_meta()
+        idx.open()
+        self.indexes[name] = idx
+        return idx
+
+    def create_index_if_not_exists(self, name: str, **kw) -> Index:
+        existing = self.indexes.get(name)
+        if existing is not None:
+            return existing
+        return self.create_index(name, **kw)
+
+    def delete_index(self, name: str) -> None:
+        idx = self.indexes.pop(name, None)
+        if idx is None:
+            raise KeyError(f"index not found: {name}")
+        idx.close()
+        shutil.rmtree(idx.path, ignore_errors=True)
+
+    def schema(self) -> list[dict]:
+        return [idx.schema_dict() for _, idx in sorted(self.indexes.items())]

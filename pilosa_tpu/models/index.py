@@ -1,0 +1,155 @@
+"""Index: a namespace of fields sharing one column space.
+
+Reference: index.go — owns fields, the existence field `_exists`
+(index.go:167-175; used by Not() and existence-aware Count), meta persistence
+(index.go:177-218) and AvailableShards = union of field shard bitmaps
+(index.go:238).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import shutil
+from typing import Optional
+
+from pilosa_tpu.constants import EXISTENCE_FIELD_NAME
+from pilosa_tpu.models.field import Field, FieldOptions, FieldType
+from pilosa_tpu.storage.roaring import Bitmap
+
+_NAME_RE = re.compile(r"^[a-z][a-z0-9_-]{0,63}$")
+
+
+def validate_name(name: str) -> None:
+    """Index/field naming rule (pilosa.go validateName)."""
+    if not _NAME_RE.match(name):
+        raise ValueError(f"invalid name: {name!r}")
+
+
+class Index:
+    def __init__(self, path: str, name: str, keys: bool = False,
+                 track_existence: bool = True):
+        validate_name(name)
+        self.path = path
+        self.name = name
+        self.keys = keys
+        self.track_existence = track_existence
+        self.fields: dict[str, Field] = {}
+        # column attr store (reference: index.go ColumnAttrStore)
+        from pilosa_tpu.utils.attrstore import AttrStore
+        self.column_attrs = AttrStore(os.path.join(self.path, ".col_attrs.db"))
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def open(self) -> "Index":
+        os.makedirs(self.path, exist_ok=True)
+        self.column_attrs.open()
+        meta = os.path.join(self.path, ".meta")
+        if os.path.exists(meta):
+            with open(meta) as f:
+                data = json.load(f)
+            self.keys = data.get("keys", False)
+            self.track_existence = data.get("trackExistence", True)
+        else:
+            self.save_meta()
+        for fname in sorted(os.listdir(self.path)):
+            fpath = os.path.join(self.path, fname)
+            if os.path.isdir(fpath):
+                self.fields[fname] = Field(fpath, self.name, fname).open()
+        if self.track_existence and EXISTENCE_FIELD_NAME not in self.fields:
+            self._create_existence_field()
+        return self
+
+    def close(self) -> None:
+        for f in self.fields.values():
+            f.close()
+        self.fields.clear()
+        self.column_attrs.close()
+
+    def save_meta(self) -> None:
+        os.makedirs(self.path, exist_ok=True)
+        with open(os.path.join(self.path, ".meta"), "w") as f:
+            json.dump({"keys": self.keys, "trackExistence": self.track_existence}, f)
+
+    def _create_existence_field(self) -> Field:
+        opts = FieldOptions(type=FieldType.SET, cache_type="none")
+        f = Field(os.path.join(self.path, EXISTENCE_FIELD_NAME),
+                  self.name, EXISTENCE_FIELD_NAME, opts)
+        f.open()
+        self.fields[EXISTENCE_FIELD_NAME] = f
+        return f
+
+    # -- fields -------------------------------------------------------------
+
+    def field(self, name: str) -> Optional[Field]:
+        return self.fields.get(name)
+
+    def existence_field(self) -> Optional[Field]:
+        return self.fields.get(EXISTENCE_FIELD_NAME)
+
+    def create_field(self, name: str, options: Optional[FieldOptions] = None) -> Field:
+        validate_name(name)
+        if name in self.fields:
+            raise ValueError(f"field already exists: {name}")
+        options = options or FieldOptions()
+        options.validate()
+        f = Field(os.path.join(self.path, name), self.name, name, options)
+        f.save_meta()
+        f.open()
+        self.fields[name] = f
+        return f
+
+    def create_field_if_not_exists(self, name: str,
+                                   options: Optional[FieldOptions] = None) -> Field:
+        existing = self.fields.get(name)
+        if existing is not None:
+            return existing
+        return self.create_field(name, options)
+
+    def delete_field(self, name: str) -> None:
+        f = self.fields.pop(name, None)
+        if f is None:
+            raise KeyError(f"field not found: {name}")
+        f.close()
+        shutil.rmtree(f.path, ignore_errors=True)
+
+    # -- shards -------------------------------------------------------------
+
+    def available_shards(self) -> Bitmap:
+        """Union of per-field shard bitmaps (index.go:238)."""
+        out = Bitmap()
+        for f in self.fields.values():
+            out = out.union(f.available_shards)
+        if not out.any():
+            out.add(0)  # queries always cover at least shard 0
+        return out
+
+    # -- existence tracking (writes mark columns live; Not()/existence
+    #    queries read it — index.go:167, executor.go:1478) ------------------
+
+    def mark_exists(self, column: int) -> None:
+        if not self.track_existence:
+            return
+        ef = self.existence_field()
+        if ef is not None:
+            ef.set_bit(0, column)
+
+    def schema_dict(self) -> dict:
+        return {
+            "name": self.name,
+            "options": {"keys": self.keys, "trackExistence": self.track_existence},
+            "fields": [
+                {"name": f.name, "options": {
+                    "type": f.options.type,
+                    "cacheType": f.options.cache_type,
+                    "cacheSize": f.options.cache_size,
+                    "min": f.options.min,
+                    "max": f.options.max,
+                    "timeQuantum": f.options.time_quantum,
+                    "keys": f.options.keys,
+                }}
+                for name, f in sorted(self.fields.items())
+                if name != EXISTENCE_FIELD_NAME
+            ],
+        }

@@ -1,0 +1,128 @@
+"""Row: the executor's bitmap result value.
+
+Reference: row.go — a Row is a list of per-shard segments in *global column
+space*, merged lazily so no op ever materializes the full row x column matrix
+(row.go:26, rowSegment row.go:257). Here a segment is a sorted uint64 numpy
+array of global columns; set algebra is numpy per-shard — this type carries
+*results* between host reduce steps, while heavy compute stays on device as
+dense bitvectors (the executor converts device outputs into Rows only at the
+reduce/serialization boundary).
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Optional
+
+import numpy as np
+
+from pilosa_tpu.constants import SHARD_WIDTH
+
+
+class Row:
+    """Distributed bitmap result: {shard -> sorted uint64 global columns}."""
+
+    __slots__ = ("segments", "attrs", "keys")
+
+    def __init__(self, columns: Optional[np.ndarray] = None):
+        self.segments: dict[int, np.ndarray] = {}
+        self.attrs: dict = {}
+        self.keys: list[str] = []
+        if columns is not None and len(columns):
+            cols = np.unique(np.asarray(columns, dtype=np.uint64))
+            shards = (cols // np.uint64(SHARD_WIDTH)).astype(np.int64)
+            bounds = np.flatnonzero(np.diff(shards)) + 1
+            for chunk in np.split(cols, bounds):
+                self.segments[int(chunk[0]) // SHARD_WIDTH] = chunk
+
+    @classmethod
+    def from_segment(cls, shard: int, columns: np.ndarray) -> "Row":
+        r = cls()
+        cols = np.asarray(columns, dtype=np.uint64)
+        if cols.size:
+            r.segments[shard] = cols
+        return r
+
+    # -- algebra (row.go:85-171; segment ops row.go:254-423) ----------------
+
+    def _merge(self, other: "Row", op) -> "Row":
+        out = Row()
+        for shard in sorted(set(self.segments) | set(other.segments)):
+            a = self.segments.get(shard, np.empty(0, dtype=np.uint64))
+            b = other.segments.get(shard, np.empty(0, dtype=np.uint64))
+            seg = op(a, b)
+            if seg.size:
+                out.segments[shard] = seg.astype(np.uint64)
+        return out
+
+    def intersect(self, other: "Row") -> "Row":
+        return self._merge(other, lambda a, b: np.intersect1d(a, b, assume_unique=True))
+
+    def union(self, other: "Row") -> "Row":
+        return self._merge(other, np.union1d)
+
+    def difference(self, other: "Row") -> "Row":
+        return self._merge(other, lambda a, b: np.setdiff1d(a, b, assume_unique=True))
+
+    def xor(self, other: "Row") -> "Row":
+        return self._merge(other, lambda a, b: np.setxor1d(a, b, assume_unique=True))
+
+    def merge(self, other: "Row") -> "Row":
+        """Shard-wise merge for map-reduce: other's segments override/extend
+        (Row.Merge, row.go:130 — used as the mapReduce reduce fn)."""
+        out = Row()
+        out.segments = dict(self.segments)
+        for shard, seg in other.segments.items():
+            if shard in out.segments:
+                out.segments[shard] = np.union1d(out.segments[shard], seg)
+            else:
+                out.segments[shard] = seg
+        out.attrs = {**self.attrs, **other.attrs}
+        return out
+
+    def intersection_count(self, other: "Row") -> int:
+        total = 0
+        for shard in set(self.segments) & set(other.segments):
+            total += int(np.intersect1d(
+                self.segments[shard], other.segments[shard], assume_unique=True).size)
+        return total
+
+    # -- accessors ----------------------------------------------------------
+
+    def count(self) -> int:
+        return sum(int(s.size) for s in self.segments.values())
+
+    def columns(self) -> np.ndarray:
+        if not self.segments:
+            return np.empty(0, dtype=np.uint64)
+        return np.concatenate([self.segments[s] for s in sorted(self.segments)])
+
+    def shards(self) -> list[int]:
+        return sorted(self.segments)
+
+    def any(self) -> bool:
+        return any(s.size for s in self.segments.values())
+
+    def includes(self, col: int) -> bool:
+        seg = self.segments.get(col // SHARD_WIDTH)
+        if seg is None:
+            return False
+        i = np.searchsorted(seg, np.uint64(col))
+        return i < seg.size and seg[i] == np.uint64(col)
+
+    def to_json_dict(self) -> dict:
+        d = {"columns": self.columns().tolist()}
+        if self.attrs:
+            d["attrs"] = self.attrs
+        if self.keys:
+            d["keys"] = self.keys
+        return d
+
+    def __eq__(self, other) -> bool:
+        if not isinstance(other, Row):
+            return NotImplemented
+        return self.shards() == other.shards() and all(
+            np.array_equal(self.segments[s], other.segments[s]) for s in self.segments
+        )
+
+    def __repr__(self) -> str:
+        return f"<Row count={self.count()} shards={self.shards()}>"

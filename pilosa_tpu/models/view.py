@@ -1,0 +1,127 @@
+"""View: a named sub-bitmap of a field.
+
+Reference: view.go — "standard" (view.go:34), time views "standard_YYYYMMDDHH"
+(time.go:63-215) and BSI views "bsig_<field>" (view.go:36); a view owns
+fragments by shard and creates them on demand (view.go:208-263).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from pilosa_tpu.constants import SHARD_WIDTH
+from pilosa_tpu.models.cache import RankCache
+from pilosa_tpu.storage.fragment import Fragment
+
+VIEW_STANDARD = "standard"
+VIEW_BSI_PREFIX = "bsig_"
+
+
+def view_path(field_path: str, name: str) -> str:
+    return os.path.join(field_path, "views", name)
+
+
+class View:
+    def __init__(self, path: str, index: str, field: str, name: str,
+                 track_rank: bool = False, cache_size: int = 50000):
+        self.path = path
+        self.index = index
+        self.field = field
+        self.name = name
+        self.fragments: dict[int, Fragment] = {}
+        self.track_rank = track_rank
+        self.cache_size = cache_size
+        self.rank_caches: dict[int, RankCache] = {}
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def open(self) -> "View":
+        frag_dir = os.path.join(self.path, "fragments")
+        if os.path.isdir(frag_dir):
+            for fname in os.listdir(frag_dir):
+                if fname.endswith(".cache") or fname.endswith(".snapshotting") or fname.endswith(".tmp"):
+                    continue
+                try:
+                    shard = int(fname)
+                except ValueError:
+                    continue
+                self._open_fragment(shard)
+        return self
+
+    def close(self) -> None:
+        for shard, frag in self.fragments.items():
+            cache = self.rank_caches.get(shard)
+            if cache is not None:
+                cache.save(frag.path + ".cache")
+            frag.close()
+        self.fragments.clear()
+        self.rank_caches.clear()
+
+    def _open_fragment(self, shard: int) -> Fragment:
+        frag = Fragment(
+            os.path.join(self.path, "fragments", str(shard)),
+            self.index, self.field, self.name, shard,
+        ).open()
+        self.fragments[shard] = frag
+        if self.track_rank:
+            cache_path = frag.path + ".cache"
+            if os.path.exists(cache_path):
+                self.rank_caches[shard] = RankCache.load(cache_path)
+            else:
+                cache = RankCache(self.cache_size)
+                cache.bulk_add((rid, frag.row_count(rid)) for rid in frag.row_ids())
+                self.rank_caches[shard] = cache
+        return frag
+
+    # -- fragment routing ---------------------------------------------------
+
+    def fragment(self, shard: int) -> Optional[Fragment]:
+        return self.fragments.get(shard)
+
+    def create_fragment_if_not_exists(self, shard: int) -> Fragment:
+        frag = self.fragments.get(shard)
+        if frag is None:
+            frag = self._open_fragment(shard)
+        return frag
+
+    def shards(self) -> list[int]:
+        return sorted(self.fragments)
+
+    # -- writes (global column space; view.setBit view.go:309) --------------
+
+    def set_bit(self, row_id: int, column: int) -> bool:
+        shard = column // SHARD_WIDTH
+        frag = self.create_fragment_if_not_exists(shard)
+        changed = frag.set_bit(row_id, column % SHARD_WIDTH)
+        if changed:
+            self._update_rank(shard, frag, row_id)
+        return changed
+
+    def clear_bit(self, row_id: int, column: int) -> bool:
+        shard = column // SHARD_WIDTH
+        frag = self.fragments.get(shard)
+        if frag is None:
+            return False
+        changed = frag.clear_bit(row_id, column % SHARD_WIDTH)
+        if changed:
+            self._update_rank(shard, frag, row_id)
+        return changed
+
+    def _update_rank(self, shard: int, frag: Fragment, row_id: int) -> None:
+        cache = self.rank_caches.get(shard)
+        if cache is not None:
+            # row_count walks at most 16 container keys — cheap enough to
+            # keep cached counts exact (the reference recounts via rowCache,
+            # fragment.go:435-440)
+            cache.add(row_id, frag.row_count(row_id))
+
+    def refresh_rank_cache(self, shard: int) -> None:
+        if not self.track_rank:
+            return
+        frag = self.fragments.get(shard)
+        if frag is None:
+            return
+        cache = RankCache(self.cache_size)
+        cache.bulk_add((rid, frag.row_count(rid)) for rid in frag.row_ids())
+        self.rank_caches[shard] = cache

@@ -1,0 +1,12 @@
+"""Parallel execution: device meshes, shard placement, cluster runtime.
+
+The reference distributes per-shard work with a goroutine-per-shard fan-out
+and HTTP scatter-gather between nodes (executor.go:2183-2321). Here the
+data-plane fan-out is a sharded XLA computation over a `jax.sharding.Mesh`:
+shard slabs live sharded over the mesh's "shard" axis, GSPMD partitions the
+bitwise/popcount program, and cross-shard reductions ride ICI collectives
+that XLA inserts for the final `sum`. The host-side control plane (placement,
+membership, replication, resize) mirrors the reference's cluster.go.
+"""
+
+from pilosa_tpu.parallel.mesh import DeviceRunner, make_mesh  # noqa: F401

@@ -1,0 +1,12 @@
+"""PQL: the Pilosa query language.
+
+Behavior-compatible with the reference grammar (pql/pql.peg) and AST
+(pql/ast.go) — special-form calls (Set/SetRowAttrs/SetColumnAttrs/Clear/
+ClearRow/Store/TopN/Range), generic nested calls, conditions (= == != < <=
+> >= ><), int-range conditionals (a < field < b), lists, quoted strings and
+timestamps — implemented as a hand-written recursive-descent parser instead
+of a generated PEG parser.
+"""
+
+from pilosa_tpu.pql.ast import Call, Condition, Query  # noqa: F401
+from pilosa_tpu.pql.parser import PQLError, parse_string  # noqa: F401

@@ -1,0 +1,120 @@
+"""PQL AST: Query / Call / Condition (reference: pql/ast.go:27,247,451)."""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+# Condition ops (reference: pql/token.go)
+ASSIGN, EQ, NEQ, LT, LTE, GT, GTE, BETWEEN = "=", "==", "!=", "<", "<=", ">", ">=", "><"
+
+
+class Condition:
+    __slots__ = ("op", "value")
+
+    def __init__(self, op: str, value: Any):
+        self.op = op
+        self.value = value
+
+    def int_slice_value(self) -> list[int]:
+        """cond.Value as ints (Condition.IntSliceValue, pql/ast.go:464)."""
+        if not isinstance(self.value, (list, tuple)):
+            raise ValueError(f"unexpected type {type(self.value).__name__} in IntSliceValue")
+        out = []
+        for v in self.value:
+            if isinstance(v, bool) or not isinstance(v, int):
+                raise ValueError(f"unexpected value type in IntSliceValue: {v!r}")
+            out.append(v)
+        return out
+
+    def __eq__(self, other):
+        return isinstance(other, Condition) and (self.op, self.value) == (other.op, other.value)
+
+    def __repr__(self):
+        return f"Condition({self.op!r}, {self.value!r})"
+
+
+class Call:
+    __slots__ = ("name", "args", "children")
+
+    def __init__(self, name: str, args: Optional[dict] = None,
+                 children: Optional[list["Call"]] = None):
+        self.name = name
+        self.args = args or {}
+        self.children = children or []
+
+    # -- typed arg getters (pql/ast.go:269-360) -----------------------------
+
+    def field_arg(self) -> str:
+        """The single field=row argument of write calls (FieldArg,
+        pql/ast.go:256)."""
+        for k, v in self.args.items():
+            if not k.startswith("_") and not isinstance(v, Condition):
+                return k
+        raise ValueError(f"{self.name} expects a field argument")
+
+    def uint_arg(self, key: str):
+        v = self.args.get(key)
+        if v is None:
+            return None
+        if isinstance(v, bool) or not isinstance(v, int) or v < 0:
+            raise ValueError(f"arg {key!r} must be a non-negative integer, got {v!r}")
+        return v
+
+    def int_arg(self, key: str):
+        v = self.args.get(key)
+        if v is None:
+            return None
+        if isinstance(v, bool) or not isinstance(v, int):
+            raise ValueError(f"arg {key!r} must be an integer, got {v!r}")
+        return v
+
+    def bool_arg(self, key: str):
+        v = self.args.get(key)
+        if v is None:
+            return None
+        if not isinstance(v, bool):
+            raise ValueError(f"arg {key!r} must be a bool, got {v!r}")
+        return v
+
+    def string_arg(self, key: str):
+        v = self.args.get(key)
+        if v is None:
+            return None
+        if not isinstance(v, str):
+            raise ValueError(f"arg {key!r} must be a string, got {v!r}")
+        return v
+
+    def uint_slice_arg(self, key: str):
+        v = self.args.get(key)
+        if v is None:
+            return None
+        if isinstance(v, int) and not isinstance(v, bool):
+            return [v]
+        if isinstance(v, list) and all(isinstance(x, int) and not isinstance(x, bool) for x in v):
+            return list(v)
+        raise ValueError(f"arg {key!r} must be a list of integers, got {v!r}")
+
+    def __eq__(self, other):
+        return (isinstance(other, Call)
+                and (self.name, self.args, self.children)
+                == (other.name, other.args, other.children))
+
+    def __repr__(self):
+        parts = [repr(c) for c in self.children]
+        parts += [f"{k}={v!r}" for k, v in self.args.items()]
+        return f"{self.name}({', '.join(parts)})"
+
+
+class Query:
+    __slots__ = ("calls",)
+
+    def __init__(self, calls: Optional[list[Call]] = None):
+        self.calls = calls or []
+
+    def write_call_count(self) -> int:
+        """Number of mutating calls (WriteCallN, pql/ast.go:219)."""
+        writes = {"Set", "Clear", "ClearRow", "Store", "SetRowAttrs", "SetColumnAttrs"}
+        return sum(1 for c in self.calls if c.name in writes)
+
+    def __repr__(self):
+        return f"Query({self.calls!r})"

@@ -1,0 +1,367 @@
+"""Fragment: the (index, field, view, shard) storage unit.
+
+Mirrors the reference fragment's responsibilities (fragment.go:87-134):
+one roaring file + op-log WAL + snapshot compaction + row materialization +
+anti-entropy block checksums — but split cleanly into a *host-side
+authoritative store* (this module) and a *device query cache* (the executor's
+HBM residency layer). Mutation never touches the device: random single-bit
+writes are the wrong shape for XLA, so writes go to the host bitmap + WAL
+(reference: fragment.go:382-497 setBit path) and invalidate row generations;
+the executor re-materializes dirty rows on demand, exactly as the reference's
+rowCache is invalidated on writes (fragment.go:435-440).
+
+Storage lifecycle (reference: fragment.go:190-247 openStorage):
+  open -> parse snapshot+op-log file -> attach op-log appender ->
+  after MAX_OP_N ops, snapshot() rewrites the file atomically
+  (fragment.go:1707-1781 via a .snapshotting temp file).
+
+Row r of the shard occupies absolute bit positions [r*2^20, (r+1)*2^20)
+(pos(), fragment.go:2420-2424).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import io
+import os
+import struct
+import tarfile
+from typing import Iterable, Optional
+
+import numpy as np
+
+from pilosa_tpu.constants import (
+    HASH_BLOCK_SIZE,
+    MAX_OP_N,
+    SHARD_WIDTH,
+)
+from pilosa_tpu.storage.roaring import Bitmap
+
+SNAPSHOT_EXT = ".snapshotting"
+CACHE_EXT = ".cache"
+
+
+def pos(row_id: int, column: int) -> int:
+    """Absolute bit position of (row, column-within-shard)."""
+    return row_id * SHARD_WIDTH + (column % SHARD_WIDTH)
+
+
+class Fragment:
+    """Host-authoritative storage for one shard of one view of one field."""
+
+    def __init__(self, path: str, index: str, field: str, view: str, shard: int):
+        self.path = path
+        self.index = index
+        self.field = field
+        self.view = view
+        self.shard = shard
+        self.storage = Bitmap()
+        self.op_n = 0
+        self._op_file = None
+        self.closed = True
+        # Row generations: bumped on any mutation touching the row; the
+        # device cache keys on (fragment key, row, generation) — the analog
+        # of the reference's rowCache invalidation (fragment.go:435).
+        self.generation = 0
+        self._row_gen: dict[int, int] = {}
+        # Cached block checksums, invalidated per-block on writes
+        # (fragment.go:1226-1305).
+        self._block_checksums: dict[int, bytes] = {}
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def open(self) -> "Fragment":
+        os.makedirs(os.path.dirname(self.path), exist_ok=True)
+        data = b""
+        if os.path.exists(self.path):
+            with open(self.path, "rb") as f:
+                data = f.read()
+        if data:
+            self.storage = Bitmap.from_bytes(data)
+            self.op_n = self.storage.op_n
+        else:
+            # Seed an empty snapshot header so the WAL has something to
+            # append to (openStorage marshals the empty bitmap into a fresh
+            # file, fragment.go:190-247).
+            with open(self.path, "wb") as f:
+                self.storage.write_to(f)
+        self._op_file = open(self.path, "ab")
+        self.storage.op_writer = self._op_file
+        self.closed = False
+        return self
+
+    def close(self) -> None:
+        if self._op_file is not None:
+            self._op_file.flush()
+            self._op_file.close()
+            self._op_file = None
+        self.storage.op_writer = None
+        self.closed = True
+
+    # -- mutation -----------------------------------------------------------
+
+    def _touch(self, row_id: int) -> None:
+        self.generation += 1
+        self._row_gen[row_id] = self.generation
+        self._block_checksums.pop(row_id // HASH_BLOCK_SIZE, None)
+
+    def row_generation(self, row_id: int) -> int:
+        return self._row_gen.get(row_id, 0)
+
+    def set_bit(self, row_id: int, column: int) -> bool:
+        """Set one bit; appends to the WAL and snapshots at MAX_OP_N
+        (fragment.go:382-433 setBit + incrementOpN)."""
+        changed = self.storage.add(pos(row_id, column))
+        if changed:
+            self._touch(row_id)
+        self._increment_op_n()
+        return changed
+
+    def clear_bit(self, row_id: int, column: int) -> bool:
+        changed = self.storage.remove(pos(row_id, column))
+        if changed:
+            self._touch(row_id)
+        self._increment_op_n()
+        return changed
+
+    def contains(self, row_id: int, column: int) -> bool:
+        return self.storage.contains(pos(row_id, column))
+
+    def _increment_op_n(self) -> None:
+        self.op_n += 1
+        if self.op_n > MAX_OP_N:
+            self.snapshot()
+
+    def set_row(self, row_id: int, columns: np.ndarray) -> None:
+        """Whole-row replace (setRow, fragment.go:501-586). Bulk path: no WAL,
+        snapshot responsibility is the caller's (bulk import batches rows)."""
+        base = row_id * SHARD_WIDTH
+        self.storage.remove_many(self.storage.slice(base, base + SHARD_WIDTH))
+        cols = np.asarray(columns, dtype=np.uint64) % SHARD_WIDTH + np.uint64(base)
+        self.storage.add_many(cols)
+        self._touch(row_id)
+
+    def clear_row(self, row_id: int) -> int:
+        base = row_id * SHARD_WIDTH
+        vals = self.storage.slice(base, base + SHARD_WIDTH)
+        self.storage.remove_many(vals)
+        if vals.size:
+            self._touch(row_id)
+        return int(vals.size)
+
+    # -- BSI value mutation (fragment.go:597-660) ---------------------------
+
+    def set_value(self, column: int, bit_depth: int, value: int) -> bool:
+        """Write a BSI value: rows 0..bit_depth-1 are place values, row
+        bit_depth is the not-null row (fragment.go:597-618,630)."""
+        changed = False
+        for i in range(bit_depth):
+            if (value >> i) & 1:
+                changed |= self.set_bit(i, column)
+            else:
+                changed |= self.clear_bit(i, column)
+        changed |= self.set_bit(bit_depth, column)
+        return changed
+
+    def clear_value(self, column: int, bit_depth: int) -> bool:
+        changed = False
+        for i in range(bit_depth + 1):
+            changed |= self.clear_bit(i, column)
+        return changed
+
+    def value(self, column: int, bit_depth: int) -> tuple[int, bool]:
+        if not self.contains(bit_depth, column):
+            return 0, False
+        v = 0
+        for i in range(bit_depth):
+            if self.contains(i, column):
+                v |= 1 << i
+        return v, True
+
+    # -- reads --------------------------------------------------------------
+
+    def row_dense(self, row_id: int) -> np.ndarray:
+        """Materialize a row as a dense uint32 bitvector (the OffsetRange
+        slice, fragment.go:347-378 row())."""
+        base = row_id * SHARD_WIDTH
+        return self.storage.to_dense_words(base, base + SHARD_WIDTH)
+
+    def row_columns(self, row_id: int) -> np.ndarray:
+        """Set columns of a row as shard-local offsets."""
+        base = row_id * SHARD_WIDTH
+        return (self.storage.slice(base, base + SHARD_WIDTH) - np.uint64(base)).astype(np.int64)
+
+    def row_count(self, row_id: int) -> int:
+        base = row_id * SHARD_WIDTH
+        return self.storage.count_range(base, base + SHARD_WIDTH)
+
+    def max_row_id(self) -> int:
+        m = self.storage.max()
+        return 0 if m is None else m // SHARD_WIDTH
+
+    def row_ids(self, start: int = 0, limit: Optional[int] = None) -> list[int]:
+        """Distinct row ids with any set bit, ascending (rows(),
+        fragment.go:2000-2138): walks container keys, not bits."""
+        out: list[int] = []
+        rows_per_shift = SHARD_WIDTH >> 16  # container keys per row
+        last = -1
+        for key in sorted(self.storage.containers):
+            rid = key // rows_per_shift
+            if rid != last and rid >= start:
+                out.append(rid)
+                last = rid
+                if limit is not None and len(out) >= limit:
+                    break
+        return out
+
+    def bit_count(self) -> int:
+        return self.storage.count()
+
+    # -- bulk import (fragment.go:1445-1706) --------------------------------
+
+    def bulk_import(self, row_ids: Iterable[int], columns: Iterable[int]) -> None:
+        """Standard bulk set path: group by row, merge into each row, one
+        snapshot at the end (bulkImportStandard, fragment.go:1458-1533)."""
+        rows = np.asarray(list(row_ids), dtype=np.uint64)
+        cols = np.asarray(list(columns), dtype=np.uint64)
+        if rows.size != cols.size:
+            raise ValueError("row/column length mismatch")
+        positions = rows * np.uint64(SHARD_WIDTH) + cols % np.uint64(SHARD_WIDTH)
+        self.storage.add_many(positions)
+        for rid in np.unique(rows).tolist():
+            self._touch(int(rid))
+        self.snapshot()
+
+    def bulk_import_values(self, columns: Iterable[int], values: Iterable[int],
+                           bit_depth: int) -> None:
+        """BSI bulk import (importValue, fragment.go:1624-1658)."""
+        cols = np.asarray(list(columns), dtype=np.uint64) % np.uint64(SHARD_WIDTH)
+        vals = list(values)
+        if cols.size != len(vals):
+            raise ValueError("column/value length mismatch")
+        add_positions = []
+        clear_positions = []
+        for i in range(bit_depth):
+            bit_base = np.uint64(i * SHARD_WIDTH)
+            mask = np.array([(v >> i) & 1 for v in vals], dtype=bool)
+            add_positions.append(cols[mask] + bit_base)
+            clear_positions.append(cols[~mask] + bit_base)
+        add_positions.append(cols + np.uint64(bit_depth * SHARD_WIDTH))  # not-null
+        if clear_positions:
+            self.storage.remove_many(np.concatenate(clear_positions))
+        self.storage.add_many(np.concatenate(add_positions))
+        for i in range(bit_depth + 1):
+            self._touch(i)
+        self.snapshot()
+
+    def import_roaring(self, data: bytes, clear: bool = False) -> None:
+        """Union (or clear) a pre-built roaring bitmap into storage in one op
+        (importRoaring, fragment.go:1659-1706)."""
+        other = Bitmap.from_bytes(data)
+        if clear:
+            self.storage = self.storage.difference(other)
+        else:
+            self.storage = self.storage.union(other)
+        self.storage.op_writer = self._op_file
+        self.generation += 1
+        self._row_gen.clear()  # all rows considered dirty
+        self._block_checksums.clear()
+        self.snapshot()
+
+    # -- snapshot / WAL compaction (fragment.go:1707-1781) ------------------
+
+    def snapshot(self) -> None:
+        tmp = self.path + SNAPSHOT_EXT
+        os.makedirs(os.path.dirname(self.path), exist_ok=True)
+        if self._op_file is not None:
+            self._op_file.flush()
+            self._op_file.close()
+            self._op_file = None
+        with open(tmp, "wb") as f:
+            self.storage.write_to(f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.path)
+        self.op_n = 0
+        self.storage.op_n = 0
+        if not self.closed:
+            self._op_file = open(self.path, "ab")
+            self.storage.op_writer = self._op_file
+
+    # -- anti-entropy block checksums (fragment.go:1226-1443) ---------------
+
+    def blocks(self) -> list[tuple[int, bytes]]:
+        """Checksums of 100-row blocks; empty blocks omitted. The reference
+        uses xxhash over (row, col) pairs (blockHasher fragment.go:2144);
+        any stable digest works since both replicas run this code."""
+        out = []
+        max_block = self.max_row_id() // HASH_BLOCK_SIZE
+        for blk in range(max_block + 1):
+            chk = self._block_checksum(blk)
+            if chk is not None:
+                out.append((blk, chk))
+        return out
+
+    def _block_checksum(self, blk: int) -> Optional[bytes]:
+        cached = self._block_checksums.get(blk)
+        if cached is not None:
+            return cached
+        lo = blk * HASH_BLOCK_SIZE * SHARD_WIDTH
+        hi = (blk + 1) * HASH_BLOCK_SIZE * SHARD_WIDTH
+        vals = self.storage.slice(lo, hi)
+        if vals.size == 0:
+            return None
+        h = hashlib.blake2b((vals - np.uint64(lo)).tobytes(), digest_size=16).digest()
+        self._block_checksums[blk] = h
+        return h
+
+    def block_data(self, blk: int) -> tuple[np.ndarray, np.ndarray]:
+        """(rows, cols) pairs of a block (blockData, fragment.go:1307)."""
+        lo = blk * HASH_BLOCK_SIZE * SHARD_WIDTH
+        hi = (blk + 1) * HASH_BLOCK_SIZE * SHARD_WIDTH
+        vals = self.storage.slice(lo, hi)
+        rows = (vals // np.uint64(SHARD_WIDTH)).astype(np.int64)
+        cols = (vals % np.uint64(SHARD_WIDTH)).astype(np.int64)
+        return rows, cols
+
+    def merge_block(self, blk: int, peer_rows: np.ndarray, peer_cols: np.ndarray):
+        """3-way-ish merge: adopt the union of local and peer pairs; returns
+        (sets_for_peer, clears_for_peer) deltas the caller pushes back
+        (mergeBlock, fragment.go:1323-1443 — reference adopts union sets)."""
+        local_rows, local_cols = self.block_data(blk)
+        local = set(zip(local_rows.tolist(), local_cols.tolist()))
+        peer = set(zip(np.asarray(peer_rows).tolist(), np.asarray(peer_cols).tolist()))
+        missing_local = peer - local
+        missing_peer = local - peer
+        for r, c in missing_local:
+            self.set_bit(int(r), int(c))
+        sets = np.array(sorted(missing_peer), dtype=np.int64).reshape(-1, 2)
+        return sets[:, 0], sets[:, 1]
+
+    # -- archive streaming for resize copies (fragment.go:1823-1998) --------
+
+    def write_to_tar(self, fileobj) -> None:
+        with tarfile.open(fileobj=fileobj, mode="w") as tar:
+            data = self.storage.to_bytes()
+            info = tarfile.TarInfo("data")
+            info.size = len(data)
+            tar.addfile(info, io.BytesIO(data))
+
+    def read_from_tar(self, fileobj) -> None:
+        with tarfile.open(fileobj=fileobj, mode="r") as tar:
+            member = tar.getmember("data")
+            data = tar.extractfile(member).read()
+        self.storage = Bitmap.from_bytes(data)
+        self.storage.op_writer = self._op_file
+        self.generation += 1
+        self._row_gen.clear()
+        self._block_checksums.clear()
+        self.snapshot()
+
+    # -- identity -----------------------------------------------------------
+
+    def key(self) -> tuple[str, str, str, int]:
+        return (self.index, self.field, self.view, self.shard)
+
+    def __repr__(self) -> str:
+        return f"<Fragment {self.index}/{self.field}/{self.view}/{self.shard} bits={self.bit_count()}>"

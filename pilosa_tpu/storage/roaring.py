@@ -120,7 +120,7 @@ class Container:
     def contains(self, v: int) -> bool:
         if self.kind == "array":
             i = np.searchsorted(self.data, v)
-            return i < self.data.size and self.data[i] == v
+            return bool(i < self.data.size and self.data[i] == v)
         return bool((int(self.data[v >> 6]) >> (v & 63)) & 1)
 
     def _normalize(self) -> "Container":
@@ -460,13 +460,14 @@ class Bitmap:
             if a is None and b is None:
                 continue
             if a is None:
-                res = b if kind in ("or", "xor") else None
+                # aliases the other bitmap's container: copy
+                res = Container(b.kind, b.data.copy()) if kind in ("or", "xor") else None
             elif b is None:
-                res = a if kind in ("or", "xor", "andnot") else None
+                res = Container(a.kind, a.data.copy()) if kind in ("or", "xor", "andnot") else None
             else:
-                res = a.op(b, kind)
+                res = a.op(b, kind)  # freshly allocated
             if res is not None and res.n:
-                out.containers[key] = Container(res.kind, res.data.copy())
+                out.containers[key] = res
         return out
 
     def intersect(self, other: "Bitmap") -> "Bitmap":

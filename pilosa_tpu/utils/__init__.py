@@ -1,0 +1,6 @@
+"""Cross-cutting subsystems: attrs, key translation, stats, tracing, logging.
+
+Every dependency has a nop default (mirroring the reference's nop
+implementations — client.go:79, broadcast.go:43, attr.go:50,
+stats/stats.go, tracing/tracing.go:38) so each layer is testable alone.
+"""

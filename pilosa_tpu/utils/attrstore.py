@@ -1,0 +1,103 @@
+"""Attribute storage: arbitrary JSON attrs keyed by row/column id.
+
+Reference: attr.go (AttrStore interface) + boltdb/attrstore.go (embedded
+B-tree KV). Here: sqlite3 (stdlib embedded B-tree) with the same surface —
+attrs(id), set_attrs(id, m) merge semantics, bulk set, and content-hashed
+blocks for anti-entropy diffs (attr.go blocks / AttrBlocks,
+holder.go:726-820 syncIndex/syncField).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import sqlite3
+from typing import Iterable, Optional
+
+ATTR_BLOCK_SIZE = 100
+
+
+class AttrStore:
+    def __init__(self, path: Optional[str] = None):
+        self.path = path
+        self._db: Optional[sqlite3.Connection] = None
+
+    def open(self) -> "AttrStore":
+        target = self.path or ":memory:"
+        if self.path:
+            os.makedirs(os.path.dirname(self.path), exist_ok=True)
+        # served from HTTP handler threads; sqlite guards with its own lock
+        self._db = sqlite3.connect(target, check_same_thread=False)
+        self._db.execute(
+            "CREATE TABLE IF NOT EXISTS attrs (id INTEGER PRIMARY KEY, data TEXT NOT NULL)"
+        )
+        self._db.commit()
+        return self
+
+    def close(self) -> None:
+        if self._db is not None:
+            self._db.close()
+            self._db = None
+
+    def attrs(self, id_: int) -> dict:
+        cur = self._db.execute("SELECT data FROM attrs WHERE id = ?", (id_,))
+        row = cur.fetchone()
+        return json.loads(row[0]) if row else {}
+
+    def set_attrs(self, id_: int, m: dict) -> dict:
+        """Merge m into existing attrs; None values delete keys (the
+        reference's attr merge semantics, attr.go SetAttrs)."""
+        cur = dict(self.attrs(id_))
+        for k, v in m.items():
+            if v is None:
+                cur.pop(k, None)
+            else:
+                cur[k] = v
+        self._db.execute(
+            "INSERT OR REPLACE INTO attrs (id, data) VALUES (?, ?)",
+            (id_, json.dumps(cur, sort_keys=True)),
+        )
+        self._db.commit()
+        return cur
+
+    def set_bulk_attrs(self, items: Iterable[tuple[int, dict]]) -> None:
+        for id_, m in items:
+            self.set_attrs(id_, m)
+
+    def ids(self) -> list[int]:
+        return [r[0] for r in self._db.execute("SELECT id FROM attrs ORDER BY id")]
+
+    # -- anti-entropy blocks (attr.go blocks) -------------------------------
+
+    def blocks(self) -> list[tuple[int, bytes]]:
+        out: dict[int, hashlib._Hash] = {}
+        for id_, data in self._db.execute("SELECT id, data FROM attrs ORDER BY id"):
+            blk = id_ // ATTR_BLOCK_SIZE
+            h = out.get(blk)
+            if h is None:
+                h = out[blk] = hashlib.blake2b(digest_size=16)
+            h.update(str(id_).encode() + b"\0" + data.encode() + b"\0")
+        return [(blk, h.digest()) for blk, h in sorted(out.items())]
+
+    def block_data(self, blk: int) -> list[tuple[int, dict]]:
+        lo, hi = blk * ATTR_BLOCK_SIZE, (blk + 1) * ATTR_BLOCK_SIZE
+        return [
+            (id_, json.loads(data))
+            for id_, data in self._db.execute(
+                "SELECT id, data FROM attrs WHERE id >= ? AND id < ? ORDER BY id", (lo, hi)
+            )
+        ]
+
+
+class NopAttrStore:
+    """attr.go:50 nopAttrStore."""
+
+    def open(self): return self
+    def close(self): pass
+    def attrs(self, id_): return {}
+    def set_attrs(self, id_, m): return {}
+    def set_bulk_attrs(self, items): pass
+    def ids(self): return []
+    def blocks(self): return []
+    def block_data(self, blk): return []

@@ -2,14 +2,27 @@
 
 Multi-chip hardware is not available in CI; sharding tests run on a virtual
 8-device CPU backend (the analog of the reference's in-process multi-node
-harness, test/pilosa.go:297-352 MustRunCluster).  Must run before jax import.
+harness, test/pilosa.go:297-352 MustRunCluster).
+
+Note: this environment exports JAX_PLATFORMS=axon and the axon plugin wins
+over env-var overrides, so the platform is forced via jax.config.update
+(must happen before any backend use; conftest imports run first).
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import re
+
 _flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+_flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "", _flags)
+os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+
+def pytest_sessionstart(session):
+    assert jax.devices()[0].platform == "cpu", jax.devices()
+    assert len(jax.devices()) == 8, jax.devices()

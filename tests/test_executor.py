@@ -1,0 +1,253 @@
+"""Executor tests: PQL strings through the full local pipeline — parse ->
+leaf materialization -> device program -> reduce.
+
+Mirrors executor_test.go's style: build an index, run PQL, assert results.
+Runs on the CPU backend (8 virtual devices) with and without a mesh runner.
+"""
+
+import numpy as np
+import pytest
+
+from pilosa_tpu.constants import SHARD_WIDTH
+from pilosa_tpu.executor import ExecutionError, Executor, ValCount
+from pilosa_tpu.models import FieldOptions, FieldType, Holder
+from pilosa_tpu.models.row import Row
+from pilosa_tpu.parallel.mesh import DeviceRunner, make_mesh
+
+
+@pytest.fixture(params=["single", "mesh"])
+def ex(tmp_path, request):
+    h = Holder(str(tmp_path / "data")).open()
+    runner = DeviceRunner(make_mesh() if request.param == "mesh" else None)
+    e = Executor(h, runner=runner)
+    yield e
+    h.close()
+
+
+@pytest.fixture
+def populated(ex):
+    idx = ex.holder.create_index("i")
+    f = idx.create_field("f")
+    g = idx.create_field("g")
+    # rows spanning 3 shards
+    f.import_bits([10] * 4, [1, 2, SHARD_WIDTH + 1, 2 * SHARD_WIDTH + 5])
+    f.import_bits([11] * 3, [2, 3, SHARD_WIDTH + 1])
+    g.import_bits([20] * 2, [2, SHARD_WIDTH + 1])
+    for c in [1, 2, 3, SHARD_WIDTH + 1, 2 * SHARD_WIDTH + 5]:
+        idx.mark_exists(c)
+    return ex
+
+
+def cols(row: Row) -> list[int]:
+    return row.columns().tolist()
+
+
+def test_row(populated):
+    (r,) = populated.execute("i", "Row(f=10)")
+    assert cols(r) == [1, 2, SHARD_WIDTH + 1, 2 * SHARD_WIDTH + 5]
+
+
+def test_intersect_union_difference_xor(populated):
+    (r,) = populated.execute("i", "Intersect(Row(f=10), Row(f=11))")
+    assert cols(r) == [2, SHARD_WIDTH + 1]
+    (r,) = populated.execute("i", "Union(Row(f=10), Row(f=11))")
+    assert cols(r) == [1, 2, 3, SHARD_WIDTH + 1, 2 * SHARD_WIDTH + 5]
+    (r,) = populated.execute("i", "Difference(Row(f=10), Row(f=11))")
+    assert cols(r) == [1, 2 * SHARD_WIDTH + 5]
+    (r,) = populated.execute("i", "Xor(Row(f=10), Row(f=11))")
+    assert cols(r) == [1, 3, 2 * SHARD_WIDTH + 5]
+
+
+def test_nested_and_cross_field(populated):
+    (r,) = populated.execute("i", "Intersect(Union(Row(f=10), Row(f=11)), Row(g=20))")
+    assert cols(r) == [2, SHARD_WIDTH + 1]
+
+
+def test_count(populated):
+    (c,) = populated.execute("i", "Count(Row(f=10))")
+    assert c == 4
+    (c,) = populated.execute("i", "Count(Intersect(Row(f=10), Row(g=20)))")
+    assert c == 2
+
+
+def test_not(populated):
+    (r,) = populated.execute("i", "Not(Row(f=10))")
+    # existence = {1,2,3,SW+1,2SW+5}; minus row 10 -> {3}
+    assert cols(r) == [3]
+
+
+def test_row_missing_field(populated):
+    with pytest.raises(ExecutionError):
+        populated.execute("i", "Row(nope=1)")
+
+
+def test_multiple_calls(populated):
+    r1, c1 = populated.execute("i", "Row(f=11) Count(Row(f=11))")
+    assert cols(r1) == [2, 3, SHARD_WIDTH + 1]
+    assert c1 == 3
+
+
+def test_set_clear(ex):
+    idx = ex.holder.create_index("i")
+    idx.create_field("f")
+    (changed,) = ex.execute("i", "Set(100, f=1)")
+    assert changed is True
+    (changed,) = ex.execute("i", "Set(100, f=1)")
+    assert changed is False
+    (r,) = ex.execute("i", "Row(f=1)")
+    assert cols(r) == [100]
+    # existence tracked
+    (r,) = ex.execute("i", "Not(Row(f=99))")
+    assert cols(r) == [100]
+    (changed,) = ex.execute("i", "Clear(100, f=1)")
+    assert changed is True
+    (r,) = ex.execute("i", "Row(f=1)")
+    assert cols(r) == []
+
+
+def test_device_cache_invalidation(ex):
+    idx = ex.holder.create_index("i")
+    idx.create_field("f")
+    ex.execute("i", "Set(1, f=1)")
+    (c,) = ex.execute("i", "Count(Row(f=1))")
+    assert c == 1
+    ex.execute("i", "Set(2, f=1)")
+    (c,) = ex.execute("i", "Count(Row(f=1))")  # must not serve stale slab
+    assert c == 2
+
+
+def test_clear_row_and_store(ex):
+    idx = ex.holder.create_index("i")
+    f = idx.create_field("f")
+    f.import_bits([1, 1, 2], [5, 6, 7])
+    (changed,) = ex.execute("i", "ClearRow(f=1)")
+    assert changed is True
+    (r,) = ex.execute("i", "Row(f=1)")
+    assert cols(r) == []
+    # Store: copy row 2 into a new row of a new field
+    ex.execute("i", "Store(Row(f=2), t=9)")
+    (r,) = ex.execute("i", "Row(t=9)")
+    assert cols(r) == [7]
+
+
+def test_bsi_sum_min_max(ex):
+    idx = ex.holder.create_index("i")
+    idx.create_field("v", FieldOptions(type=FieldType.INT, min=-10, max=1000))
+    idx.create_field("f")
+    ex.execute("i", "Set(1, v=10) Set(2, v=-10) Set(3, v=1000) Set(4, v=0)")
+    ex.execute("i", "Set(1, f=7) Set(2, f=7)")
+    (vc,) = ex.execute("i", "Sum(field=v)")
+    assert vc == ValCount(1000, 4)
+    (vc,) = ex.execute("i", "Sum(Row(f=7), field=v)")
+    assert vc == ValCount(0, 2)
+    (vc,) = ex.execute("i", "Min(field=v)")
+    assert vc == ValCount(-10, 1)
+    (vc,) = ex.execute("i", "Max(field=v)")
+    assert vc == ValCount(1000, 1)
+    (vc,) = ex.execute("i", "Max(Row(f=7), field=v)")
+    assert vc == ValCount(10, 1)
+
+
+def test_bsi_range_ops(ex):
+    idx = ex.holder.create_index("i")
+    idx.create_field("v", FieldOptions(type=FieldType.INT, min=0, max=100))
+    vals = {1: 5, 2: 50, 3: 100, SHARD_WIDTH + 4: 50}
+    for c, v in vals.items():
+        ex.execute("i", f"Set({c}, v={v})")
+    cases = {
+        "Range(v < 50)": [1],
+        "Range(v <= 50)": [1, 2, SHARD_WIDTH + 4],
+        "Range(v > 50)": [3],
+        "Range(v >= 50)": [2, 3, SHARD_WIDTH + 4],
+        "Range(v == 50)": [2, SHARD_WIDTH + 4],
+        "Range(v != 50)": [1, 3],
+        "Range(v >< [5, 50])": [1, 2, SHARD_WIDTH + 4],
+        "Range(0 < v < 100)": [1, 2, SHARD_WIDTH + 4],
+        "Range(v != null)": [1, 2, 3, SHARD_WIDTH + 4],
+        # out-of-range clamps
+        "Range(v > 1000)": [],
+        "Range(v < -5)": [],
+        "Range(v >= -5)": [1, 2, 3, SHARD_WIDTH + 4],
+    }
+    for q, expect in cases.items():
+        (r,) = ex.execute("i", q)
+        assert cols(r) == expect, q
+
+
+def test_topn(ex):
+    idx = ex.holder.create_index("i")
+    f = idx.create_field("f", FieldOptions(cache_size=100))
+    # row 1: 5 cols, row 2: 3 cols, row 3: 1 col; spanning shards
+    f.import_bits([1] * 5, [0, 1, 2, SHARD_WIDTH, SHARD_WIDTH + 1])
+    f.import_bits([2] * 3, [0, 5, SHARD_WIDTH + 2])
+    f.import_bits([3] * 1, [9])
+    (pairs,) = ex.execute("i", "TopN(f, n=2)")
+    assert pairs == [(1, 5), (2, 3)]
+    (pairs,) = ex.execute("i", "TopN(f)")
+    assert pairs == [(1, 5), (2, 3), (3, 1)]
+    # with Src filter: ranked by intersection with Row(f=2)
+    (pairs,) = ex.execute("i", "TopN(f, Row(f=2), n=3)")
+    assert pairs[0] == (2, 3)
+    # threshold
+    (pairs,) = ex.execute("i", "TopN(f, n=10, threshold=3)")
+    assert pairs == [(1, 5), (2, 3)]
+
+
+def test_rows(ex):
+    idx = ex.holder.create_index("i")
+    f = idx.create_field("f")
+    f.import_bits([5, 7, 9], [1, SHARD_WIDTH + 2, 3])
+    (rows,) = ex.execute("i", "Rows(field=f)")
+    assert rows == [5, 7, 9]
+    (rows,) = ex.execute("i", "Rows(field=f, limit=2)")
+    assert rows == [5, 7]
+    (rows,) = ex.execute("i", "Rows(field=f, previous=5)")
+    assert rows == [7, 9]
+    (rows,) = ex.execute("i", f"Rows(field=f, column={SHARD_WIDTH + 2})")
+    assert rows == [7]
+
+
+def test_group_by(ex):
+    idx = ex.holder.create_index("i")
+    f = idx.create_field("f")
+    g = idx.create_field("g")
+    # f rows: 1 -> {0,1,2}; 2 -> {2,3}
+    f.import_bits([1, 1, 1, 2, 2], [0, 1, 2, 2, 3])
+    # g rows: 10 -> {1,2,3}
+    g.import_bits([10, 10, 10], [1, 2, 3])
+    (groups,) = ex.execute("i", "GroupBy(Rows(field=f), Rows(field=g))")
+    assert groups == [
+        {"group": [{"field": "f", "rowID": 1}, {"field": "g", "rowID": 10}], "count": 2},
+        {"group": [{"field": "f", "rowID": 2}, {"field": "g", "rowID": 10}], "count": 2},
+    ]
+    (groups,) = ex.execute("i", "GroupBy(Rows(field=f), limit=1)")
+    assert groups == [{"group": [{"field": "f", "rowID": 1}], "count": 3}]
+
+
+def test_attrs(ex):
+    idx = ex.holder.create_index("i")
+    idx.create_field("f")
+    ex.execute("i", 'SetRowAttrs(f, 1, color="red", weight=10)')
+    assert idx.field("f").row_attrs.attrs(1) == {"color": "red", "weight": 10}
+    ex.execute("i", 'SetColumnAttrs(5, active=true)')
+    assert idx.column_attrs.attrs(5) == {"active": True}
+    # None deletes
+    ex.execute("i", 'SetRowAttrs(f, 1, color=null)')
+    assert idx.field("f").row_attrs.attrs(1) == {"weight": 10}
+
+
+def test_options(populated):
+    (r,) = populated.execute("i", "Options(Row(f=10), excludeColumns=true)")
+    assert cols(r) == []
+    (r,) = populated.execute("i", "Options(Row(f=10), shards=[0, 2])")
+    assert cols(r) == [1, 2, 2 * SHARD_WIDTH + 5]
+
+
+def test_bool_field_query(ex):
+    idx = ex.holder.create_index("i")
+    idx.create_field("b", FieldOptions(type=FieldType.BOOL))
+    ex.execute("i", "Set(1, b=true) Set(2, b=false) Set(3, b=true)")
+    (r,) = ex.execute("i", "Row(b=true)")
+    assert cols(r) == [1, 3]
+    (r,) = ex.execute("i", "Row(b=false)")
+    assert cols(r) == [2]

@@ -1,0 +1,187 @@
+"""Fragment storage tests: WAL durability, snapshot compaction, row
+materialization, BSI values, bulk import, block checksums.
+
+Mirrors fragment_internal_test.go coverage (setBit/clearBit, setValue,
+snapshot, import paths, Blocks) on temp dirs.
+"""
+
+import io
+import os
+
+import numpy as np
+import pytest
+
+from pilosa_tpu.constants import MAX_OP_N, SHARD_WIDTH
+from pilosa_tpu.storage.fragment import Fragment
+
+RNG = np.random.default_rng(3)
+
+
+@pytest.fixture
+def frag(tmp_path):
+    f = Fragment(str(tmp_path / "i/f/views/standard/fragments/0"), "i", "f", "standard", 0)
+    f.open()
+    yield f
+    f.close()
+
+
+def reopen(f: Fragment) -> Fragment:
+    f.close()
+    g = Fragment(f.path, f.index, f.field, f.view, f.shard)
+    return g.open()
+
+
+def test_set_clear_bit_and_durability(frag):
+    assert frag.set_bit(10, 100)
+    assert not frag.set_bit(10, 100)
+    assert frag.set_bit(10, 200)
+    assert frag.set_bit(500, SHARD_WIDTH - 1)
+    assert frag.clear_bit(10, 200)
+    assert frag.contains(10, 100)
+    assert not frag.contains(10, 200)
+
+    # ops were WAL'd, not snapshotted: reopen replays them
+    g = reopen(frag)
+    assert g.contains(10, 100)
+    assert not g.contains(10, 200)
+    assert g.contains(500, SHARD_WIDTH - 1)
+    assert g.row_columns(10).tolist() == [100]
+    g.close()
+
+
+def test_snapshot_at_max_opn(frag):
+    for i in range(MAX_OP_N + 2):
+        frag.set_bit(0, i % SHARD_WIDTH)
+    assert frag.op_n <= MAX_OP_N  # snapshot reset the op counter
+    g = reopen(frag)
+    assert g.bit_count() == MAX_OP_N + 2
+    assert g.op_n <= MAX_OP_N
+    g.close()
+
+
+def test_row_dense_matches_columns(frag):
+    cols = np.unique(RNG.integers(0, SHARD_WIDTH, 500))
+    for c in cols:
+        frag.set_bit(7, int(c))
+    dense = frag.row_dense(7)
+    from pilosa_tpu.ops.bitvector import columns_from_dense
+    np.testing.assert_array_equal(columns_from_dense(dense), cols)
+    assert frag.row_count(7) == cols.size
+    assert frag.row_ids() == [7]
+    assert frag.max_row_id() == 7
+
+
+def test_generations_track_mutations(frag):
+    g0 = frag.row_generation(3)
+    frag.set_bit(3, 1)
+    g1 = frag.row_generation(3)
+    assert g1 > g0
+    frag.set_bit(4, 1)
+    assert frag.row_generation(3) == g1  # other row untouched
+    frag.clear_bit(3, 1)
+    assert frag.row_generation(3) > g1
+
+
+def test_set_row_and_clear_row(frag):
+    frag.set_bit(2, 5)
+    frag.set_row(2, np.array([7, 8, 9]))
+    assert frag.row_columns(2).tolist() == [7, 8, 9]
+    assert frag.clear_row(2) == 3
+    assert frag.row_columns(2).size == 0
+
+
+def test_bsi_value_roundtrip(frag):
+    assert frag.set_value(42, 16, 12345)
+    v, ok = frag.value(42, 16)
+    assert ok and v == 12345
+    # overwrite with a smaller value must clear high bits
+    frag.set_value(42, 16, 3)
+    v, ok = frag.value(42, 16)
+    assert ok and v == 3
+    # unset column
+    v, ok = frag.value(43, 16)
+    assert not ok
+    frag.clear_value(42, 16)
+    assert frag.value(42, 16) == (0, False)
+
+
+def test_bulk_import(frag):
+    rows = [1, 1, 2, 3, 3, 3]
+    cols = [10, 20, 10, 1, 2, 3]
+    frag.bulk_import(rows, cols)
+    assert frag.row_columns(1).tolist() == [10, 20]
+    assert frag.row_columns(3).tolist() == [1, 2, 3]
+    # bulk import snapshots: no ops pending
+    assert frag.op_n == 0
+    g = reopen(frag)
+    assert g.bit_count() == 6
+    g.close()
+
+
+def test_bulk_import_values(frag):
+    cols = [5, 6, 7]
+    vals = [100, 0, 65535]
+    frag.bulk_import_values(cols, vals, 16)
+    for c, v in zip(cols, vals):
+        got, ok = frag.value(c, 16)
+        assert ok and got == v
+
+
+def test_import_roaring(frag, tmp_path):
+    other = Fragment(str(tmp_path / "o"), "i", "f", "standard", 0).open()
+    other.bulk_import([0, 1], [100, 200])
+    data = other.storage.to_bytes()
+    other.close()
+    frag.set_bit(0, 50)
+    frag.import_roaring(data)
+    assert frag.row_columns(0).tolist() == [50, 100]
+    assert frag.row_columns(1).tolist() == [200]
+    frag.import_roaring(data, clear=True)
+    assert frag.row_columns(0).tolist() == [50]
+    assert frag.row_columns(1).size == 0
+
+
+def test_blocks_and_merge(frag, tmp_path):
+    frag.set_bit(0, 1)
+    frag.set_bit(150, 2)     # block 1
+    frag.set_bit(250, 3)     # block 2
+    blocks = dict(frag.blocks())
+    assert set(blocks) == {0, 1, 2}
+
+    peer = Fragment(str(tmp_path / "p"), "i", "f", "standard", 0).open()
+    peer.set_bit(0, 1)
+    peer.set_bit(0, 9)       # peer has extra bit in block 0
+    frag.set_bit(50, 4)      # local extra in block 0
+    pr, pc = peer.block_data(0)
+    sets_r, sets_c = frag.merge_block(0, pr, pc)
+    # local adopted the peer's bit
+    assert frag.contains(0, 9)
+    # delta for the peer: the local-only pairs
+    assert list(zip(sets_r.tolist(), sets_c.tolist())) == [(50, 4)]
+    # checksums equal after peer applies delta
+    for r, c in zip(sets_r.tolist(), sets_c.tolist()):
+        peer.set_bit(r, c)
+    assert dict(peer.blocks())[0] == dict(frag.blocks())[0]
+    peer.close()
+
+
+def test_tar_roundtrip(frag, tmp_path):
+    frag.bulk_import([0, 1, 2], [1, 2, 3])
+    buf = io.BytesIO()
+    frag.write_to_tar(buf)
+    buf.seek(0)
+    other = Fragment(str(tmp_path / "t"), "i", "f", "standard", 1).open()
+    other.read_from_tar(buf)
+    assert other.bit_count() == 3
+    assert other.row_columns(1).tolist() == [2]
+    other.close()
+
+
+def test_snapshot_atomic_file(frag):
+    frag.set_bit(0, 1)
+    frag.snapshot()
+    assert os.path.exists(frag.path)
+    assert not os.path.exists(frag.path + ".snapshotting")
+    g = reopen(frag)
+    assert g.contains(0, 1) and g.op_n == 0
+    g.close()

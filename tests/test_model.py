@@ -1,0 +1,188 @@
+"""Data model tests: holder/index/field/view tree, field types, time views,
+Row algebra, persistence across reopen.
+
+Mirrors holder_test.go / index_test.go / field_test.go / view tests.
+"""
+
+from datetime import datetime
+
+import numpy as np
+import pytest
+
+from pilosa_tpu.constants import EXISTENCE_FIELD_NAME, SHARD_WIDTH
+from pilosa_tpu.models import Field, FieldOptions, FieldType, Holder, Row
+from pilosa_tpu.models.timequantum import views_by_time, views_by_time_range
+
+
+@pytest.fixture
+def holder(tmp_path):
+    h = Holder(str(tmp_path / "data")).open()
+    yield h
+    h.close()
+
+
+def test_holder_index_lifecycle(holder):
+    idx = holder.create_index("i")
+    assert holder.index("i") is idx
+    with pytest.raises(ValueError):
+        holder.create_index("i")
+    with pytest.raises(ValueError):
+        holder.create_index("Bad Name!")
+    holder.delete_index("i")
+    assert holder.index("i") is None
+
+
+def test_set_field_write_read(holder):
+    idx = holder.create_index("i")
+    f = idx.create_field("f")
+    assert f.set_bit(10, 100)
+    assert not f.set_bit(10, 100)
+    f.set_bit(10, SHARD_WIDTH + 5)  # second shard
+    row = f.row(10)
+    assert row.columns().tolist() == [100, SHARD_WIDTH + 5]
+    assert f.shards() == [0, 1]
+    assert idx.available_shards().slice().tolist() == [0, 1]
+
+
+def test_persistence_across_reopen(tmp_path):
+    h = Holder(str(tmp_path / "d")).open()
+    idx = h.create_index("i", keys=False)
+    f = idx.create_field("f", FieldOptions(type=FieldType.SET, cache_size=100))
+    f.set_bit(3, 7)
+    g = idx.create_field("n", FieldOptions(type=FieldType.INT, min=-10, max=100))
+    g.set_value(5, 42)
+    h.close()
+
+    h2 = Holder(str(tmp_path / "d")).open()
+    idx2 = h2.index("i")
+    assert idx2 is not None
+    f2 = idx2.field("f")
+    assert f2.options.cache_size == 100
+    assert f2.row(3).columns().tolist() == [7]
+    g2 = idx2.field("n")
+    assert g2.options.min == -10 and g2.options.max == 100
+    assert g2.value(5) == (42, True)
+    h2.close()
+
+
+def test_int_field_bsi(holder):
+    idx = holder.create_index("i")
+    f = idx.create_field("v", FieldOptions(type=FieldType.INT, min=-100, max=1000))
+    assert f.bit_depth == (1100).bit_length()
+    f.set_value(1, -100)
+    f.set_value(2, 0)
+    f.set_value(3, 1000)
+    assert f.value(1) == (-100, True)
+    assert f.value(2) == (0, True)
+    assert f.value(3) == (1000, True)
+    assert f.value(4) == (0, False)
+    with pytest.raises(ValueError):
+        f.set_value(1, 1001)
+    f.clear_value(3)
+    assert f.value(3) == (0, False)
+    with pytest.raises(ValueError):
+        f.set_bit(0, 0)  # set_bit invalid on int fields
+
+
+def test_mutex_field(holder):
+    idx = holder.create_index("i")
+    f = idx.create_field("m", FieldOptions(type=FieldType.MUTEX))
+    f.set_bit(1, 50)
+    f.set_bit(2, 50)  # must clear row 1 for column 50
+    assert f.row(1).columns().size == 0
+    assert f.row(2).columns().tolist() == [50]
+
+
+def test_bool_field(holder):
+    idx = holder.create_index("i")
+    f = idx.create_field("b", FieldOptions(type=FieldType.BOOL))
+    f.set_bit(1, 9)
+    f.set_bit(0, 9)
+    assert f.row(1).columns().size == 0
+    assert f.row(0).columns().tolist() == [9]
+    with pytest.raises(ValueError):
+        f.set_bit(2, 9)
+
+
+def test_time_field_views_and_range(holder):
+    idx = holder.create_index("i")
+    f = idx.create_field("t", FieldOptions(type=FieldType.TIME, time_quantum="YMD"))
+    t1 = datetime(2018, 1, 2)
+    t2 = datetime(2018, 2, 3)
+    f.set_bit(1, 10, timestamp=t1)
+    f.set_bit(1, 20, timestamp=t2)
+    # standard view has both
+    assert f.row(1).columns().tolist() == [10, 20]
+    # range covering only January
+    r = f.row_time(1, datetime(2018, 1, 1), datetime(2018, 2, 1))
+    assert r.columns().tolist() == [10]
+    r = f.row_time(1, datetime(2018, 1, 1), datetime(2018, 3, 1))
+    assert r.columns().tolist() == [10, 20]
+
+
+def test_views_by_time():
+    t = datetime(2018, 1, 2, 3)
+    assert views_by_time("standard", t, "YMDH") == [
+        "standard_2018", "standard_201801", "standard_20180102", "standard_2018010203"]
+
+
+def test_views_by_time_range_minimal_cover():
+    # feb..april exactly = 2 monthly views + partial via days
+    got = views_by_time_range("standard", datetime(2018, 2, 1), datetime(2018, 4, 1), "YMD")
+    assert got == ["standard_201802", "standard_201803"]
+    # full year plus one day each side
+    got = views_by_time_range("standard", datetime(2017, 12, 31), datetime(2019, 1, 2), "YMD")
+    assert "standard_2018" in got
+    assert "standard_20171231" in got and "standard_20190101" in got
+    assert len(got) == 3
+    # sub-day ranges need H
+    got = views_by_time_range("standard", datetime(2018, 1, 1, 5), datetime(2018, 1, 1, 7), "YMDH")
+    assert got == ["standard_2018010105", "standard_2018010106"]
+
+
+def test_existence_field(holder):
+    idx = holder.create_index("i", track_existence=True)
+    assert idx.existence_field() is not None
+    idx.mark_exists(42)
+    assert idx.existence_field().row(0).columns().tolist() == [42]
+    # existence field hidden from schema
+    names = [f["name"] for f in idx.schema_dict()["fields"]]
+    assert EXISTENCE_FIELD_NAME not in names
+
+
+def test_import_bits_and_values(holder):
+    idx = holder.create_index("i")
+    f = idx.create_field("f")
+    f.import_bits([1, 1, 2], [5, SHARD_WIDTH + 1, 9])
+    assert f.row(1).columns().tolist() == [5, SHARD_WIDTH + 1]
+    assert f.row(2).columns().tolist() == [9]
+    g = idx.create_field("v", FieldOptions(type=FieldType.INT, min=0, max=1000))
+    g.import_values([1, 2, 3], [10, 20, 30])
+    assert g.value(2) == (20, True)
+
+
+def test_row_algebra():
+    a = Row(np.array([1, 5, SHARD_WIDTH + 3]))
+    b = Row(np.array([5, 9, SHARD_WIDTH + 3, 2 * SHARD_WIDTH]))
+    assert a.intersect(b).columns().tolist() == [5, SHARD_WIDTH + 3]
+    assert a.union(b).columns().tolist() == [1, 5, 9, SHARD_WIDTH + 3, 2 * SHARD_WIDTH]
+    assert a.difference(b).columns().tolist() == [1]
+    assert sorted(a.xor(b).columns().tolist()) == [1, 9, 2 * SHARD_WIDTH]
+    assert a.intersection_count(b) == 2
+    assert a.includes(5) and not a.includes(9)
+    assert a.count() == 3
+    m = Row.from_segment(0, np.array([1])).merge(Row.from_segment(1, np.array([SHARD_WIDTH + 1])))
+    assert m.columns().tolist() == [1, SHARD_WIDTH + 1]
+
+
+def test_rank_cache_update(holder):
+    idx = holder.create_index("i")
+    f = idx.create_field("f", FieldOptions(cache_size=10))
+    for c in range(20):
+        f.set_bit(1, c)
+    f.set_bit(2, 0)
+    v = f.view()
+    cache = v.rank_caches[0]
+    top = cache.top(2)
+    assert top[0] == (1, 20)
+    assert top[1] == (2, 1)

@@ -1,0 +1,174 @@
+"""PQL parser tests.
+
+Case matrix modeled on the reference's pqlpeg_test.go / parser_test.go:
+special forms, nesting, conditions, conditionals, lists, strings, timestamps.
+"""
+
+from datetime import datetime
+
+import pytest
+
+from pilosa_tpu.pql import Call, Condition, PQLError, parse_string
+from pilosa_tpu.pql.ast import BETWEEN
+
+
+def one(src: str) -> Call:
+    q = parse_string(src)
+    assert len(q.calls) == 1, q.calls
+    return q.calls[0]
+
+
+def test_row():
+    c = one("Row(f=10)")
+    assert c == Call("Row", {"f": 10})
+
+
+def test_nested_bitmap_ops():
+    c = one("Count(Intersect(Row(a=1), Row(b=2)))")
+    assert c.name == "Count"
+    inter = c.children[0]
+    assert inter.name == "Intersect"
+    assert inter.children == [Call("Row", {"a": 1}), Call("Row", {"b": 2})]
+
+
+def test_union_many_and_not():
+    c = one("Union(Row(a=1), Row(b=2), Row(c=3))")
+    assert len(c.children) == 3
+    c = one("Not(Row(a=1))")
+    assert c.children[0].name == "Row"
+
+
+def test_whitespace_and_multiple_calls():
+    q = parse_string("  Row(a=1)\n\tRow(b = 2) ")
+    assert len(q.calls) == 2
+    assert q.calls[1] == Call("Row", {"b": 2})
+
+
+def test_set():
+    c = one("Set(100, f=5)")
+    assert c == Call("Set", {"_col": 100, "f": 5})
+
+
+def test_set_with_timestamp():
+    c = one("Set(100, f=5, 2018-01-02T03:04)")
+    assert c.args["_timestamp"] == datetime(2018, 1, 2, 3, 4)
+
+
+def test_set_with_keys():
+    c = one("Set('col-key', f='row-key')")
+    assert c.args["_col"] == "col-key"
+    assert c.args["f"] == "row-key"
+
+
+def test_clear_and_clearrow():
+    assert one("Clear(7, f=1)") == Call("Clear", {"_col": 7, "f": 1})
+    assert one("ClearRow(f=1)") == Call("ClearRow", {"f": 1})
+
+
+def test_store():
+    c = one("Store(Row(a=1), f=9)")
+    assert c.name == "Store"
+    assert c.children[0] == Call("Row", {"a": 1})
+    assert c.args["f"] == 9
+
+
+def test_setrowattrs_setcolumnattrs():
+    c = one('SetRowAttrs(f, 10, color="blue", weight=1.5, active=true, gone=null)')
+    assert c.args["_field"] == "f"
+    assert c.args["_row"] == 10
+    assert c.args["color"] == "blue"
+    assert c.args["weight"] == 1.5
+    assert c.args["active"] is True
+    assert c.args["gone"] is None
+    c = one("SetColumnAttrs(3, happy=false)")
+    assert c.args == {"_col": 3, "happy": False}
+
+
+def test_topn():
+    assert one("TopN(f)").args == {"_field": "f"}
+    c = one("TopN(f, n=5)")
+    assert c.args == {"_field": "f", "n": 5}
+    c = one("TopN(f, Row(g=1), n=10, attrName=\"a\", attrValues=[1,2])")
+    assert c.children[0] == Call("Row", {"g": 1})
+    assert c.args["n"] == 10
+    assert c.args["attrValues"] == [1, 2]
+
+
+def test_range_condition_ops():
+    for op in ("<", "<=", ">", ">=", "==", "!="):
+        c = one(f"Range(f {op} 10)")
+        assert c.args["f"] == Condition(op, 10), op
+
+
+def test_range_between():
+    c = one("Range(f >< [4, 8])")
+    assert c.args["f"] == Condition("><", [4, 8])
+
+
+def test_range_conditional():
+    # intended semantics: 4 < f < 8 -> inclusive [5, 7]
+    assert one("Range(4 < f < 8)").args["f"] == Condition(BETWEEN, [5, 7])
+    assert one("Range(4 <= f <= 8)").args["f"] == Condition(BETWEEN, [4, 8])
+    assert one("Range(-10 <= f < 0)").args["f"] == Condition(BETWEEN, [-10, -1])
+
+
+def test_range_timerange():
+    c = one("Range(f=1, 2018-01-01T00:00, 2018-02-01T00:00)")
+    assert c.args["f"] == 1
+    assert c.args["_start"] == datetime(2018, 1, 1)
+    assert c.args["_end"] == datetime(2018, 2, 1)
+    c = one("Range(f=1, '2018-01-01T00:00', \"2018-02-01T00:00\")")
+    assert c.args["_start"] == datetime(2018, 1, 1)
+
+
+def test_row_with_list_and_strings():
+    c = one('Row(f=[1, 2, 3])')
+    assert c.args["f"] == [1, 2, 3]
+    c = one('Row(f="hello world")')
+    assert c.args["f"] == "hello world"
+    c = one("Row(f=bare-string_1:x)")
+    assert c.args["f"] == "bare-string_1:x"
+
+
+def test_quoted_escapes():
+    c = one(r'Row(f="a\"b")')
+    assert c.args["f"] == 'a"b'
+
+
+def test_negative_and_float():
+    assert one("Range(f > -5)").args["f"] == Condition(">", -5)
+    assert one("Row(f=1.25)").args["f"] == 1.25
+
+
+def test_field_names_with_underscore_dash():
+    c = one("Row(my_field-2=1)")
+    assert c.args["my_field-2"] == 1
+
+
+def test_groupby_rows():
+    c = one("GroupBy(Rows(field=a), Rows(field=b), limit=10)")
+    assert [ch.name for ch in c.children] == ["Rows", "Rows"]
+    assert c.args["limit"] == 10
+    assert c.children[0].args["field"] == "a"
+
+
+def test_options_call():
+    c = one("Options(Row(f=10), excludeColumns=true, shards=[0, 2])")
+    assert c.children[0] == Call("Row", {"f": 10})
+    assert c.args["excludeColumns"] is True
+    assert c.args["shards"] == [0, 2]
+
+
+def test_errors():
+    for bad in ("Row(", "Row)", "Set(1,)", "Row(f=)", "(", "Row(f==)"):
+        with pytest.raises(PQLError):
+            parse_string(bad)
+
+
+def test_write_call_count():
+    q = parse_string("Set(1, f=1)Row(f=1)Clear(1, f=1)")
+    assert q.write_call_count() == 2
+
+
+def test_empty_args_call():
+    assert one("Schema()") == Call("Schema")
