@@ -1,0 +1,396 @@
+"""API: the validated façade over holder + cluster + executor.
+
+Reference: api.go — ~40 methods, each gated on cluster state
+(api.validate, api.go:93; state table api.go:1212-1278). Handlers (HTTP or
+CLI) call only this surface; it owns key translation at the query boundary
+(translateCalls/translateResults, executor.go:2323-2590) and existence
+tracking on imports.
+"""
+
+from __future__ import annotations
+
+import csv
+import io
+from datetime import datetime, timezone
+from typing import Optional
+
+import numpy as np
+
+from pilosa_tpu import __version__
+from pilosa_tpu.constants import EXISTENCE_FIELD_NAME, SHARD_WIDTH
+from pilosa_tpu.executor import (
+    ExecutionError,
+    Executor,
+    GroupCounts,
+    Pairs,
+    RowIdentifiers,
+    ValCount,
+)
+from pilosa_tpu.models import FieldOptions, Holder
+from pilosa_tpu.models.field import FieldType
+from pilosa_tpu.models.row import Row
+from pilosa_tpu.models.view import VIEW_STANDARD
+from pilosa_tpu.parallel.cluster import (
+    STATE_DEGRADED,
+    STATE_NORMAL,
+    STATE_RESIZING,
+    STATE_STARTING,
+    Cluster,
+)
+from pilosa_tpu.utils.translate import TranslateStore
+
+
+class ApiError(Exception):
+    def __init__(self, msg: str, status: int = 400):
+        super().__init__(msg)
+        self.status = status
+
+
+class NotFoundError(ApiError):
+    def __init__(self, msg: str):
+        super().__init__(msg, status=404)
+
+
+class ConflictError(ApiError):
+    def __init__(self, msg: str):
+        super().__init__(msg, status=409)
+
+
+# method -> states in which it is permitted (api.go:1212-1278). Methods not
+# listed are permitted in NORMAL and DEGRADED.
+_STATE_GATES = {
+    "query": (STATE_NORMAL, STATE_DEGRADED),
+    "write": (STATE_NORMAL,),
+    "schema_read": (STATE_NORMAL, STATE_DEGRADED, STATE_RESIZING, STATE_STARTING),
+    "resize": (STATE_NORMAL, STATE_DEGRADED, STATE_RESIZING),
+}
+
+
+class API:
+    def __init__(self, holder: Holder, cluster: Cluster,
+                 executor: Optional[Executor] = None,
+                 translate_store: Optional[TranslateStore] = None):
+        self.holder = holder
+        self.cluster = cluster
+        self.translate = translate_store or TranslateStore().open()
+        self.executor = executor or Executor(holder, translator=self.translate)
+        if self.executor.translator is None:
+            self.executor.translator = self.translate
+        # DDL broadcast hook; set by Server on multi-node clusters
+        # (broadcaster.SendSync, broadcast.go:30)
+        self.broadcast_fn = None
+
+    def _broadcast(self, msg: dict) -> None:
+        if self.broadcast_fn is not None:
+            self.broadcast_fn(msg)
+
+    # -- validation ---------------------------------------------------------
+
+    def _validate(self, gate: str) -> None:
+        allowed = _STATE_GATES.get(gate, (STATE_NORMAL, STATE_DEGRADED))
+        if self.cluster.state not in allowed:
+            raise ApiError(
+                f"api method unavailable in cluster state {self.cluster.state}",
+                status=503)
+
+    # -- queries ------------------------------------------------------------
+
+    def query(self, index_name: str, pql: str,
+              shards: Optional[list[int]] = None, remote: bool = False) -> dict:
+        """POST /index/{index}/query (api.Query, api.go:102)."""
+        self._validate("query")
+        index = self.holder.index(index_name)
+        if index is None:
+            raise NotFoundError(f"index not found: {index_name}")
+        try:
+            results = self.executor.execute(index_name, pql, shards=shards,
+                                            remote=remote)
+        except (ExecutionError, ValueError) as e:
+            raise ApiError(str(e))
+        return {"results": [self._result_to_json(index, r) for r in results]}
+
+    def _result_to_json(self, index, result):
+        if isinstance(result, Row):
+            d = result.to_json_dict()
+            if index.keys:
+                d["keys"] = [
+                    self.translate.translate_column_to_string(index.name, int(c)) or str(c)
+                    for c in d.pop("columns")
+                ]
+            if "attrs" not in d:
+                d["attrs"] = {}
+            return d
+        if isinstance(result, ValCount):
+            return result.to_json_dict()
+        if isinstance(result, Pairs):
+            return [{"id": i, "count": c} for i, c in result]
+        if isinstance(result, RowIdentifiers):
+            return {"rows": list(result)}
+        if isinstance(result, GroupCounts):
+            return list(result)
+        if isinstance(result, list):
+            # untyped list (shouldn't happen from the executor, but keep the
+            # legacy heuristics as a fallback)
+            if result and isinstance(result[0], tuple):
+                return [{"id": i, "count": c} for i, c in result]
+            return result
+        if result is None:
+            return None
+        return result  # bool / int
+
+    # -- schema DDL ---------------------------------------------------------
+
+    def create_index(self, name: str, keys: bool = False,
+                     track_existence: bool = True):
+        self._validate("write")
+        if self.holder.index(name) is not None:
+            raise ConflictError(f"index already exists: {name}")
+        try:
+            idx = self.holder.create_index(name, keys=keys,
+                                           track_existence=track_existence)
+        except ValueError as e:
+            raise ApiError(str(e))
+        self._broadcast({"type": "create-index", "index": name, "keys": keys,
+                         "trackExistence": track_existence})
+        return idx
+
+    def delete_index(self, name: str) -> None:
+        self._validate("write")
+        try:
+            self.holder.delete_index(name)
+        except KeyError as e:
+            raise NotFoundError(str(e))
+        self._broadcast({"type": "delete-index", "index": name})
+
+    def create_field(self, index_name: str, field_name: str,
+                     options: Optional[FieldOptions] = None):
+        self._validate("write")
+        index = self.holder.index(index_name)
+        if index is None:
+            raise NotFoundError(f"index not found: {index_name}")
+        if index.field(field_name) is not None:
+            raise ConflictError(f"field already exists: {field_name}")
+        try:
+            f = index.create_field(field_name, options)
+        except ValueError as e:
+            raise ApiError(str(e))
+        from dataclasses import asdict
+        self._broadcast({"type": "create-field", "index": index_name,
+                         "field": field_name,
+                         "options": asdict(f.options)})
+        return f
+
+    def delete_field(self, index_name: str, field_name: str) -> None:
+        self._validate("write")
+        index = self.holder.index(index_name)
+        if index is None:
+            raise NotFoundError(f"index not found: {index_name}")
+        try:
+            index.delete_field(field_name)
+        except KeyError as e:
+            raise NotFoundError(str(e))
+        self._broadcast({"type": "delete-field", "index": index_name,
+                         "field": field_name})
+
+    def schema(self) -> dict:
+        self._validate("schema_read")
+        return {"indexes": self.holder.schema()}
+
+    def views(self, index_name: str, field_name: str) -> list[str]:
+        self._validate("schema_read")
+        f = self._field(index_name, field_name)
+        return sorted(f.views)
+
+    def _field(self, index_name: str, field_name: str):
+        index = self.holder.index(index_name)
+        if index is None:
+            raise NotFoundError(f"index not found: {index_name}")
+        f = index.field(field_name)
+        if f is None:
+            raise NotFoundError(f"field not found: {field_name}")
+        return f
+
+    # -- imports (api.go:804-1045) ------------------------------------------
+
+    def import_bits(self, index_name: str, field_name: str,
+                    row_ids=None, column_ids=None,
+                    row_keys=None, column_keys=None,
+                    timestamps=None) -> None:
+        self._validate("write")
+        index = self.holder.index(index_name)
+        if index is None:
+            raise NotFoundError(f"index not found: {index_name}")
+        f = self._field(index_name, field_name)
+        if row_keys:
+            row_ids = self.translate.translate_rows(index_name, field_name, list(row_keys))
+        if column_keys:
+            column_ids = self.translate.translate_columns(index_name, list(column_keys))
+        if row_ids is None or column_ids is None:
+            raise ApiError("import requires rows and columns")
+        ts = None
+        if timestamps:
+            ts = [datetime.fromtimestamp(t, tz=timezone.utc).replace(tzinfo=None)
+                  if isinstance(t, (int, float)) and t else
+                  (t if isinstance(t, datetime) else None)
+                  for t in timestamps]
+        f.import_bits(list(row_ids), list(column_ids), ts)
+        self._import_existence(index, column_ids)
+
+    def import_values(self, index_name: str, field_name: str,
+                      column_ids=None, values=None, column_keys=None) -> None:
+        self._validate("write")
+        index = self.holder.index(index_name)
+        if index is None:
+            raise NotFoundError(f"index not found: {index_name}")
+        f = self._field(index_name, field_name)
+        if column_keys:
+            column_ids = self.translate.translate_columns(index_name, list(column_keys))
+        if column_ids is None or values is None:
+            raise ApiError("import requires columns and values")
+        try:
+            f.import_values(list(column_ids), list(values))
+        except ValueError as e:
+            raise ApiError(str(e))
+        self._import_existence(index, column_ids)
+
+    def import_roaring(self, index_name: str, field_name: str, shard: int,
+                       views: dict[str, bytes], clear: bool = False) -> None:
+        """POST /index/{i}/field/{f}/import-roaring/{shard}: pre-serialized
+        roaring payloads per view (api.go:290)."""
+        self._validate("write")
+        f = self._field(index_name, field_name)
+        for vname, data in views.items():
+            vname = vname or VIEW_STANDARD
+            view = f.create_view_if_not_exists(vname)
+            frag = view.create_fragment_if_not_exists(shard)
+            try:
+                frag.import_roaring(data, clear=clear)
+            except ValueError as e:
+                raise ApiError(f"unmarshalling roaring data: {e}")
+            view.refresh_rank_cache(shard)
+        f.add_available_shard(shard)
+
+    def _import_existence(self, index, column_ids) -> None:
+        ef = index.existence_field()
+        if ef is not None and column_ids is not None and len(column_ids):
+            ef.import_bits([0] * len(column_ids), list(column_ids))
+
+    # -- export (api.go ExportCSV) ------------------------------------------
+
+    def export_csv(self, index_name: str, field_name: str, shard: int) -> str:
+        self._validate("query")
+        f = self._field(index_name, field_name)
+        view = f.view(VIEW_STANDARD)
+        buf = io.StringIO()
+        w = csv.writer(buf)
+        frag = view.fragment(shard) if view else None
+        if frag is not None:
+            for rid in frag.row_ids():
+                for col in frag.row_columns(rid):
+                    w.writerow([rid, int(col) + shard * SHARD_WIDTH])
+        return buf.getvalue()
+
+    # -- cluster / info -----------------------------------------------------
+
+    def hosts(self) -> list[dict]:
+        return [n.to_dict() for n in self.cluster.nodes]
+
+    def node(self) -> dict:
+        n = self.cluster.local_node
+        return n.to_dict() if n else {"id": self.cluster.local_id}
+
+    def state(self) -> str:
+        return self.cluster.state
+
+    def status(self) -> dict:
+        return {"state": self.cluster.state, "nodes": self.hosts(),
+                "localID": self.cluster.local_id}
+
+    def info(self) -> dict:
+        import os
+        return {"shardWidth": SHARD_WIDTH, "cpuPhysicalCores": os.cpu_count(),
+                "version": __version__}
+
+    def version(self) -> str:
+        return __version__
+
+    def max_shards(self) -> dict[str, int]:
+        """GET /internal/shards/max (api.go MaxShards)."""
+        out = {}
+        for name, idx in self.holder.indexes.items():
+            m = idx.available_shards().max()
+            out[name] = int(m) if m is not None else 0
+        return out
+
+    def shard_nodes(self, index_name: str, shard: int) -> list[dict]:
+        return [n.to_dict() for n in self.cluster.shard_nodes(index_name, shard)]
+
+    def set_coordinator(self, node_id: str) -> None:
+        self._validate("resize")
+        if self.cluster.node_by_id(node_id) is None:
+            raise NotFoundError(f"node not found: {node_id}")
+        self.cluster.coordinator_id = node_id
+
+    def remove_node(self, node_id: str):
+        self._validate("resize")
+        if self.cluster.node_by_id(node_id) is None:
+            raise NotFoundError(f"node not found: {node_id}")
+        return self.cluster.node_leave(node_id)
+
+    def resize_abort(self) -> None:
+        if self.cluster.state != STATE_RESIZING:
+            raise ApiError("no resize job currently running")
+        self.cluster.abort_resize()
+
+    def recalculate_caches(self) -> None:
+        for idx in self.holder.indexes.values():
+            for f in idx.fields.values():
+                for v in f.views.values():
+                    for shard in v.shards():
+                        v.refresh_rank_cache(shard)
+
+    # -- fragment internals (anti-entropy RPC surface) ----------------------
+
+    def fragment_blocks(self, index_name: str, field_name: str, view_name: str,
+                        shard: int) -> list[dict]:
+        f = self._field(index_name, field_name)
+        view = f.view(view_name)
+        frag = view.fragment(shard) if view else None
+        if frag is None:
+            raise NotFoundError("fragment not found")
+        return [{"id": b, "checksum": chk.hex()} for b, chk in frag.blocks()]
+
+    def fragment_block_data(self, index_name: str, field_name: str,
+                            view_name: str, shard: int, block: int) -> dict:
+        f = self._field(index_name, field_name)
+        view = f.view(view_name)
+        frag = view.fragment(shard) if view else None
+        if frag is None:
+            raise NotFoundError("fragment not found")
+        rows, cols = frag.block_data(block)
+        return {"rowIDs": rows.tolist(), "columnIDs": cols.tolist()}
+
+    def fragment_data(self, index_name: str, field_name: str, view_name: str,
+                      shard: int) -> bytes:
+        f = self._field(index_name, field_name)
+        view = f.view(view_name)
+        frag = view.fragment(shard) if view else None
+        if frag is None:
+            raise NotFoundError("fragment not found")
+        return frag.storage.to_bytes()
+
+    def delete_remote_available_shard(self, index_name: str, field_name: str,
+                                      shard: int) -> None:
+        f = self._field(index_name, field_name)
+        f.remove_available_shard(shard)
+
+    # -- translation --------------------------------------------------------
+
+    def translate_keys(self, index_name: str, field_name: Optional[str],
+                       keys: list[str]) -> list[int]:
+        if field_name:
+            return self.translate.translate_rows(index_name, field_name, keys)
+        return self.translate.translate_columns(index_name, keys)
+
+    def translate_data(self, offset: int = 0) -> bytes:
+        return self.translate.log_bytes(offset)

@@ -1,0 +1,6 @@
+"""CLI: the `pilosa-tpu` command family.
+
+Reference: cmd/ (cobra root), ctl/ (import/export/inspect/check/config
+subcommands), server/config.go (TOML + env + flags precedence).
+Run as `python -m pilosa_tpu.cli <subcommand>`.
+"""

@@ -1,0 +1,149 @@
+"""Server configuration: defaults <- TOML file <- env <- flags.
+
+Reference: server/config.go:36-105 (the flag surface) and cmd/root.go:91-120
+(viper merge order). Env vars use the PILOSA_TPU_ prefix with dots mapped to
+underscores (PILOSA_TPU_CLUSTER_REPLICAS, matching the reference's PILOSA_*).
+"""
+
+from __future__ import annotations
+
+import os
+import tomllib
+from dataclasses import dataclass, field
+
+
+@dataclass
+class ClusterConfig:
+    disabled: bool = True
+    coordinator: bool = False
+    replicas: int = 1
+    hosts: list[str] = field(default_factory=list)
+    long_query_time: float = 0.0
+
+
+@dataclass
+class AntiEntropyConfig:
+    interval: float = 0.0  # seconds; 0 disables (server.go:430-445)
+
+
+@dataclass
+class MetricConfig:
+    service: str = "expvar"  # expvar | nop
+    poll_interval: float = 0.0
+
+
+@dataclass
+class TracingConfig:
+    sampler_type: str = "off"
+    sampler_param: float = 0.0
+    agent_host_port: str = ""
+
+
+@dataclass
+class Config:
+    data_dir: str = "~/.pilosa-tpu"
+    bind: str = "localhost:10101"
+    max_writes_per_request: int = 5000
+    log_path: str = ""
+    verbose: bool = False
+    cluster: ClusterConfig = field(default_factory=ClusterConfig)
+    anti_entropy: AntiEntropyConfig = field(default_factory=AntiEntropyConfig)
+    metric: MetricConfig = field(default_factory=MetricConfig)
+    tracing: TracingConfig = field(default_factory=TracingConfig)
+
+    @property
+    def host(self) -> str:
+        return self.bind.rsplit(":", 1)[0] or "localhost"
+
+    @property
+    def port(self) -> int:
+        tail = self.bind.rsplit(":", 1)
+        return int(tail[1]) if len(tail) == 2 and tail[1] else 10101
+
+    # -- merge layers -------------------------------------------------------
+
+    def apply_toml(self, path: str) -> None:
+        with open(path, "rb") as f:
+            data = tomllib.load(f)
+        self._apply_dict(data)
+
+    def _apply_dict(self, data: dict) -> None:
+        for key, value in data.items():
+            attr = key.replace("-", "_")
+            if attr in ("cluster", "anti_entropy", "metric", "tracing") and isinstance(value, dict):
+                sub = getattr(self, attr)
+                for k, v in value.items():
+                    sk = k.replace("-", "_")
+                    if hasattr(sub, sk):
+                        setattr(sub, sk, v)
+            elif hasattr(self, attr):
+                setattr(self, attr, value)
+
+    def apply_env(self, environ=None) -> None:
+        environ = environ if environ is not None else os.environ
+        prefix = "PILOSA_TPU_"
+        for name, raw in environ.items():
+            if not name.startswith(prefix):
+                continue
+            parts = name[len(prefix):].lower().split("_")
+            self._set_path(parts, raw)
+
+    def _set_path(self, parts: list[str], raw: str) -> None:
+        # try sub-config first (cluster_replicas -> cluster.replicas)
+        for sub_name in ("cluster", "anti_entropy", "metric", "tracing"):
+            sub_parts = sub_name.split("_")
+            if parts[: len(sub_parts)] == sub_parts and len(parts) > len(sub_parts):
+                sub = getattr(self, sub_name)
+                attr = "_".join(parts[len(sub_parts):])
+                if hasattr(sub, attr):
+                    setattr(sub, attr, _coerce(raw, getattr(sub, attr)))
+                return
+        attr = "_".join(parts)
+        if hasattr(self, attr):
+            setattr(self, attr, _coerce(raw, getattr(self, attr)))
+
+    def to_toml(self) -> str:
+        lines = [
+            f'data-dir = "{self.data_dir}"',
+            f'bind = "{self.bind}"',
+            f"max-writes-per-request = {self.max_writes_per_request}",
+            f"verbose = {str(self.verbose).lower()}",
+            "",
+            "[cluster]",
+            f"disabled = {str(self.cluster.disabled).lower()}",
+            f"replicas = {self.cluster.replicas}",
+            f"hosts = [{', '.join(repr(h) for h in self.cluster.hosts)}]",
+            "",
+            "[anti-entropy]",
+            f"interval = {self.anti_entropy.interval}",
+            "",
+            "[metric]",
+            f'service = "{self.metric.service}"',
+            f"poll-interval = {self.metric.poll_interval}",
+            "",
+            "[tracing]",
+            f'sampler-type = "{self.tracing.sampler_type}"',
+            f"sampler-param = {self.tracing.sampler_param}",
+            f'agent-host-port = "{self.tracing.agent_host_port}"',
+        ]
+        return "\n".join(lines) + "\n"
+
+
+def _coerce(raw: str, current):
+    if isinstance(current, bool):
+        return raw.lower() in ("1", "true", "yes", "on")
+    if isinstance(current, int):
+        return int(raw)
+    if isinstance(current, float):
+        return float(raw)
+    if isinstance(current, list):
+        return [s for s in raw.split(",") if s]
+    return raw
+
+
+def load_config(config_path=None, environ=None) -> Config:
+    cfg = Config()
+    if config_path:
+        cfg.apply_toml(config_path)
+    cfg.apply_env(environ)
+    return cfg

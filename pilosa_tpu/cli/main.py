@@ -1,0 +1,258 @@
+"""`pilosa-tpu` command family: server / import / export / inspect / check /
+config / generate-config.
+
+Reference: cmd/*.go (cobra subcommands), ctl/*.go (implementations).
+"""
+
+from __future__ import annotations
+
+import argparse
+import csv
+import json
+import signal
+import sys
+import threading
+import urllib.request
+
+from pilosa_tpu import __version__
+from pilosa_tpu.cli.config import Config, load_config
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="pilosa-tpu",
+                                description="TPU-native distributed bitmap index")
+    p.add_argument("--version", action="version", version=__version__)
+    sub = p.add_subparsers(dest="command", required=True)
+
+    sp = sub.add_parser("server", help="run a node")
+    sp.add_argument("--config", help="TOML config file")
+    sp.add_argument("--data-dir", help="data directory")
+    sp.add_argument("--bind", help="host:port to listen on")
+    sp.add_argument("--cluster-hosts", help="comma-separated peer URIs")
+    sp.add_argument("--cluster-replicas", type=int, help="replica count")
+    sp.add_argument("--anti-entropy-interval", type=float,
+                    help="seconds between anti-entropy passes (0 = off)")
+    sp.add_argument("--verbose", action="store_true")
+
+    ip = sub.add_parser("import", help="bulk-import CSV (row,col or col,value)")
+    ip.add_argument("--host", default="http://localhost:10101")
+    ip.add_argument("--index", required=True)
+    ip.add_argument("--field", required=True)
+    ip.add_argument("--field-type", default="set", choices=["set", "int"])
+    ip.add_argument("--create", action="store_true",
+                    help="create index/field if missing")
+    ip.add_argument("--batch-size", type=int, default=100000)
+    ip.add_argument("--min", type=int, default=0)
+    ip.add_argument("--max", type=int, default=0)
+    ip.add_argument("files", nargs="+")
+
+    ep = sub.add_parser("export", help="export a field as CSV")
+    ep.add_argument("--host", default="http://localhost:10101")
+    ep.add_argument("--index", required=True)
+    ep.add_argument("--field", required=True)
+    ep.add_argument("-o", "--output", help="output file (default stdout)")
+
+    np_ = sub.add_parser("inspect", help="dump fragment file stats offline")
+    np_.add_argument("path")
+
+    cp = sub.add_parser("check", help="integrity-check fragment files offline")
+    cp.add_argument("paths", nargs="+")
+
+    cfgp = sub.add_parser("config", help="print parsed config")
+    cfgp.add_argument("--config", help="TOML config file")
+
+    sub.add_parser("generate-config", help="print default TOML config")
+    return p
+
+
+# ---------------------------------------------------------------------------
+
+
+def cmd_server(args) -> int:
+    try:
+        cfg = load_config(args.config)
+    except (OSError, ValueError) as e:
+        raise SystemExit(f"error: loading config: {e}")
+    if args.data_dir:
+        cfg.data_dir = args.data_dir
+    if args.bind:
+        cfg.bind = args.bind
+    if args.cluster_hosts:
+        cfg.cluster.hosts = args.cluster_hosts.split(",")
+        cfg.cluster.disabled = False
+    if args.cluster_replicas is not None:
+        cfg.cluster.replicas = args.cluster_replicas
+    if args.anti_entropy_interval is not None:
+        cfg.anti_entropy.interval = args.anti_entropy_interval
+
+    import os
+    from pilosa_tpu.server import Server
+    data_dir = os.path.expanduser(cfg.data_dir)
+    server = Server(
+        data_dir, host=cfg.host, port=cfg.port,
+        cluster_hosts=cfg.cluster.hosts if not cfg.cluster.disabled else None,
+        replica_n=cfg.cluster.replicas,
+        anti_entropy_interval=cfg.anti_entropy.interval,
+    ).open()
+    print(f"pilosa-tpu {__version__} serving at {server.uri} "
+          f"(data: {data_dir}, node: {server.node_id})", flush=True)
+
+    stop = threading.Event()
+
+    def _sig(_s, _f):
+        stop.set()
+
+    signal.signal(signal.SIGINT, _sig)
+    signal.signal(signal.SIGTERM, _sig)
+    try:
+        stop.wait()
+    finally:
+        server.close()
+    return 0
+
+
+def _post(host: str, path: str, payload=None, raw=None) -> dict:
+    body = raw if raw is not None else json.dumps(payload or {}).encode()
+    req = urllib.request.Request(host + path, data=body, method="POST")
+    try:
+        with urllib.request.urlopen(req, timeout=60) as resp:
+            out = resp.read()
+            return json.loads(out) if out else {}
+    except urllib.error.HTTPError as e:
+        detail = e.read().decode(errors="replace")
+        raise SystemExit(f"error: {path}: {e.code}: {detail}")
+
+
+def cmd_import(args) -> int:
+    if args.create:
+        _post_tolerant(args.host, f"/index/{args.index}")
+        opts = {"options": {"type": args.field_type}}
+        if args.field_type == "int":
+            opts["options"].update(min=args.min, max=args.max)
+        _post_tolerant(args.host, f"/index/{args.index}/field/{args.field}", opts)
+
+    total = 0
+    batch_a, batch_b = [], []
+
+    def flush():
+        nonlocal total
+        if not batch_a:
+            return
+        if args.field_type == "int":
+            payload = {"columnIDs": batch_a, "values": batch_b}
+        else:
+            payload = {"rowIDs": batch_a, "columnIDs": batch_b}
+        _post(args.host, f"/index/{args.index}/field/{args.field}/import", payload)
+        total += len(batch_a)
+        batch_a.clear()
+        batch_b.clear()
+
+    for fname in args.files:
+        fh = sys.stdin if fname == "-" else open(fname)
+        with fh:
+            for rowno, row in enumerate(csv.reader(fh), 1):
+                if not row:
+                    continue
+                if len(row) < 2:
+                    raise SystemExit(f"error: {fname}:{rowno}: expected 2+ columns")
+                batch_a.append(int(row[0]))
+                batch_b.append(int(row[1]))
+                if len(batch_a) >= args.batch_size:
+                    flush()
+    flush()
+    print(f"imported {total} records into {args.index}/{args.field}")
+    return 0
+
+
+def _post_tolerant(host: str, path: str, payload=None) -> None:
+    """POST ignoring 409 conflict (create-if-not-exists)."""
+    req = urllib.request.Request(host + path,
+                                 data=json.dumps(payload or {}).encode(),
+                                 method="POST")
+    try:
+        urllib.request.urlopen(req, timeout=60).read()
+    except urllib.error.HTTPError as e:
+        if e.code != 409:
+            raise SystemExit(f"error: {path}: {e.code}: {e.read().decode(errors='replace')}")
+
+
+def cmd_export(args) -> int:
+    # discover shards, then stream each via /export
+    with urllib.request.urlopen(args.host + "/internal/shards/max", timeout=60) as resp:
+        max_shards = json.loads(resp.read())["standard"]
+    out = open(args.output, "w") if args.output else sys.stdout
+    try:
+        for shard in range(max_shards.get(args.index, 0) + 1):
+            url = (f"{args.host}/export?index={args.index}"
+                   f"&field={args.field}&shard={shard}")
+            with urllib.request.urlopen(url, timeout=60) as resp:
+                out.write(resp.read().decode())
+    finally:
+        if args.output:
+            out.close()
+    return 0
+
+
+def cmd_inspect(args) -> int:
+    from pilosa_tpu.storage.roaring import Bitmap
+    with open(args.path, "rb") as f:
+        data = f.read()
+    b = Bitmap.from_bytes(data)
+    kinds = {}
+    for c in b.containers.values():
+        kinds[c.kind] = kinds.get(c.kind, 0) + 1
+    print(json.dumps({
+        "path": args.path,
+        "bytes": len(data),
+        "bits": b.count(),
+        "containers": len(b.containers),
+        "containerKinds": kinds,
+        "opN": b.op_n,
+        "min": b.min(),
+        "max": b.max(),
+    }, indent=2))
+    return 0
+
+
+def cmd_check(args) -> int:
+    from pilosa_tpu.storage.roaring import Bitmap
+    failed = 0
+    for path in args.paths:
+        try:
+            with open(path, "rb") as f:
+                b = Bitmap.from_bytes(f.read())
+            b.check()
+            print(f"{path}: OK ({b.count()} bits)")
+        except (ValueError, OSError) as e:
+            failed += 1
+            print(f"{path}: FAILED: {e}")
+    return 1 if failed else 0
+
+
+def cmd_config(args) -> int:
+    cfg = load_config(getattr(args, "config", None))
+    print(cfg.to_toml(), end="")
+    return 0
+
+
+def cmd_generate_config(_args) -> int:
+    print(Config().to_toml(), end="")
+    return 0
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    handler = {
+        "server": cmd_server,
+        "import": cmd_import,
+        "export": cmd_export,
+        "inspect": cmd_inspect,
+        "check": cmd_check,
+        "config": cmd_config,
+        "generate-config": cmd_generate_config,
+    }[args.command]
+    return handler(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -51,6 +51,18 @@ class ExecutionError(ValueError):
     pass
 
 
+class Pairs(list):
+    """TopN result: [(row_id, count)] (reference Pairs, cache.go:317)."""
+
+
+class RowIdentifiers(list):
+    """Rows result: sorted row ids (reference RowIdentifiers)."""
+
+
+class GroupCounts(list):
+    """GroupBy result: [{"group": [...], "count": n}] (reference GroupCounts)."""
+
+
 class ValCount:
     """Sum/Min/Max result (reference ValCount, executor.go:363)."""
 
@@ -72,10 +84,19 @@ class ValCount:
 
 class Executor:
     def __init__(self, holder, runner: Optional[DeviceRunner] = None,
-                 translator=None):
+                 translator=None, cluster=None, client=None):
         self.holder = holder
         self.runner = runner or DeviceRunner()
         self.translator = translator
+        # multi-node fan-out (None -> purely local execution)
+        self.cluster = cluster
+        self.client = client
+        # observability (nop defaults; reference: executor per-call counters
+        # executor.go:258-293, spans executor.go:85)
+        from pilosa_tpu.utils.stats import NopStatsClient
+        from pilosa_tpu.utils import tracing
+        self.stats = NopStatsClient()
+        self.tracer = tracing.global_tracer
         # device slab cache: (index, field, view, shard, row, generation) ->
         # host dense row; slabs assembled per query then device_put (the
         # HBM residency layer; see DeviceRunner.put_slab)
@@ -83,9 +104,12 @@ class Executor:
 
     # ------------------------------------------------------------------ API
 
-    def execute(self, index_name: str, query, shards: Optional[list[int]] = None):
+    def execute(self, index_name: str, query, shards: Optional[list[int]] = None,
+                remote: bool = False):
         """Execute a PQL query; returns a list of per-call results
-        (executor.Execute, executor.go:84)."""
+        (executor.Execute, executor.go:84). `remote=True` marks a fan-out
+        sub-request: execute locally on exactly the given shards
+        (opt.Remote, executor.go:2147)."""
         if isinstance(query, str):
             query = parse_string(query)
         if not isinstance(query, Query):
@@ -93,9 +117,18 @@ class Executor:
         index = self.holder.index(index_name)
         if index is None:
             raise ExecutionError(f"index not found: {index_name}")
+        distributed = (not remote and self.cluster is not None
+                       and self.client is not None
+                       and len(self.cluster.nodes) > 1)
         results = []
         for call in query.calls:
-            results.append(self._execute_call(index, call, shards))
+            self.stats.count(f"query/{call.name}")
+            with self.tracer.start_span(f"executor.{call.name}") as span:
+                if distributed:
+                    results.append(self._execute_distributed(index, call, shards))
+                else:
+                    results.append(self._execute_call(index, call, shards))
+                span.set_tag("index", index_name)
         return results
 
     # ------------------------------------------------------------ dispatch
@@ -415,7 +448,7 @@ class Executor:
             # phase 2: recount the top ~n ids exactly across all shards —
             # already exact here since candidates span all query shards.
             merged = merged[:n]
-        return [(i, c) for i, c in merged if c > 0]
+        return Pairs((i, c) for i, c in merged if c > 0)
 
     def _topn_candidates(self, index: Index, f, shards, ids_arg) -> list[int]:
         if ids_arg is not None:
@@ -492,7 +525,7 @@ class Executor:
             rows = [r for r in rows if r > previous]
         if limit is not None:
             rows = rows[:limit]
-        return rows
+        return RowIdentifiers(rows)
 
     def _execute_group_by(self, index: Index, call: Call, shards) -> list[dict]:
         """GroupBy(Rows(...), ..., limit=, filter=) — cross product of row
@@ -549,7 +582,7 @@ class Executor:
                 recurse(i + 1, nxt, group + [(fname, rid)])
 
         recurse(0, None, [])
-        return results
+        return GroupCounts(results)
 
     # -------------------------------------------------------------- writes
 
@@ -641,6 +674,223 @@ class Executor:
         col = self._translate_col(index, call.args["_col"])
         attrs = {k: v for k, v in call.args.items() if not k.startswith("_")}
         index.column_attrs.set_attrs(col, attrs)
+
+    # --------------------------------------------- distributed fan-out
+    # The reference's mapReduce (executor.go:2183-2321): shards grouped by
+    # owning node, the PQL string re-sent to remote nodes with Remote=true,
+    # failures re-mapped onto replicas, results reduced associatively.
+
+    WRITE_CALLS = frozenset({"Set", "Clear", "ClearRow", "Store",
+                             "SetRowAttrs", "SetColumnAttrs"})
+
+    def _execute_distributed(self, index: Index, call: Call, shards):
+        # Unwrap Options() BEFORE fan-out — the wrapper is not an associative
+        # reduce; its shards= / excludeColumns apply around the inner call.
+        if call.name == "Options":
+            if len(call.children) != 1:
+                raise ExecutionError("Options() takes exactly one query argument")
+            if call.args.get("shards") is not None:
+                shards = [int(s) for s in call.uint_slice_arg("shards")]
+            result = self._execute_distributed(index, call.children[0], shards)
+            if call.bool_arg("excludeColumns") and isinstance(result, Row):
+                result = Row()
+            return result
+        if call.name in self.WRITE_CALLS:
+            return self._execute_write_distributed(index, call, shards)
+        fan_call = call
+        if call.name == "GroupBy" and call.uint_arg("limit") is not None:
+            # per-node truncation breaks the merge; limit applies post-reduce
+            fan_call = Call(call.name,
+                            {k: v for k, v in call.args.items() if k != "limit"},
+                            call.children)
+        qshards = self._query_shards(index, shards)
+        groups = self.cluster.shards_by_node(index.name, qshards)
+        partials = []
+        for node_id, node_shards in groups.items():
+            partials.extend(
+                self._map_node(index, fan_call, node_id, node_shards, set()))
+        return self._reduce(call, partials, index, shards)
+
+    def _map_node(self, index: Index, call: Call, node_id: str,
+                  node_shards: list[int], excluded: set) -> list:
+        """Execute `call` for node_shards on node_id; on failure, re-map each
+        shard onto its next live replica individually (executor.go:2216-2231).
+        Returns a list of partials."""
+        from pilosa_tpu.net.client import ClientError
+        if node_id == self.cluster.local_id:
+            return [self._execute_call(index, call, node_shards)]
+        node = self.cluster.node_by_id(node_id)
+        err: Exception | None = None
+        if node is not None and node.uri:
+            try:
+                resp = self.client.query(node.uri, index.name, call.to_pql(),
+                                         shards=node_shards, remote=True)
+                return [self._result_from_json(index, call, resp["results"][0])]
+            except ClientError as e:
+                err = e
+        # failover: per-shard re-mapping onto surviving replicas
+        excluded = excluded | {node_id}
+        regroup: dict[str, list[int]] = {}
+        for s in node_shards:
+            cand = next((n.id for n in self.cluster.shard_nodes(index.name, s)
+                         if n.id not in excluded), None)
+            if cand is None:
+                raise ExecutionError(
+                    f"shard {s} unavailable on all replicas: {err}")
+            regroup.setdefault(cand, []).append(s)
+        partials = []
+        for cand, cand_shards in regroup.items():
+            partials.extend(self._map_node(index, call, cand, cand_shards,
+                                           excluded))
+        return partials
+
+    def _execute_write_distributed(self, index: Index, call: Call, shards):
+        """Set/Clear/SetColumnAttrs fan out to every replica of the column's
+        shard (executeSetBitField, executor.go:1865-1895); Store/ClearRow are
+        per-shard ops routed like reads; SetRowAttrs broadcasts (row attr
+        stores are per-node replicas)."""
+        from pilosa_tpu.net.client import ClientError
+        pql = call.to_pql()
+
+        if call.name in ("Store", "ClearRow"):
+            qshards = self._query_shards(index, shards)
+            groups = self.cluster.shards_by_node(index.name, qshards)
+            partials = []
+            for node_id, node_shards in groups.items():
+                # writes also land on replicas of each shard
+                replica_targets: dict[str, list[int]] = {}
+                for s in node_shards:
+                    for n in self.cluster.shard_nodes(index.name, s):
+                        replica_targets.setdefault(n.id, []).append(s)
+                for rid, rshards in replica_targets.items():
+                    if rid == self.cluster.local_id:
+                        partials.append(self._execute_call(index, call, rshards))
+                    else:
+                        node = self.cluster.node_by_id(rid)
+                        try:
+                            resp = self.client.query(node.uri, index.name, pql,
+                                                     shards=rshards, remote=True)
+                            partials.append(self._result_from_json(
+                                index, call, resp["results"][0]))
+                        except ClientError as e:
+                            raise ExecutionError(f"replica write failed: {e}")
+            return any(bool(p) for p in partials)
+
+        if call.name in ("Set", "Clear", "SetColumnAttrs"):
+            col = self._translate_col(index, call.args["_col"])
+            targets = self.cluster.shard_nodes(index.name, col // SHARD_WIDTH)
+        else:  # SetRowAttrs
+            targets = self.cluster.nodes
+        result = None
+        for node in targets:
+            if node.id == self.cluster.local_id:
+                r = self._execute_call(index, call, None)
+            else:
+                try:
+                    resp = self.client.query(node.uri, index.name, pql,
+                                             shards=None, remote=True)
+                    r = self._result_from_json(index, call, resp["results"][0])
+                except ClientError as e:
+                    raise ExecutionError(f"replica write failed: {e}")
+            result = r if result is None else (result or r)
+        return result
+
+    def _result_from_json(self, index: Index, call: Call, obj):
+        """Inverse of the API's JSON encoding, per call type — remote
+        responses come back as JSON (QueryResponse union,
+        internal/public.proto:62-88)."""
+        if call.name == "Count":
+            return int(obj)
+        if call.name in ("Sum", "Min", "Max"):
+            return ValCount(obj.get("value", 0), obj.get("count", 0))
+        if call.name == "TopN":
+            return Pairs((p["id"], p["count"]) for p in obj) \
+                if isinstance(obj, list) else Pairs()
+        if call.name == "Rows":
+            return RowIdentifiers(
+                obj.get("rows", []) if isinstance(obj, dict) else obj)
+        if call.name == "GroupBy":
+            return GroupCounts(obj if isinstance(obj, list) else [])
+        if call.name in BITMAP_CALLS:
+            if not isinstance(obj, dict):
+                return Row()
+            if "keys" in obj and self.translator is not None:
+                # keyed index: the node JSON-encodes columns as keys
+                cols = [self.translator.translate_column(index.name, k)
+                        for k in obj["keys"]]
+            else:
+                cols = obj.get("columns", [])
+            return Row(np.array(cols, dtype=np.uint64))
+        return obj
+
+    def _reduce(self, call: Call, partials: list, index: Optional[Index] = None,
+                shards: Optional[list[int]] = None):
+        """Associative reduce (reduceFn, executor.go:2209-2242)."""
+        if not partials:
+            raise ExecutionError("no shards to execute")
+        if call.name == "Count":
+            return sum(partials)
+        if call.name == "Sum":
+            return ValCount(sum(p.val for p in partials),
+                            sum(p.count for p in partials))
+        if call.name in ("Min", "Max"):
+            best = None
+            for p in partials:
+                if p.count == 0:
+                    continue
+                if best is None:
+                    best = ValCount(p.val, p.count)
+                elif p.val == best.val:
+                    best.count += p.count
+                elif (call.name == "Min") == (p.val < best.val):
+                    best = ValCount(p.val, p.count)
+            return best or ValCount(0, 0)
+        if call.name == "TopN":
+            merged = merge_pairs(partials)
+            n = call.uint_arg("n")
+            if n is not None and call.uint_slice_arg("ids") is None and index is not None:
+                # phase 2: exact recount of winning ids on the query's shards
+                # (executor.go:694-761)
+                ids = [i for i, _ in merged[:n]]
+                return self._recount_topn(index, call, ids, shards)
+            return Pairs(merged)
+        if call.name == "Rows":
+            out = sorted(set().union(*[set(p) for p in partials]))
+            limit = call.uint_arg("limit")
+            return RowIdentifiers(out[:limit] if limit is not None else out)
+        if call.name == "GroupBy":
+            acc: dict[str, dict] = {}
+            for p in partials:
+                for g in p:
+                    key = str(g["group"])
+                    if key in acc:
+                        acc[key]["count"] += g["count"]
+                    else:
+                        acc[key] = dict(g)
+            out = sorted(acc.values(), key=lambda g: [
+                (x["field"], x["rowID"]) for x in g["group"]])
+            limit = call.uint_arg("limit")
+            return GroupCounts(out[:limit] if limit is not None else out)
+        if call.name in BITMAP_CALLS:
+            out = partials[0]
+            for p in partials[1:]:
+                out = out.merge(p)
+            return out
+        return partials[0]
+
+    def _recount_topn(self, index: Index, call: Call, ids: list[int],
+                      shards: Optional[list[int]]):
+        recount = Call("TopN", {**call.args, "ids": ids}, call.children)
+        recount.args.pop("n", None)
+        partials = []
+        qshards = self._query_shards(index, shards)
+        groups = self.cluster.shards_by_node(index.name, qshards)
+        for node_id, node_shards in groups.items():
+            partials.extend(self._map_node(index, recount, node_id,
+                                           node_shards, set()))
+        merged = merge_pairs(partials)
+        n = call.uint_arg("n")
+        return Pairs(merged[:n] if n is not None else merged)
 
     # -------------------------------------------------------------- options
 

@@ -71,6 +71,9 @@ class Field:
         # row attr store (reference: field.go rowAttrStore, boltdb-backed)
         from pilosa_tpu.utils.attrstore import AttrStore
         self.row_attrs = AttrStore(os.path.join(self.path, ".row_attrs.db"))
+        # fired on newly-available shards so the server can broadcast a
+        # CreateShardMessage (view.go:208-263); (index, field, shard) ->
+        self.on_shard_added = None
 
     # -- derived ------------------------------------------------------------
 
@@ -147,10 +150,12 @@ class Field:
 
     # -- shard tracking -----------------------------------------------------
 
-    def add_available_shard(self, shard: int) -> None:
+    def add_available_shard(self, shard: int, quiet: bool = False) -> None:
         if not self.available_shards.contains(shard):
             self.available_shards.add(shard)
             self._save_available_shards()
+            if self.on_shard_added is not None and not quiet:
+                self.on_shard_added(self.index, self.name, shard)
 
     def remove_available_shard(self, shard: int) -> None:
         if self.available_shards.contains(shard):

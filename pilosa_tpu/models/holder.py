@@ -19,6 +19,7 @@ class Holder:
         self.path = path
         self.indexes: dict[str, Index] = {}
         self.opened = False
+        self.shard_hook = None
 
     def open(self) -> "Holder":
         os.makedirs(self.path, exist_ok=True)
@@ -47,8 +48,16 @@ class Holder:
                     track_existence=track_existence)
         idx.save_meta()
         idx.open()
+        if self.shard_hook is not None:
+            idx.set_shard_hook(self.shard_hook)
         self.indexes[name] = idx
         return idx
+
+    def set_shard_hook(self, fn) -> None:
+        """Install the shard-creation broadcast hook on the whole tree."""
+        self.shard_hook = fn
+        for idx in self.indexes.values():
+            idx.set_shard_hook(fn)
 
     def create_index_if_not_exists(self, name: str, **kw) -> Index:
         existing = self.indexes.get(name)

@@ -36,6 +36,7 @@ class Index:
         self.keys = keys
         self.track_existence = track_existence
         self.fields: dict[str, Field] = {}
+        self.shard_hook = None
         # column attr store (reference: index.go ColumnAttrStore)
         from pilosa_tpu.utils.attrstore import AttrStore
         self.column_attrs = AttrStore(os.path.join(self.path, ".col_attrs.db"))
@@ -97,8 +98,14 @@ class Index:
         f = Field(os.path.join(self.path, name), self.name, name, options)
         f.save_meta()
         f.open()
+        f.on_shard_added = self.shard_hook
         self.fields[name] = f
         return f
+
+    def set_shard_hook(self, fn) -> None:
+        self.shard_hook = fn
+        for f in self.fields.values():
+            f.on_shard_added = fn
 
     def create_field_if_not_exists(self, name: str,
                                    options: Optional[FieldOptions] = None) -> Field:

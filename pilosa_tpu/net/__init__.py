@@ -1,0 +1,7 @@
+"""Transport: REST handler + inter-node client.
+
+Reference: http/ — gorilla/mux router (http/handler.go:236-277) and the
+InternalClient RPC surface (http/client.go). JSON is the wire format here
+(the reference negotiates JSON/protobuf; protobuf parity is storage-side via
+the roaring format, and the internal message plane is versioned JSON).
+"""

@@ -1,0 +1,125 @@
+"""InternalClient: inter-node RPC over HTTP.
+
+Reference: client.go:32-59 (interface), http/client.go (implementation).
+Carries remote query fan-out, imports, anti-entropy block exchange, fragment
+retrieval for resize, and translate-log tailing. JSON bodies matching
+net/http_server.py.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import urllib.error
+import urllib.request
+from typing import Optional
+
+
+class ClientError(Exception):
+    def __init__(self, msg: str, status: int = 0):
+        super().__init__(msg)
+        self.status = status
+
+
+class InternalClient:
+    def __init__(self, timeout: float = 30.0):
+        self.timeout = timeout
+
+    # -- low-level ----------------------------------------------------------
+
+    def _request(self, method: str, uri: str, path: str,
+                 body: Optional[bytes] = None,
+                 content_type: str = "application/json") -> bytes:
+        req = urllib.request.Request(
+            uri + path, data=body, method=method,
+            headers={"Content-Type": content_type} if body is not None else {})
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                return resp.read()
+        except urllib.error.HTTPError as e:
+            detail = e.read().decode(errors="replace")
+            raise ClientError(f"{method} {path}: {e.code}: {detail}", status=e.code)
+        except urllib.error.URLError as e:
+            raise ClientError(f"{method} {path}: {e.reason}")
+
+    def _json(self, method: str, uri: str, path: str, payload=None) -> dict:
+        body = json.dumps(payload).encode() if payload is not None else None
+        out = self._request(method, uri, path, body)
+        return json.loads(out) if out else {}
+
+    # -- interface (client.go:32-59) ----------------------------------------
+
+    def query(self, uri: str, index: str, pql: str,
+              shards: Optional[list[int]] = None, remote: bool = False) -> dict:
+        args = []
+        if shards:
+            args.append("shards=" + ",".join(str(s) for s in shards))
+        if remote:
+            args.append("remote=1")
+        path = f"/index/{index}/query" + ("?" + "&".join(args) if args else "")
+        out = self._request("POST", uri, path, pql.encode(), "text/plain")
+        return json.loads(out)
+
+    def import_bits(self, uri: str, index: str, field: str, payload: dict) -> None:
+        self._json("POST", uri, f"/index/{index}/field/{field}/import", payload)
+
+    def import_roaring(self, uri: str, index: str, field: str, shard: int,
+                       views: dict[str, bytes], clear: bool = False) -> None:
+        payload = {
+            "views": {k: base64.b64encode(v).decode() for k, v in views.items()},
+            "clear": clear,
+        }
+        self._json("POST", uri,
+                   f"/index/{index}/field/{field}/import-roaring/{shard}", payload)
+
+    def fragment_blocks(self, uri: str, index: str, field: str, view: str,
+                        shard: int) -> list[dict]:
+        out = self._json("GET", uri,
+                         f"/internal/fragment/blocks?index={index}&field={field}"
+                         f"&view={view}&shard={shard}")
+        return out.get("blocks", [])
+
+    def block_data(self, uri: str, index: str, field: str, view: str,
+                   shard: int, block: int) -> dict:
+        return self._json("GET", uri,
+                          f"/internal/fragment/block/data?index={index}&field={field}"
+                          f"&view={view}&shard={shard}&block={block}")
+
+    def retrieve_shard(self, uri: str, index: str, field: str, view: str,
+                       shard: int) -> bytes:
+        """Fragment snapshot bytes for resize copies (RetrieveShardFromURI)."""
+        return self._request(
+            "GET", uri,
+            f"/internal/fragment/data?index={index}&field={field}"
+            f"&view={view}&shard={shard}")
+
+    def send_message(self, uri: str, message: dict) -> None:
+        self._json("POST", uri, "/internal/cluster/message", message)
+
+    def nodes(self, uri: str) -> list[dict]:
+        out = self._request("GET", uri, "/internal/nodes")
+        return json.loads(out)
+
+    def status(self, uri: str) -> dict:
+        return self._json("GET", uri, "/status")
+
+    def translate_keys(self, uri: str, index: str, field: Optional[str],
+                       keys: list[str]) -> list[int]:
+        out = self._json("POST", uri, "/internal/translate/keys",
+                         {"index": index, "field": field, "keys": keys})
+        return out.get("ids", [])
+
+    def translate_data(self, uri: str, offset: int = 0) -> bytes:
+        return self._request("GET", uri, f"/internal/translate/data?offset={offset}")
+
+    def schema(self, uri: str) -> dict:
+        return self._json("GET", uri, "/schema")
+
+
+class NopInternalClient:
+    """client.go:79 nopInternalClient."""
+
+    def __getattr__(self, name):
+        def nop(*a, **k):
+            return None
+        return nop

@@ -1,0 +1,338 @@
+"""REST handler: the reference's public + internal HTTP surface.
+
+Route table mirrors http/handler.go:236-277. Built on stdlib
+ThreadingHTTPServer: one regex route table, JSON bodies, text PQL queries.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Optional
+from urllib.parse import parse_qs, urlparse
+
+from pilosa_tpu.api import API, ApiError
+from pilosa_tpu.models.field import FieldOptions
+
+# (method, regex) -> handler name; ordered
+ROUTES: list[tuple[str, re.Pattern, str]] = [
+    ("GET", re.compile(r"^/$"), "home"),
+    ("POST", re.compile(r"^/cluster/resize/abort$"), "post_resize_abort"),
+    ("POST", re.compile(r"^/cluster/resize/remove-node$"), "post_remove_node"),
+    ("POST", re.compile(r"^/cluster/resize/set-coordinator$"), "post_set_coordinator"),
+    ("GET", re.compile(r"^/export$"), "get_export"),
+    ("GET", re.compile(r"^/index$"), "get_indexes"),
+    ("GET", re.compile(r"^/index/(?P<index>[^/]+)$"), "get_index"),
+    ("POST", re.compile(r"^/index/(?P<index>[^/]+)$"), "post_index"),
+    ("DELETE", re.compile(r"^/index/(?P<index>[^/]+)$"), "delete_index"),
+    ("POST", re.compile(r"^/index/(?P<index>[^/]+)/field/(?P<field>[^/]+)$"), "post_field"),
+    ("DELETE", re.compile(r"^/index/(?P<index>[^/]+)/field/(?P<field>[^/]+)$"), "delete_field"),
+    ("POST", re.compile(r"^/index/(?P<index>[^/]+)/field/(?P<field>[^/]+)/import$"), "post_import"),
+    ("POST", re.compile(r"^/index/(?P<index>[^/]+)/field/(?P<field>[^/]+)/import-roaring/(?P<shard>\d+)$"), "post_import_roaring"),
+    ("POST", re.compile(r"^/index/(?P<index>[^/]+)/query$"), "post_query"),
+    ("GET", re.compile(r"^/info$"), "get_info"),
+    ("POST", re.compile(r"^/recalculate-caches$"), "post_recalculate_caches"),
+    ("GET", re.compile(r"^/schema$"), "get_schema"),
+    ("GET", re.compile(r"^/status$"), "get_status"),
+    ("GET", re.compile(r"^/version$"), "get_version"),
+    ("GET", re.compile(r"^/debug/vars$"), "get_debug_vars"),
+    # internal
+    ("POST", re.compile(r"^/internal/cluster/message$"), "post_cluster_message"),
+    ("GET", re.compile(r"^/internal/fragment/block/data$"), "get_fragment_block_data"),
+    ("GET", re.compile(r"^/internal/fragment/blocks$"), "get_fragment_blocks"),
+    ("GET", re.compile(r"^/internal/fragment/data$"), "get_fragment_data"),
+    ("GET", re.compile(r"^/internal/fragment/nodes$"), "get_fragment_nodes"),
+    ("DELETE", re.compile(r"^/internal/index/(?P<index>[^/]+)/field/(?P<field>[^/]+)/remote-available-shards/(?P<shard>\d+)$"), "delete_remote_available_shard"),
+    ("GET", re.compile(r"^/internal/nodes$"), "get_nodes"),
+    ("GET", re.compile(r"^/internal/shards/max$"), "get_shards_max"),
+    ("GET", re.compile(r"^/internal/translate/data$"), "get_translate_data"),
+    ("POST", re.compile(r"^/internal/translate/keys$"), "post_translate_keys"),
+]
+
+
+class Handler:
+    """Route dispatch against an API instance."""
+
+    def __init__(self, api: API,
+                 cluster_message_fn: Optional[Callable[[dict], None]] = None,
+                 stats=None):
+        self.api = api
+        self.cluster_message_fn = cluster_message_fn
+        self.stats = stats
+
+    def dispatch(self, method: str, path: str, query: dict, body: bytes):
+        """-> (status, content_type, payload bytes)."""
+        for m, rx, name in ROUTES:
+            if m != method:
+                continue
+            match = rx.match(path)
+            if match is None:
+                continue
+            handler = getattr(self, name)
+            try:
+                return handler(match.groupdict(), query, body)
+            except ApiError as e:
+                return e.status, "application/json", json.dumps({"error": str(e)}).encode()
+            except Exception as e:  # noqa: BLE001 — surface as 500
+                return 500, "application/json", json.dumps({"error": str(e)}).encode()
+        if any(rx.match(path) for _, rx, _ in ROUTES):
+            return 405, "application/json", b'{"error": "method not allowed"}'
+        return 404, "application/json", b'{"error": "not found"}'
+
+    # -- helpers ------------------------------------------------------------
+
+    @staticmethod
+    def _json(payload, status: int = 200):
+        return status, "application/json", json.dumps(payload).encode()
+
+    @staticmethod
+    def _body_json(body: bytes) -> dict:
+        if not body:
+            return {}
+        try:
+            out = json.loads(body)
+        except json.JSONDecodeError as e:
+            raise ApiError(f"invalid JSON body: {e}")
+        if not isinstance(out, dict):
+            raise ApiError("JSON body must be an object")
+        return out
+
+    @staticmethod
+    def _arg(query: dict, name: str, default=None):
+        vals = query.get(name)
+        return vals[0] if vals else default
+
+    # -- public handlers ----------------------------------------------------
+
+    def home(self, params, query, body):
+        return self._json({"name": "pilosa-tpu", "version": self.api.version()})
+
+    def post_query(self, params, query, body):
+        shards = self._arg(query, "shards")
+        shard_list = [int(s) for s in shards.split(",")] if shards else None
+        remote = self._arg(query, "remote") in ("1", "true")
+        pql = body.decode()
+        return self._json(self.api.query(params["index"], pql,
+                                         shards=shard_list, remote=remote))
+
+    def get_indexes(self, params, query, body):
+        return self._json(self.api.schema())
+
+    def get_index(self, params, query, body):
+        for idx in self.api.schema()["indexes"]:
+            if idx["name"] == params["index"]:
+                return self._json(idx)
+        raise ApiError(f"index not found: {params['index']}", status=404)
+
+    def post_index(self, params, query, body):
+        opts = self._body_json(body).get("options", {})
+        self.api.create_index(params["index"], keys=opts.get("keys", False),
+                              track_existence=opts.get("trackExistence", True))
+        return self._json({"success": True})
+
+    def delete_index(self, params, query, body):
+        self.api.delete_index(params["index"])
+        return self._json({"success": True})
+
+    def post_field(self, params, query, body):
+        o = self._body_json(body).get("options", {})
+        options = FieldOptions(
+            type=o.get("type", "set"),
+            cache_type=o.get("cacheType", "ranked"),
+            cache_size=o.get("cacheSize", 50000),
+            min=o.get("min", 0),
+            max=o.get("max", 0),
+            time_quantum=o.get("timeQuantum", ""),
+            keys=o.get("keys", False),
+        )
+        self.api.create_field(params["index"], params["field"], options)
+        return self._json({"success": True})
+
+    def delete_field(self, params, query, body):
+        self.api.delete_field(params["index"], params["field"])
+        return self._json({"success": True})
+
+    def post_import(self, params, query, body):
+        req = self._body_json(body)
+        if "values" in req:
+            self.api.import_values(
+                params["index"], params["field"],
+                column_ids=req.get("columnIDs"), values=req.get("values"),
+                column_keys=req.get("columnKeys"))
+        else:
+            self.api.import_bits(
+                params["index"], params["field"],
+                row_ids=req.get("rowIDs"), column_ids=req.get("columnIDs"),
+                row_keys=req.get("rowKeys"), column_keys=req.get("columnKeys"),
+                timestamps=req.get("timestamps"))
+        return self._json({})
+
+    def post_import_roaring(self, params, query, body):
+        req = self._body_json(body)
+        views = {name: base64.b64decode(data)
+                 for name, data in req.get("views", {}).items()}
+        self.api.import_roaring(params["index"], params["field"],
+                                int(params["shard"]), views,
+                                clear=bool(req.get("clear", False)))
+        return self._json({})
+
+    def get_export(self, params, query, body):
+        index = self._arg(query, "index")
+        field = self._arg(query, "field")
+        shard = self._arg(query, "shard")
+        if index is None or field is None or shard is None:
+            raise ApiError("index, field and shard are required")
+        out = self.api.export_csv(index, field, int(shard))
+        return 200, "text/csv", out.encode()
+
+    def get_schema(self, params, query, body):
+        return self._json(self.api.schema())
+
+    def get_status(self, params, query, body):
+        return self._json(self.api.status())
+
+    def get_info(self, params, query, body):
+        return self._json(self.api.info())
+
+    def get_version(self, params, query, body):
+        return self._json({"version": self.api.version()})
+
+    def get_debug_vars(self, params, query, body):
+        snap = self.stats.snapshot() if self.stats is not None else {}
+        return self._json(snap)
+
+    def post_recalculate_caches(self, params, query, body):
+        self.api.recalculate_caches()
+        return self._json({})
+
+    def post_resize_abort(self, params, query, body):
+        self.api.resize_abort()
+        return self._json({})
+
+    def post_remove_node(self, params, query, body):
+        req = self._body_json(body)
+        node_id = req.get("id")
+        if not node_id:
+            raise ApiError("id is required")
+        self.api.remove_node(node_id)
+        return self._json({})
+
+    def post_set_coordinator(self, params, query, body):
+        req = self._body_json(body)
+        node_id = req.get("id")
+        if not node_id:
+            raise ApiError("id is required")
+        self.api.set_coordinator(node_id)
+        return self._json({})
+
+    # -- internal handlers --------------------------------------------------
+
+    def post_cluster_message(self, params, query, body):
+        if self.cluster_message_fn is None:
+            raise ApiError("cluster messages not supported", status=501)
+        self.cluster_message_fn(self._body_json(body))
+        return self._json({})
+
+    def _frag_args(self, query):
+        return (self._arg(query, "index"), self._arg(query, "field"),
+                self._arg(query, "view"), int(self._arg(query, "shard", "0")))
+
+    def get_fragment_blocks(self, params, query, body):
+        i, f, v, s = self._frag_args(query)
+        return self._json({"blocks": self.api.fragment_blocks(i, f, v, s)})
+
+    def get_fragment_block_data(self, params, query, body):
+        i, f, v, s = self._frag_args(query)
+        block = int(self._arg(query, "block", "0"))
+        return self._json(self.api.fragment_block_data(i, f, v, s, block))
+
+    def get_fragment_data(self, params, query, body):
+        i, f, v, s = self._frag_args(query)
+        return 200, "application/octet-stream", self.api.fragment_data(i, f, v, s)
+
+    def get_fragment_nodes(self, params, query, body):
+        index = self._arg(query, "index")
+        shard = int(self._arg(query, "shard", "0"))
+        return self._json(self.api.shard_nodes(index, shard))
+
+    def delete_remote_available_shard(self, params, query, body):
+        self.api.delete_remote_available_shard(
+            params["index"], params["field"], int(params["shard"]))
+        return self._json({})
+
+    def get_nodes(self, params, query, body):
+        return self._json(self.api.hosts())
+
+    def get_shards_max(self, params, query, body):
+        return self._json({"standard": self.api.max_shards()})
+
+    def get_translate_data(self, params, query, body):
+        offset = int(self._arg(query, "offset", "0"))
+        return 200, "application/octet-stream", self.api.translate_data(offset)
+
+    def post_translate_keys(self, params, query, body):
+        req = self._body_json(body)
+        ids = self.api.translate_keys(req.get("index"), req.get("field"),
+                                      req.get("keys", []))
+        return self._json({"ids": ids})
+
+
+class _RequestHandler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    handler: Handler = None  # injected by server factory
+
+    def _handle(self, method: str):
+        parsed = urlparse(self.path)
+        length = int(self.headers.get("Content-Length", 0) or 0)
+        body = self.rfile.read(length) if length else b""
+        status, ctype, payload = self.handler.dispatch(
+            method, parsed.path, parse_qs(parsed.query), body)
+        self.send_response(status)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(payload)))
+        self.end_headers()
+        self.wfile.write(payload)
+
+    def do_GET(self):
+        self._handle("GET")
+
+    def do_POST(self):
+        self._handle("POST")
+
+    def do_DELETE(self):
+        self._handle("DELETE")
+
+    def log_message(self, fmt, *args):  # quiet; logging goes through utils
+        pass
+
+
+class HTTPServer:
+    """Threaded HTTP server wrapper with lifecycle (Handler.Serve,
+    http/handler.go:150)."""
+
+    def __init__(self, handler: Handler, host: str = "localhost", port: int = 0):
+        cls = type("BoundHandler", (_RequestHandler,), {"handler": handler})
+        self._srv = ThreadingHTTPServer((host, port), cls)
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self._srv.server_address[1]
+
+    @property
+    def uri(self) -> str:
+        host = self._srv.server_address[0]
+        return f"http://{host}:{self.port}"
+
+    def serve_background(self) -> None:
+        self._thread = threading.Thread(target=self._srv.serve_forever, daemon=True)
+        self._thread.start()
+
+    def close(self) -> None:
+        self._srv.shutdown()
+        self._srv.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)

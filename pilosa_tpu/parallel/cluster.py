@@ -1,0 +1,277 @@
+"""Cluster runtime: membership, placement, replication, resize planning.
+
+Reference: cluster.go — node ring with states STARTING/DEGRADED/NORMAL/
+RESIZING (cluster.go:44-48), topology persistence (cluster.go:1534-1646),
+coordinator-driven join/leave with resize jobs that stream fragments between
+nodes (cluster.go:1150-1515). The data plane difference on TPU: a "node" is
+a host process driving a mesh slice; intra-node shard distribution is the
+mesh shard axis (parallel/mesh.py), and only *inter-node* movement uses the
+resize engine here.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import uuid
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from pilosa_tpu.parallel.placement import (
+    DEFAULT_PARTITION_N,
+    JmpHasher,
+    partition as partition_fn,
+)
+
+# cluster states (cluster.go:44-48)
+STATE_STARTING = "STARTING"
+STATE_DEGRADED = "DEGRADED"
+STATE_NORMAL = "NORMAL"
+STATE_RESIZING = "RESIZING"
+
+# node events (event.go)
+EVENT_JOIN = "join"
+EVENT_LEAVE = "leave"
+EVENT_UPDATE = "update"
+
+
+@dataclass
+class Node:
+    id: str
+    uri: str = ""
+    is_coordinator: bool = False
+    state: str = "READY"
+
+    def to_dict(self) -> dict:
+        return {"id": self.id, "uri": self.uri, "isCoordinator": self.is_coordinator}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Node":
+        return cls(id=d["id"], uri=d.get("uri", ""),
+                   is_coordinator=d.get("isCoordinator", False))
+
+
+@dataclass
+class ResizeSource:
+    """One fragment copy instruction (internal ResizeSource message)."""
+    index: str
+    field: str
+    view: str
+    shard: int
+    from_node: str
+
+    def to_dict(self) -> dict:
+        return {"index": self.index, "field": self.field, "view": self.view,
+                "shard": self.shard, "fromNode": self.from_node}
+
+
+@dataclass
+class ResizeJob:
+    """Coordinator-built plan for a node add/remove (resizeJob,
+    cluster.go:1401-1515)."""
+    id: str
+    event: str  # join | leave
+    node_id: str
+    # target node id -> fragment sources to fetch
+    instructions: dict[str, list[ResizeSource]] = field(default_factory=dict)
+    completed: set = field(default_factory=set)
+
+    def done(self) -> bool:
+        return set(self.instructions) <= self.completed
+
+
+class Cluster:
+    """Placement + membership + resize planning.
+
+    `schema_fn` returns {index: {field: {view: [shards]}}} — what fragments
+    exist; used to plan resize copies (fragSources, cluster.go:741-826).
+    """
+
+    def __init__(self, local_id: str, partition_n: int = DEFAULT_PARTITION_N,
+                 replica_n: int = 1, hasher=None,
+                 schema_fn: Optional[Callable[[], dict]] = None,
+                 topology_path: Optional[str] = None):
+        self.local_id = local_id
+        self.partition_n = partition_n
+        self.replica_n = max(replica_n, 1)
+        self.hasher = hasher or JmpHasher()
+        self.nodes: list[Node] = []
+        self.state = STATE_STARTING
+        self.coordinator_id: Optional[str] = None
+        self.schema_fn = schema_fn or (lambda: {})
+        self.topology_path = topology_path
+        self.cluster_id = str(uuid.uuid4())
+        self.on_state_change: Optional[Callable[[str], None]] = None
+        self.active_job: Optional[ResizeJob] = None
+
+    # -- membership ---------------------------------------------------------
+
+    def add_node(self, node: Node) -> None:
+        """Insert keeping nodes sorted by ID (the ring order the jump hash
+        indexes into, cluster.go nodes ordering)."""
+        if self.node_by_id(node.id) is None:
+            self.nodes.append(node)
+            self.nodes.sort(key=lambda n: n.id)
+        self.save_topology()
+
+    def remove_node(self, node_id: str) -> None:
+        self.nodes = [n for n in self.nodes if n.id != node_id]
+        self.save_topology()
+
+    def node_by_id(self, node_id: str) -> Optional[Node]:
+        return next((n for n in self.nodes if n.id == node_id), None)
+
+    @property
+    def local_node(self) -> Optional[Node]:
+        return self.node_by_id(self.local_id)
+
+    def is_coordinator(self) -> bool:
+        return self.coordinator_id == self.local_id
+
+    def set_static(self, nodes: list[Node]) -> None:
+        """Gossip-less fixed-membership mode (`cluster.disabled`,
+        cluster.go:1939 setStatic)."""
+        self.nodes = sorted(nodes, key=lambda n: n.id)
+        if self.nodes:
+            self.coordinator_id = self.coordinator_id or self.nodes[0].id
+        self._set_state(STATE_NORMAL)
+
+    def _set_state(self, state: str) -> None:
+        if state != self.state:
+            self.state = state
+            if self.on_state_change is not None:
+                self.on_state_change(state)
+
+    # -- placement ----------------------------------------------------------
+
+    def partition(self, index: str, shard: int) -> int:
+        return partition_fn(index, shard, self.partition_n)
+
+    def partition_nodes(self, partition_id: int) -> list[Node]:
+        """Primary + replicas around the ring (cluster.go:857-878)."""
+        if not self.nodes:
+            return []
+        replica_n = min(self.replica_n, len(self.nodes))
+        idx = self.hasher.hash(partition_id, len(self.nodes))
+        return [self.nodes[(idx + i) % len(self.nodes)] for i in range(replica_n)]
+
+    def shard_nodes(self, index: str, shard: int) -> list[Node]:
+        return self.partition_nodes(self.partition(index, shard))
+
+    def owns_shard(self, node_id: str, index: str, shard: int) -> bool:
+        return any(n.id == node_id for n in self.shard_nodes(index, shard))
+
+    def shards_by_node(self, index: str, shards: list[int]) -> dict[str, list[int]]:
+        """Group shards by primary owner — the mapReduce fan-out plan
+        (executor.go:2163 shardsByNode)."""
+        out: dict[str, list[int]] = {}
+        for s in shards:
+            nodes = self.shard_nodes(index, s)
+            if nodes:
+                out.setdefault(nodes[0].id, []).append(s)
+        return out
+
+    def non_primary_replicas(self, index: str, shard: int) -> list[Node]:
+        return self.shard_nodes(index, shard)[1:]
+
+    # -- resize planning (fragSources, cluster.go:741-826) ------------------
+
+    def plan_resize(self, event: str, node: Node) -> ResizeJob:
+        """Diff ownership before/after a membership change; emit per-node
+        fetch instructions for fragments they newly own."""
+        before = Cluster(self.local_id, self.partition_n, self.replica_n,
+                         self.hasher)
+        before.nodes = list(self.nodes)
+        after = Cluster(self.local_id, self.partition_n, self.replica_n,
+                        self.hasher)
+        after.nodes = list(self.nodes)
+        if event == EVENT_JOIN:
+            after.nodes = sorted(after.nodes + [node], key=lambda n: n.id)
+        elif event == EVENT_LEAVE:
+            after.nodes = [n for n in after.nodes if n.id != node.id]
+        else:
+            raise ValueError(f"unsupported resize event: {event}")
+
+        job = ResizeJob(id=str(uuid.uuid4()), event=event, node_id=node.id)
+        schema = self.schema_fn()
+        for index, fields in schema.items():
+            for fname, views in fields.items():
+                for vname, shards in views.items():
+                    for shard in shards:
+                        old = {n.id for n in before.shard_nodes(index, shard)}
+                        new = {n.id for n in after.shard_nodes(index, shard)}
+                        for target in new - old:
+                            # fetch from any surviving old owner
+                            donors = [i for i in old if any(
+                                n.id == i for n in after.nodes)]
+                            if not donors:
+                                continue  # data loss: no surviving replica
+                            job.instructions.setdefault(target, []).append(
+                                ResizeSource(index, fname, vname, shard,
+                                             sorted(donors)[0]))
+        for n in after.nodes:
+            job.instructions.setdefault(n.id, [])
+        return job
+
+    def node_join(self, node: Node) -> Optional[ResizeJob]:
+        """Coordinator-side join handling (nodeJoin, cluster.go:1715)."""
+        if self.node_by_id(node.id) is not None:
+            return None
+        job = self.plan_resize(EVENT_JOIN, node)
+        self.active_job = job
+        self._set_state(STATE_RESIZING)
+        return job
+
+    def node_leave(self, node_id: str) -> Optional[ResizeJob]:
+        node = self.node_by_id(node_id)
+        if node is None:
+            return None
+        if len(self.nodes) <= self.replica_n:
+            # can't rebuild replicas; serve degraded (cluster.go:45)
+            self.remove_node(node_id)
+            self._set_state(STATE_DEGRADED)
+            return None
+        job = self.plan_resize(EVENT_LEAVE, node)
+        self.active_job = job
+        self._set_state(STATE_RESIZING)
+        return job
+
+    def complete_resize(self, job: ResizeJob, node_id: str) -> None:
+        """A node acks its instruction (ResizeInstructionComplete)."""
+        job.completed.add(node_id)
+        if job.done():
+            if job.event == EVENT_JOIN:
+                node = Node(id=job.node_id)
+                if self.node_by_id(job.node_id) is None:
+                    self.add_node(node)
+            else:
+                self.remove_node(job.node_id)
+            self.active_job = None
+            self._set_state(STATE_NORMAL)
+
+    def abort_resize(self) -> None:
+        """api.ResizeAbort (api.go:1131)."""
+        self.active_job = None
+        self._set_state(STATE_NORMAL)
+
+    # -- topology persistence (cluster.go:1534-1646, JSON not protobuf) -----
+
+    def save_topology(self) -> None:
+        if not self.topology_path:
+            return
+        os.makedirs(os.path.dirname(self.topology_path), exist_ok=True)
+        tmp = self.topology_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({
+                "clusterID": self.cluster_id,
+                "nodeIDs": [n.id for n in self.nodes],
+            }, f)
+        os.replace(tmp, self.topology_path)
+
+    def load_topology(self) -> list[str]:
+        if not self.topology_path or not os.path.exists(self.topology_path):
+            return []
+        with open(self.topology_path) as f:
+            data = json.load(f)
+        self.cluster_id = data.get("clusterID", self.cluster_id)
+        return data.get("nodeIDs", [])

@@ -1,0 +1,56 @@
+"""Shard placement: 256 partitions, fnv64a keys, jump consistent hashing.
+
+Hash-compatible with the reference (cluster.go:828-913): partition =
+fnv64a(index_name || bigendian64(shard)) mod partitionN; the partition's
+primary node is jump-hash(partition, len(nodes)); ReplicaN ring successors
+hold the copies. Keeping the exact hash means a mixed rollout (reference
+nodes + TPU nodes) agrees on ownership.
+
+On TPU this layer does double duty: the same jump hash assigns partitions to
+*chips of the local mesh slice* (the shard axis), so a node's owned shards
+are further striped across its devices deterministically.
+"""
+
+from __future__ import annotations
+
+DEFAULT_PARTITION_N = 256
+
+_FNV64_OFFSET = 0xCBF29CE484222325
+_FNV64_PRIME = 0x100000001B3
+_MASK64 = 0xFFFFFFFFFFFFFFFF
+
+
+def fnv64a(data: bytes) -> int:
+    h = _FNV64_OFFSET
+    for b in data:
+        h = ((h ^ b) * _FNV64_PRIME) & _MASK64
+    return h
+
+
+def partition(index: str, shard: int, partition_n: int = DEFAULT_PARTITION_N) -> int:
+    """(index, shard) -> partition id (cluster.partition, cluster.go:828)."""
+    return fnv64a(index.encode() + shard.to_bytes(8, "big")) % partition_n
+
+
+def jump_hash(key: int, n: int) -> int:
+    """Jump consistent hash: key -> bucket in [0, n) (jmphasher,
+    cluster.go:902-913; Lamping & Veach)."""
+    key &= _MASK64
+    b, j = -1, 0
+    while j < n:
+        b = j
+        key = (key * 2862933555777941757 + 1) & _MASK64
+        j = int((b + 1) * ((1 << 31) / ((key >> 33) + 1)))
+    return b
+
+
+class ModHasher:
+    """key % n — deterministic placement for tests (test/cluster.go:18)."""
+
+    def hash(self, key: int, n: int) -> int:
+        return key % n
+
+
+class JmpHasher:
+    def hash(self, key: int, n: int) -> int:
+        return jump_hash(key, n)

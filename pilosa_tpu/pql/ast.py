@@ -104,6 +104,59 @@ class Call:
         parts += [f"{k}={v!r}" for k, v in self.args.items()]
         return f"{self.name}({', '.join(parts)})"
 
+    # -- PQL serialization (Call.String, pql/ast.go:231; used by remote
+    #    fan-out, which re-sends the PQL string — executor.go:2147) ---------
+
+    def to_pql(self) -> str:
+        args = dict(self.args)
+        head: list[str] = []
+        tail: list[str] = []
+        if self.name in ("Set", "Clear", "SetColumnAttrs"):
+            head.append(_fmt_value(args.pop("_col")))
+        if self.name in ("SetRowAttrs", "TopN"):
+            head.append(str(args.pop("_field")))
+        if self.name == "SetRowAttrs":
+            head.append(_fmt_value(args.pop("_row")))
+        ts = args.pop("_timestamp", None)
+        start = args.pop("_start", None)
+        end = args.pop("_end", None)
+        head.extend(c.to_pql() for c in self.children)
+        for k, v in args.items():
+            if isinstance(v, Condition):
+                tail.append(f"{k} {v.op} {_fmt_value(v.value)}")
+            else:
+                tail.append(f"{k}={_fmt_value(v)}")
+        if start is not None:
+            tail.append(_fmt_timestamp(start))
+        if end is not None:
+            tail.append(_fmt_timestamp(end))
+        if ts is not None:
+            tail.append(_fmt_timestamp(ts))
+        return f"{self.name}({', '.join(head + tail)})"
+
+
+def _fmt_value(v) -> str:
+    import json as _json
+    from datetime import datetime as _dt
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    if v is None:
+        return "null"
+    if isinstance(v, str):
+        return _json.dumps(v)
+    if isinstance(v, _dt):
+        return v.strftime("%Y-%m-%dT%H:%M")
+    if isinstance(v, (list, tuple)):
+        return "[" + ", ".join(_fmt_value(x) for x in v) + "]"
+    if isinstance(v, Call):
+        return v.to_pql()
+    return str(v)
+
+
+def _fmt_timestamp(v) -> str:
+    from datetime import datetime as _dt
+    return v.strftime("%Y-%m-%dT%H:%M") if isinstance(v, _dt) else str(v)
+
 
 class Query:
     __slots__ = ("calls",)

@@ -1,0 +1,265 @@
+"""Server: process lifecycle wiring holder + cluster + executor + transport.
+
+Reference: server.go — functional options (server.go:84-246), Open() sequence
+(§3.1 of SURVEY.md), cluster message dispatch (server.go:485-580), anti-
+entropy ticker (server.go:430-483). One Server is one "node": a host process
+that owns a data dir and drives the local device mesh slice.
+"""
+
+from __future__ import annotations
+
+import threading
+import uuid
+from typing import Optional
+
+from pilosa_tpu.api import API
+from pilosa_tpu.executor import Executor
+from pilosa_tpu.models import FieldOptions, Holder
+from pilosa_tpu.net.client import ClientError, InternalClient
+from pilosa_tpu.net.http_server import Handler, HTTPServer
+from pilosa_tpu.parallel.cluster import Cluster, Node, STATE_NORMAL
+from pilosa_tpu.parallel.mesh import DeviceRunner
+from pilosa_tpu.utils.translate import TranslateStore
+
+import os
+
+
+class Server:
+    """One node of the index. With `cluster_hosts` empty: single-node static
+    cluster (the reference's `cluster.disabled` mode, server/config.go:65)."""
+
+    def __init__(self, data_dir: str, host: str = "localhost", port: int = 0,
+                 node_id: Optional[str] = None,
+                 cluster_hosts: Optional[list[str]] = None,
+                 replica_n: int = 1,
+                 anti_entropy_interval: float = 0.0,
+                 mesh=None):
+        self.data_dir = data_dir
+        self.holder = Holder(data_dir)
+        self.node_id = node_id or self._load_or_create_id()
+        self.cluster = Cluster(
+            self.node_id, replica_n=replica_n,
+            schema_fn=self._schema_shards,
+            topology_path=os.path.join(data_dir, ".topology"))
+        self.translate = TranslateStore(os.path.join(data_dir, ".keys"))
+        self.runner = DeviceRunner(mesh)
+        self.client = InternalClient()
+        from pilosa_tpu.utils.logger import Logger
+        from pilosa_tpu.utils.stats import new_stats_client
+        from pilosa_tpu.utils.tracing import Tracer
+        self.stats = new_stats_client()
+        self.tracer = Tracer()
+        self.logger = Logger()
+        from pilosa_tpu.utils.cluster_translate import ClusterTranslator
+        self.cluster_translate = ClusterTranslator(self.translate, self.cluster,
+                                                   self.client)
+        self.executor = Executor(self.holder, runner=self.runner,
+                                 translator=self.cluster_translate,
+                                 cluster=self.cluster, client=self.client)
+        self.executor.stats = self.stats
+        self.executor.tracer = self.tracer
+        self.api = API(self.holder, self.cluster, executor=self.executor,
+                       translate_store=self.cluster_translate)
+        self.handler = Handler(self.api, cluster_message_fn=self.receive_message,
+                               stats=self.stats)
+        self.http = HTTPServer(self.handler, host=host, port=port)
+        self.cluster_hosts = cluster_hosts or []
+        self.anti_entropy_interval = anti_entropy_interval
+        self._ae_timer: Optional[threading.Timer] = None
+        self.closed = False
+
+    # -- lifecycle (server.go Open, §3.1) -----------------------------------
+
+    def _load_or_create_id(self) -> str:
+        """Persistent node id (.id file, holder.go:576)."""
+        os.makedirs(self.data_dir, exist_ok=True)
+        path = os.path.join(self.data_dir, ".id")
+        if os.path.exists(path):
+            with open(path) as f:
+                return f.read().strip()
+        node_id = str(uuid.uuid4())
+        with open(path, "w") as f:
+            f.write(node_id)
+        return node_id
+
+    def _schema_shards(self) -> dict:
+        out: dict = {}
+        for iname, idx in self.holder.indexes.items():
+            for fname, field in idx.fields.items():
+                for vname, view in field.views.items():
+                    out.setdefault(iname, {}).setdefault(fname, {})[vname] = view.shards()
+        return out
+
+    def open(self) -> "Server":
+        self.translate.open()
+        self.holder.open()
+        self.holder.set_shard_hook(self._on_shard_added)
+        self.http.serve_background()
+        me = Node(id=self.node_id, uri=self.http.uri,
+                  is_coordinator=not self.cluster_hosts)
+        if not self.cluster_hosts:
+            self.cluster.set_static([me])
+            self.cluster.coordinator_id = self.node_id
+        else:
+            # static multi-node (all hosts known up front; nodes ordered by
+            # id). Peers may not be up yet: start with self, converge via
+            # refresh_membership once peers answer /internal/nodes.
+            self.cluster.set_static([me])
+            self.refresh_membership()
+        self.api.broadcast_fn = self.broadcast
+        if self.anti_entropy_interval > 0:
+            self._schedule_anti_entropy()
+        return self
+
+    def refresh_membership(self) -> None:
+        """Merge peer node lists from all configured hosts (the static-mode
+        analog of a gossip LocalState/MergeRemoteState sync,
+        gossip/gossip.go:274-316)."""
+        if not self.cluster_hosts:
+            return
+        me = Node(id=self.node_id, uri=self.http.uri)
+        nodes = {self.node_id: me}
+        for huri in self.cluster_hosts:
+            if huri == self.http.uri:
+                continue
+            try:
+                for nd in self.client.nodes(huri) or []:
+                    if nd["id"] not in nodes:
+                        nodes[nd["id"]] = Node.from_dict(nd)
+            except ClientError:
+                pass
+        self.cluster.set_static(list(nodes.values()))
+        # lowest node id coordinates (deterministic across peers)
+        self.cluster.coordinator_id = min(nodes)
+
+    def close(self) -> None:
+        self.closed = True
+        if self._ae_timer is not None:
+            self._ae_timer.cancel()
+        self.http.close()
+        self.holder.close()
+        self.translate.close()
+
+    @property
+    def uri(self) -> str:
+        return self.http.uri
+
+    # -- cluster message dispatch (server.go:485-580) -----------------------
+
+    def receive_message(self, msg: dict) -> None:
+        mtype = msg.get("type")
+        if mtype == "create-index":
+            if self.holder.index(msg["index"]) is None:
+                self.holder.create_index(msg["index"], keys=msg.get("keys", False),
+                                         track_existence=msg.get("trackExistence", True))
+        elif mtype == "delete-index":
+            if self.holder.index(msg["index"]) is not None:
+                self.holder.delete_index(msg["index"])
+        elif mtype == "create-field":
+            idx = self.holder.index(msg["index"])
+            if idx is not None and idx.field(msg["field"]) is None:
+                idx.create_field(msg["field"], FieldOptions(**msg.get("options", {})))
+        elif mtype == "delete-field":
+            idx = self.holder.index(msg["index"])
+            if idx is not None and idx.field(msg["field"]) is not None:
+                idx.delete_field(msg["field"])
+        elif mtype == "create-shard":
+            idx = self.holder.index(msg["index"])
+            f = idx.field(msg["field"]) if idx else None
+            if f is not None:
+                f.add_available_shard(int(msg["shard"]), quiet=True)
+        elif mtype == "node-join":
+            node = Node.from_dict(msg["node"])
+            self.cluster.add_node(node)
+        elif mtype == "recalculate-caches":
+            self.api.recalculate_caches()
+        else:
+            raise ValueError(f"unknown cluster message type: {mtype}")
+
+    def _on_shard_added(self, index_name: str, field_name: str, shard: int) -> None:
+        """Broadcast newly-available shards so every node's shard set stays
+        complete for query fan-out (CreateShardMessage, view.go:208-263)."""
+        self.broadcast({"type": "create-shard", "index": index_name,
+                        "field": field_name, "shard": shard})
+
+    def broadcast(self, msg: dict) -> None:
+        """SendSync: POST to every peer (server.go:582-604)."""
+        for node in self.cluster.nodes:
+            if node.id == self.node_id or not node.uri:
+                continue
+            try:
+                self.client.send_message(node.uri, msg)
+            except ClientError:
+                pass  # peers converge via anti-entropy
+
+    # -- anti-entropy (server.go:430-483; fragmentSyncer fragment.go:2170) --
+
+    def _schedule_anti_entropy(self) -> None:
+        if self.closed:
+            return
+        self._ae_timer = threading.Timer(self.anti_entropy_interval,
+                                         self._anti_entropy_tick)
+        self._ae_timer.daemon = True
+        self._ae_timer.start()
+
+    def _anti_entropy_tick(self) -> None:
+        try:
+            self.sync_holder()
+        finally:
+            self._schedule_anti_entropy()
+
+    def sync_holder(self) -> int:
+        """One full anti-entropy pass over owned fragments; returns number of
+        blocks merged (holderSyncer.SyncHolder, holder.go:633-853)."""
+        merged = 0
+        for iname, idx in self.holder.indexes.items():
+            for fname, field in idx.fields.items():
+                for vname, view in field.views.items():
+                    for shard in view.shards():
+                        if not self.cluster.owns_shard(self.node_id, iname, shard):
+                            continue
+                        merged += self._sync_fragment(iname, fname, vname, shard)
+        return merged
+
+    def _sync_fragment(self, iname: str, fname: str, vname: str, shard: int) -> int:
+        frag = self.holder.index(iname).field(fname).view(vname).fragment(shard)
+        if frag is None:
+            return 0
+        local_blocks = dict(frag.blocks())
+        merged = 0
+        for node in self.cluster.shard_nodes(iname, shard):
+            if node.id == self.node_id or not node.uri:
+                continue
+            try:
+                remote = {b["id"]: b["checksum"]
+                          for b in self.client.fragment_blocks(
+                              node.uri, iname, fname, vname, shard)}
+            except ClientError:
+                continue
+            for blk in set(local_blocks) | set(remote):
+                lc = local_blocks.get(blk)
+                if lc is not None and remote.get(blk) == lc.hex():
+                    continue
+                try:
+                    data = self.client.block_data(node.uri, iname, fname, vname,
+                                                  shard, blk)
+                except ClientError:
+                    continue
+                import numpy as np
+                sets_r, sets_c = frag.merge_block(
+                    blk, np.array(data.get("rowIDs", []), dtype=np.int64),
+                    np.array(data.get("columnIDs", []), dtype=np.int64))
+                merged += 1
+                # push local-only pairs back to the peer
+                if sets_r.size:
+                    from pilosa_tpu.storage.roaring import Bitmap
+                    from pilosa_tpu.constants import SHARD_WIDTH
+                    positions = sets_r.astype(np.uint64) * np.uint64(SHARD_WIDTH) \
+                        + sets_c.astype(np.uint64)
+                    payload = Bitmap(positions).to_bytes()
+                    try:
+                        self.client.import_roaring(node.uri, iname, fname, shard,
+                                                   {vname: payload})
+                    except ClientError:
+                        pass
+        return merged

@@ -1,0 +1,115 @@
+"""Cluster-consistent key translation: single-writer primary.
+
+Reference: translate.go:359-433 — only the primary mints new key ids;
+replicas serve reads from a tailed copy of the log and forward misses. This
+keeps the key -> id mapping identical on every node, which matters because
+raw ids cross node boundaries (TopN phase-2 id lists, fragment replication,
+anti-entropy block exchange).
+
+The coordinator is the translation primary. Non-coordinators:
+  * translate from the local tailed store when possible,
+  * forward misses to the coordinator (/internal/translate/keys) and install
+    the returned mapping locally,
+  * on reverse-lookup misses, tail the primary's log from the local offset
+    (/internal/translate/data) and retry.
+"""
+
+from __future__ import annotations
+
+from pilosa_tpu.utils.translate import KIND_COLUMN, KIND_ROW, TranslateStore
+
+
+class ClusterTranslator:
+    def __init__(self, store: TranslateStore, cluster, client):
+        self.store = store
+        self.cluster = cluster
+        self.client = client
+
+    # -- primary routing ----------------------------------------------------
+
+    def _primary_uri(self):
+        if self.cluster is None or self.cluster.is_coordinator():
+            return None
+        node = self.cluster.node_by_id(self.cluster.coordinator_id)
+        return node.uri if node is not None and node.uri else None
+
+    def _forward(self, index: str, field, keys: list[str]):
+        from pilosa_tpu.net.client import ClientError
+        uri = self._primary_uri()
+        if uri is None:
+            return None
+        try:
+            return self.client.translate_keys(uri, index, field, keys)
+        except ClientError:
+            return None
+
+    def _tail(self) -> bool:
+        from pilosa_tpu.net.client import ClientError
+        uri = self._primary_uri()
+        if uri is None:
+            return False
+        try:
+            data = self.client.translate_data(uri, offset=self.store.log_size())
+        except ClientError:
+            return False
+        if data:
+            self.store.apply_log(data)
+        return bool(data)
+
+    # -- forward translation ------------------------------------------------
+
+    def translate_column(self, index: str, key: str, create: bool = True):
+        id_ = self.store.translate_column(index, key, create=False)
+        if id_ is not None:
+            return id_
+        uri = self._primary_uri()
+        if uri is None:
+            # we are the primary (or single-node): mint locally
+            return self.store.translate_column(index, key, create=create)
+        ids = self._forward(index, None, [key])
+        if not ids or ids[0] is None:
+            return None
+        self.store.ensure_mapping(KIND_COLUMN, index, "", key, ids[0])
+        return ids[0]
+
+    def translate_columns(self, index: str, keys: list[str], create: bool = True):
+        return [self.translate_column(index, k, create) for k in keys]
+
+    def translate_row(self, index: str, field: str, key: str, create: bool = True):
+        id_ = self.store.translate_row(index, field, key, create=False)
+        if id_ is not None:
+            return id_
+        uri = self._primary_uri()
+        if uri is None:
+            return self.store.translate_row(index, field, key, create=create)
+        ids = self._forward(index, field, [key])
+        if not ids or ids[0] is None:
+            return None
+        self.store.ensure_mapping(KIND_ROW, index, field, key, ids[0])
+        return ids[0]
+
+    def translate_rows(self, index: str, field: str, keys: list[str],
+                       create: bool = True):
+        return [self.translate_row(index, field, k, create) for k in keys]
+
+    # -- reverse translation ------------------------------------------------
+
+    def translate_column_to_string(self, index: str, id_: int):
+        out = self.store.translate_column_to_string(index, id_)
+        if out is None and self._tail():
+            out = self.store.translate_column_to_string(index, id_)
+        return out
+
+    def translate_row_to_string(self, index: str, field: str, id_: int):
+        out = self.store.translate_row_to_string(index, field, id_)
+        if out is None and self._tail():
+            out = self.store.translate_row_to_string(index, field, id_)
+        return out
+
+    # -- passthrough for the API surface ------------------------------------
+
+    def log_bytes(self, offset: int = 0) -> bytes:
+        return self.store.log_bytes(offset)
+
+    def log_size(self) -> int:
+        return self.store.log_size()

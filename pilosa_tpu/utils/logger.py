@@ -1,0 +1,36 @@
+"""Logger: standard / verbose / nop (reference: logger/logger.go)."""
+
+from __future__ import annotations
+
+import sys
+import time
+from typing import Optional, TextIO
+
+
+class Logger:
+    def __init__(self, verbose: bool = False, out: Optional[TextIO] = None):
+        self.verbose = verbose
+        self.out = out or sys.stderr
+
+    def _emit(self, level: str, fmt: str, *args) -> None:
+        ts = time.strftime("%Y-%m-%dT%H:%M:%S")
+        msg = fmt % args if args else fmt
+        self.out.write(f"{ts} {level} {msg}\n")
+        self.out.flush()
+
+    def printf(self, fmt: str, *args) -> None:
+        self._emit("INFO", fmt, *args)
+
+    def debugf(self, fmt: str, *args) -> None:
+        if self.verbose:
+            self._emit("DEBUG", fmt, *args)
+
+
+class NopLogger:
+    def printf(self, fmt, *args): pass
+    def debugf(self, fmt, *args): pass
+
+
+def file_logger(path: str, verbose: bool = False) -> Logger:
+    """log-path config (server/config.go:49-52)."""
+    return Logger(verbose=verbose, out=open(path, "a"))

@@ -1,0 +1,94 @@
+"""Stats: the StatsClient interface + in-memory (expvar-style) impl.
+
+Reference: stats/stats.go:31-67 (Count/Gauge/Histogram/Set/Timing with tags,
+WithTags namespacing), default expvar map served at /debug/vars, statsd impl
+selected by `metric.service`. Here: an in-memory client with the same
+surface, a nop client, and a JSON snapshot for the /debug/vars endpoint.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+
+class StatsClient:
+    """In-memory stats (the Expvar impl, stats/stats.go:24)."""
+
+    def __init__(self, prefix: str = "", tags: Optional[list[str]] = None,
+                 _store=None):
+        self._prefix = prefix
+        self.tags = sorted(tags or [])
+        self._store = _store if _store is not None else {
+            "lock": threading.Lock(), "counts": {}, "gauges": {},
+            "timings": {}, "sets": {}}
+
+    def _key(self, name: str) -> str:
+        tag_part = ("," + ",".join(self.tags)) if self.tags else ""
+        return f"{self._prefix}{name}{tag_part}"
+
+    def with_tags(self, *tags: str) -> "StatsClient":
+        return StatsClient(self._prefix, self.tags + list(tags), self._store)
+
+    def count(self, name: str, value: int = 1, rate: float = 1.0) -> None:
+        with self._store["lock"]:
+            k = self._key(name)
+            self._store["counts"][k] = self._store["counts"].get(k, 0) + value
+
+    def count_with_custom_tags(self, name: str, value: int, rate: float,
+                               tags: list[str]) -> None:
+        self.with_tags(*tags).count(name, value, rate)
+
+    def gauge(self, name: str, value: float, rate: float = 1.0) -> None:
+        with self._store["lock"]:
+            self._store["gauges"][self._key(name)] = value
+
+    def histogram(self, name: str, value: float, rate: float = 1.0) -> None:
+        self.timing(name, value, rate)
+
+    def set(self, name: str, value: str, rate: float = 1.0) -> None:
+        with self._store["lock"]:
+            self._store["sets"].setdefault(self._key(name), set()).add(value)
+
+    def timing(self, name: str, value: float, rate: float = 1.0) -> None:
+        with self._store["lock"]:
+            t = self._store["timings"].setdefault(
+                self._key(name), {"count": 0, "sum": 0.0, "min": None, "max": None})
+            t["count"] += 1
+            t["sum"] += value
+            t["min"] = value if t["min"] is None else min(t["min"], value)
+            t["max"] = value if t["max"] is None else max(t["max"], value)
+
+    def snapshot(self) -> dict:
+        """JSON-able dump for /debug/vars."""
+        with self._store["lock"]:
+            return {
+                "counts": dict(self._store["counts"]),
+                "gauges": dict(self._store["gauges"]),
+                "timings": {k: dict(v) for k, v in self._store["timings"].items()},
+                "sets": {k: sorted(v) for k, v in self._store["sets"].items()},
+            }
+
+
+class NopStatsClient:
+    """stats.NopStatsClient."""
+
+    def with_tags(self, *tags):
+        return self
+
+    def count(self, *a, **k): pass
+    def count_with_custom_tags(self, *a, **k): pass
+    def gauge(self, *a, **k): pass
+    def histogram(self, *a, **k): pass
+    def set(self, *a, **k): pass
+    def timing(self, *a, **k): pass
+
+    def snapshot(self):
+        return {}
+
+
+def new_stats_client(service: str = "expvar"):
+    """metric.service selection (server/server.go:361-374)."""
+    if service in ("expvar", "statsd"):  # statsd egress not available: in-mem
+        return StatsClient()
+    return NopStatsClient()

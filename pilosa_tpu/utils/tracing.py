@@ -1,0 +1,108 @@
+"""Tracing: Tracer/Span interface with a global tracer and nop default.
+
+Reference: tracing/tracing.go:9-59 (GlobalTracer, StartSpanFromContext, nop
+impls) + the opentracing/Jaeger adapter. Jaeger egress isn't available here;
+the concrete impl is an in-memory recording tracer usable for slow-query
+logging and tests, with HTTP header propagation hooks like
+InjectHTTPHeaders/extractTracing (tracing/tracing.go:22-26).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import uuid
+from typing import Optional
+
+TRACE_HEADER = "X-Pilosa-Trace-Id"
+
+
+class Span:
+    __slots__ = ("tracer", "name", "trace_id", "start", "end", "tags")
+
+    def __init__(self, tracer, name: str, trace_id: str):
+        self.tracer = tracer
+        self.name = name
+        self.trace_id = trace_id
+        self.start = time.monotonic()
+        self.end: Optional[float] = None
+        self.tags: dict = {}
+
+    def set_tag(self, key: str, value) -> None:
+        self.tags[key] = value
+
+    def finish(self) -> None:
+        self.end = time.monotonic()
+        self.tracer._record(self)
+
+    def duration(self) -> float:
+        return (self.end if self.end is not None else time.monotonic()) - self.start
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.finish()
+
+
+class Tracer:
+    """Recording tracer; keeps the last `limit` finished spans."""
+
+    def __init__(self, limit: int = 1000):
+        self.limit = limit
+        self._lock = threading.Lock()
+        self.spans: list[Span] = []
+
+    def start_span(self, name: str, trace_id: Optional[str] = None) -> Span:
+        return Span(self, name, trace_id or uuid.uuid4().hex[:16])
+
+    def _record(self, span: Span) -> None:
+        with self._lock:
+            self.spans.append(span)
+            if len(self.spans) > self.limit:
+                self.spans = self.spans[-self.limit:]
+
+    def finished(self, name: Optional[str] = None) -> list[Span]:
+        with self._lock:
+            return [s for s in self.spans if name is None or s.name == name]
+
+    # HTTP propagation (tracing/tracing.go:22-26)
+    def inject_headers(self, span: Span, headers: dict) -> None:
+        headers[TRACE_HEADER] = span.trace_id
+
+    def extract_trace_id(self, headers) -> Optional[str]:
+        return headers.get(TRACE_HEADER)
+
+
+class NopSpan:
+    def set_tag(self, key, value): pass
+    def finish(self): pass
+    def duration(self): return 0.0
+    def __enter__(self): return self
+    def __exit__(self, *exc): pass
+
+
+class NopTracer:
+    """tracing/tracing.go:38 nop default."""
+
+    def start_span(self, name, trace_id=None):
+        return NopSpan()
+
+    def finished(self, name=None):
+        return []
+
+    def inject_headers(self, span, headers): pass
+    def extract_trace_id(self, headers): return None
+
+
+# global tracer (tracing.GlobalTracer)
+global_tracer = NopTracer()
+
+
+def set_global_tracer(t) -> None:
+    global global_tracer
+    global_tracer = t
+
+
+def start_span(name: str, trace_id=None):
+    return global_tracer.start_span(name, trace_id)

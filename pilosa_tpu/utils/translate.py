@@ -1,0 +1,182 @@
+"""Key translation: string keys <-> uint64 ids.
+
+Reference: translate.go — a single-writer append-only log replicated to
+followers, with an mmapped hash index (translate.go:359-433, 1,153 LoC).
+Here: an append-only binary log replayed into host dicts on open. The
+single-writer property is preserved at the cluster level: only the primary
+translates new keys; replicas tail the log over HTTP
+(/internal/translate/data) and serve reads.
+
+Record format (little-endian):
+  kind u8 (0=column, 1=row) | index_len u16 | index | field_len u16 | field |
+  key_len u16 | key | id u64
+"""
+
+from __future__ import annotations
+
+import io
+import os
+import struct
+import threading
+from typing import Optional
+
+KIND_COLUMN = 0
+KIND_ROW = 1
+
+
+class TranslateStore:
+    def __init__(self, path: Optional[str] = None):
+        self.path = path
+        self._lock = threading.RLock()
+        self._file = None
+        # (index,) -> {key: id} and inverse; rows keyed by (index, field)
+        self._col_fwd: dict[str, dict[str, int]] = {}
+        self._col_rev: dict[str, dict[int, str]] = {}
+        self._row_fwd: dict[tuple[str, str], dict[str, int]] = {}
+        self._row_rev: dict[tuple[str, str], dict[int, str]] = {}
+        self.read_only = False  # True on replicas (non-primary)
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def open(self) -> "TranslateStore":
+        if self.path:
+            os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+            if os.path.exists(self.path):
+                with open(self.path, "rb") as f:
+                    self._replay(f.read())
+            self._file = open(self.path, "ab")
+        return self
+
+    def close(self) -> None:
+        if self._file is not None:
+            self._file.close()
+            self._file = None
+
+    def _replay(self, data: bytes) -> None:
+        pos = 0
+        n = len(data)
+        while pos < n:
+            try:
+                kind, index, field, key, id_ = _unpack_record(data, pos)
+            except (struct.error, ValueError):
+                raise ValueError(f"corrupt translate log at offset {pos}")
+            pos = _record_end(data, pos)
+            self._apply(kind, index, field, key, id_)
+
+    def _apply(self, kind: int, index: str, field: str, key: str, id_: int) -> None:
+        if kind == KIND_COLUMN:
+            self._col_fwd.setdefault(index, {})[key] = id_
+            self._col_rev.setdefault(index, {})[id_] = key
+        else:
+            self._row_fwd.setdefault((index, field), {})[key] = id_
+            self._row_rev.setdefault((index, field), {})[id_] = key
+
+    def _append(self, kind: int, index: str, field: str, key: str, id_: int) -> None:
+        if self._file is not None:
+            self._file.write(_pack_record(kind, index, field, key, id_))
+            self._file.flush()
+
+    # -- translation (translate.go TranslateColumnsToUint64 etc.) -----------
+
+    def translate_column(self, index: str, key: str, create: bool = True) -> Optional[int]:
+        with self._lock:
+            fwd = self._col_fwd.setdefault(index, {})
+            id_ = fwd.get(key)
+            if id_ is None and create:
+                if self.read_only:
+                    raise ValueError("translate store is read-only (replica)")
+                id_ = len(fwd) + 1
+                self._apply(KIND_COLUMN, index, "", key, id_)
+                self._append(KIND_COLUMN, index, "", key, id_)
+            return id_
+
+    def translate_columns(self, index: str, keys: list[str], create: bool = True) -> list[Optional[int]]:
+        return [self.translate_column(index, k, create) for k in keys]
+
+    def translate_column_to_string(self, index: str, id_: int) -> Optional[str]:
+        return self._col_rev.get(index, {}).get(id_)
+
+    def translate_row(self, index: str, field: str, key: str, create: bool = True) -> Optional[int]:
+        with self._lock:
+            fwd = self._row_fwd.setdefault((index, field), {})
+            id_ = fwd.get(key)
+            if id_ is None and create:
+                if self.read_only:
+                    raise ValueError("translate store is read-only (replica)")
+                id_ = len(fwd) + 1
+                self._apply(KIND_ROW, index, field, key, id_)
+                self._append(KIND_ROW, index, field, key, id_)
+            return id_
+
+    def translate_rows(self, index: str, field: str, keys: list[str], create: bool = True) -> list[Optional[int]]:
+        return [self.translate_row(index, field, k, create) for k in keys]
+
+    def translate_row_to_string(self, index: str, field: str, id_: int) -> Optional[str]:
+        return self._row_rev.get((index, field), {}).get(id_)
+
+    def ensure_mapping(self, kind: int, index: str, field: str, key: str,
+                       id_: int) -> None:
+        """Install a mapping minted by the primary (replica-side apply)."""
+        with self._lock:
+            fwd = (self._col_fwd.setdefault(index, {}) if kind == KIND_COLUMN
+                   else self._row_fwd.setdefault((index, field), {}))
+            if key not in fwd:
+                self._apply(kind, index, field, key, id_)
+                self._append(kind, index, field, key, id_)
+
+    # -- replication (replicas tail the primary's log;
+    #    /internal/translate/data, translate.go:662) ------------------------
+
+    def log_bytes(self, offset: int = 0) -> bytes:
+        if not self.path or not os.path.exists(self.path):
+            return b""
+        with open(self.path, "rb") as f:
+            f.seek(offset)
+            return f.read()
+
+    def log_size(self) -> int:
+        if not self.path or not os.path.exists(self.path):
+            return 0
+        return os.path.getsize(self.path)
+
+    def apply_log(self, data: bytes) -> None:
+        """Apply a primary's log chunk on a replica (and persist it)."""
+        with self._lock:
+            self._replay(data)
+            if self._file is not None:
+                self._file.write(data)
+                self._file.flush()
+
+
+def _pack_record(kind: int, index: str, field: str, key: str, id_: int) -> bytes:
+    ib, fb, kb = index.encode(), field.encode(), key.encode()
+    return b"".join([
+        struct.pack("<B", kind),
+        struct.pack("<H", len(ib)), ib,
+        struct.pack("<H", len(fb)), fb,
+        struct.pack("<H", len(kb)), kb,
+        struct.pack("<Q", id_),
+    ])
+
+
+def _unpack_record(data: bytes, pos: int):
+    (kind,) = struct.unpack_from("<B", data, pos)
+    pos += 1
+    out = []
+    for _ in range(3):
+        (ln,) = struct.unpack_from("<H", data, pos)
+        pos += 2
+        if pos + ln > len(data):
+            raise ValueError("truncated record")
+        out.append(data[pos : pos + ln].decode())
+        pos += ln
+    (id_,) = struct.unpack_from("<Q", data, pos)
+    return kind, out[0], out[1], out[2], id_
+
+
+def _record_end(data: bytes, pos: int) -> int:
+    pos += 1
+    for _ in range(3):
+        (ln,) = struct.unpack_from("<H", data, pos)
+        pos += 2 + ln
+    return pos + 8

@@ -1,0 +1,111 @@
+"""CLI + config tests (cmd/root_test.go table pattern: flag/env/TOML
+precedence; ctl import/export/inspect/check)."""
+
+import json
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+from pilosa_tpu.cli.config import Config, load_config
+from pilosa_tpu.cli.main import main
+
+
+def test_config_defaults():
+    cfg = Config()
+    assert cfg.bind == "localhost:10101"
+    assert cfg.port == 10101
+    assert cfg.cluster.disabled is True
+
+
+def test_config_toml_env_precedence(tmp_path):
+    toml = tmp_path / "c.toml"
+    toml.write_text(
+        'data-dir = "/tmp/x"\nbind = "localhost:9999"\n'
+        "[cluster]\nreplicas = 2\nhosts = [\"http://a:1\", \"http://b:2\"]\n"
+        "[anti-entropy]\ninterval = 5.0\n")
+    cfg = load_config(str(toml), environ={})
+    assert cfg.data_dir == "/tmp/x"
+    assert cfg.port == 9999
+    assert cfg.cluster.replicas == 2
+    assert cfg.cluster.hosts == ["http://a:1", "http://b:2"]
+    assert cfg.anti_entropy.interval == 5.0
+    # env overrides TOML
+    cfg = load_config(str(toml), environ={
+        "PILOSA_TPU_BIND": "localhost:8888",
+        "PILOSA_TPU_CLUSTER_REPLICAS": "3",
+        "PILOSA_TPU_VERBOSE": "true",
+    })
+    assert cfg.port == 8888
+    assert cfg.cluster.replicas == 3
+    assert cfg.verbose is True
+
+
+def test_generate_config_roundtrip(tmp_path, capsys):
+    assert main(["generate-config"]) == 0
+    out = capsys.readouterr().out
+    toml = tmp_path / "gen.toml"
+    toml.write_text(out)
+    cfg = load_config(str(toml), environ={})
+    assert cfg.bind == Config().bind
+
+
+def test_inspect_and_check(tmp_path, capsys):
+    import numpy as np
+    from pilosa_tpu.storage.roaring import Bitmap
+    path = tmp_path / "frag"
+    with open(path, "wb") as f:
+        Bitmap(np.arange(100, dtype=np.uint64)).write_to(f)
+    assert main(["inspect", str(path)]) == 0
+    info = json.loads(capsys.readouterr().out)
+    assert info["bits"] == 100
+    assert main(["check", str(path)]) == 0
+    assert "OK" in capsys.readouterr().out
+    bad = tmp_path / "bad"
+    bad.write_bytes(b"\x99\x99 garbage")
+    assert main(["check", str(bad)]) == 1
+    assert "FAILED" in capsys.readouterr().out
+
+
+@pytest.fixture
+def live_server(tmp_path):
+    """Spawn `pilosa-tpu server` as a real subprocess on a random port."""
+    import socket
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        port = s.getsockname()[1]
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "pilosa_tpu.cli", "server",
+         "--data-dir", str(tmp_path / "data"), "--bind", f"localhost:{port}"],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+    uri = f"http://localhost:{port}"
+    for _ in range(100):
+        try:
+            urllib.request.urlopen(uri + "/version", timeout=1)
+            break
+        except OSError:
+            if proc.poll() is not None:
+                raise RuntimeError(
+                    f"server died: {proc.stderr.read().decode()}")
+            time.sleep(0.2)
+    else:
+        proc.kill()
+        raise RuntimeError("server did not come up")
+    yield uri
+    proc.terminate()
+    proc.wait(timeout=10)
+
+
+def test_server_import_export_cli(live_server, tmp_path, capsys):
+    csv_in = tmp_path / "in.csv"
+    csv_in.write_text("1,10\n1,20\n2,30\n")
+    assert main(["import", "--host", live_server, "--index", "i",
+                 "--field", "f", "--create", str(csv_in)]) == 0
+    assert "imported 3 records" in capsys.readouterr().out
+    out_file = tmp_path / "out.csv"
+    assert main(["export", "--host", live_server, "--index", "i",
+                 "--field", "f", "-o", str(out_file)]) == 0
+    assert sorted(out_file.read_text().strip().splitlines()) == [
+        "1,10", "1,20", "2,30"]

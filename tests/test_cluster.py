@@ -1,0 +1,153 @@
+"""Cluster placement + resize planning tests.
+
+Mirrors cluster_internal_test.go: partition/jump-hash placement vs hand-built
+clusters, fragSources resize planning, state machine.
+"""
+
+import pytest
+
+from pilosa_tpu.parallel.cluster import (
+    EVENT_JOIN,
+    EVENT_LEAVE,
+    STATE_DEGRADED,
+    STATE_NORMAL,
+    STATE_RESIZING,
+    STATE_STARTING,
+    Cluster,
+    Node,
+)
+from pilosa_tpu.parallel.placement import ModHasher, fnv64a, jump_hash, partition
+
+
+def make_cluster(n, replica_n=1, schema=None, hasher=None):
+    c = Cluster("node0", replica_n=replica_n, hasher=hasher,
+                schema_fn=(lambda: schema) if schema else None)
+    c.set_static([Node(id=f"node{i}", uri=f"http://host{i}:10101") for i in range(n)])
+    return c
+
+
+def test_fnv64a_vectors():
+    # published FNV-1a 64 test vectors
+    assert fnv64a(b"") == 0xCBF29CE484222325
+    assert fnv64a(b"a") == 0xAF63DC4C8601EC8C
+    assert fnv64a(b"foobar") == 0x85944171F73967E8
+
+
+def test_jump_hash_properties():
+    # deterministic, in-range, and monotone-consistent: growing n only moves
+    # keys INTO the new bucket
+    for n in (1, 2, 5, 16):
+        for key in range(200):
+            b = jump_hash(key, n)
+            assert 0 <= b < n
+    moved = 0
+    for key in range(1000):
+        b5, b6 = jump_hash(key, 5), jump_hash(key, 6)
+        if b5 != b6:
+            assert b6 == 5
+            moved += 1
+    # ~1/6 of keys move
+    assert 100 < moved < 250
+
+
+def test_partition_stability():
+    # partition depends on index name and shard
+    assert partition("i", 0) == partition("i", 0)
+    spread = {partition("i", s) for s in range(1000)}
+    assert len(spread) > 200  # well-spread over 256 partitions
+
+
+def test_placement_replicas():
+    c = make_cluster(4, replica_n=2)
+    nodes = c.shard_nodes("i", 7)
+    assert len(nodes) == 2
+    assert nodes[0].id != nodes[1].id
+    # replicas are ring successors
+    ids = [n.id for n in c.nodes]
+    i0 = ids.index(nodes[0].id)
+    assert nodes[1].id == ids[(i0 + 1) % 4]
+    # replica_n clamped to cluster size
+    c2 = make_cluster(2, replica_n=5)
+    assert len(c2.shard_nodes("i", 1)) == 2
+
+
+def test_owns_and_group_by_node():
+    c = make_cluster(3, hasher=ModHasher())
+    groups = c.shards_by_node("i", list(range(12)))
+    total = sum(len(v) for v in groups.values())
+    assert total == 12
+    for node_id, shards in groups.items():
+        for s in shards:
+            assert c.owns_shard(node_id, "i", s)
+
+
+def test_resize_plan_join():
+    schema = {"i": {"f": {"standard": list(range(8))}}}
+    c = make_cluster(2, schema=schema)
+    job = c.node_join(Node(id="node9", uri="http://host9:10101"))
+    assert c.state == STATE_RESIZING
+    assert job is not None
+    # every fetch instruction targets the new topology and sources an old owner
+    old_ids = {"node0", "node1"}
+    for target, sources in job.instructions.items():
+        for src in sources:
+            assert src.from_node in old_ids
+            assert target not in (src.from_node,)
+    # the new node must appear in the instruction map
+    assert "node9" in job.instructions
+    # completing all instructions transitions to NORMAL and adds the node
+    for node_id in list(job.instructions):
+        c.complete_resize(job, node_id)
+    assert c.state == STATE_NORMAL
+    assert c.node_by_id("node9") is not None
+
+
+def test_resize_plan_leave():
+    schema = {"i": {"f": {"standard": list(range(8))}}}
+    c = make_cluster(3, replica_n=2, schema=schema)
+    job = c.node_leave("node2")
+    assert job is not None and c.state == STATE_RESIZING
+    for target, sources in job.instructions.items():
+        assert target != "node2"
+        for src in sources:
+            assert src.from_node != "node2" or True  # donor must survive
+            assert src.from_node in {"node0", "node1"}
+    for node_id in list(job.instructions):
+        c.complete_resize(job, node_id)
+    assert c.state == STATE_NORMAL
+    assert c.node_by_id("node2") is None
+
+
+def test_leave_below_replica_degrades():
+    c = make_cluster(2, replica_n=2)
+    job = c.node_leave("node1")
+    assert job is None
+    assert c.state == STATE_DEGRADED
+    assert c.node_by_id("node1") is None
+
+
+def test_abort_resize():
+    schema = {"i": {"f": {"standard": [0]}}}
+    c = make_cluster(2, schema=schema)
+    c.node_join(Node(id="nodez"))
+    assert c.state == STATE_RESIZING
+    c.abort_resize()
+    assert c.state == STATE_NORMAL
+    assert c.node_by_id("nodez") is None
+
+
+def test_topology_persistence(tmp_path):
+    path = str(tmp_path / ".topology")
+    c = Cluster("a", topology_path=path)
+    c.add_node(Node(id="a"))
+    c.add_node(Node(id="b"))
+    c2 = Cluster("a", topology_path=path)
+    assert c2.load_topology() == ["a", "b"]
+
+
+def test_initial_state():
+    c = Cluster("x")
+    assert c.state == STATE_STARTING
+    c.set_static([Node(id="x")])
+    assert c.state == STATE_NORMAL
+    assert c.is_coordinator()

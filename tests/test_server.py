@@ -1,0 +1,330 @@
+"""Server + HTTP API tests: full in-process servers on random ports.
+
+Mirrors the reference's test harness (test/pilosa.go:38-128 Command,
+test/pilosa.go:297-352 MustRunCluster): black-box HTTP against real servers,
+including a 3-node in-process cluster with distributed queries, replicated
+writes and anti-entropy.
+"""
+
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+from pilosa_tpu.constants import SHARD_WIDTH
+from pilosa_tpu.server import Server
+
+
+def http(method, uri, path, body=None):
+    req = urllib.request.Request(uri + path, data=body, method=method)
+    try:
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            return resp.status, resp.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+def jpost(uri, path, payload=None, raw=None):
+    body = raw if raw is not None else (json.dumps(payload).encode() if payload is not None else b"")
+    status, out = http("POST", uri, path, body)
+    return status, json.loads(out) if out else {}
+
+
+@pytest.fixture
+def server(tmp_path):
+    s = Server(str(tmp_path / "node"), port=0).open()
+    yield s
+    s.close()
+
+
+def test_home_version_status(server):
+    status, out = http("GET", server.uri, "/")
+    assert status == 200
+    assert json.loads(out)["name"] == "pilosa-tpu"
+    status, out = http("GET", server.uri, "/version")
+    assert json.loads(out)["version"]
+    status, out = http("GET", server.uri, "/status")
+    d = json.loads(out)
+    assert d["state"] == "NORMAL"
+    assert len(d["nodes"]) == 1
+
+
+def test_schema_ddl_and_query(server):
+    u = server.uri
+    status, _ = jpost(u, "/index/i", {"options": {}})
+    assert status == 200
+    status, _ = jpost(u, "/index/i/field/f", {"options": {"type": "set"}})
+    assert status == 200
+    # duplicate -> 409
+    status, out = jpost(u, "/index/i", {"options": {}})
+    assert status == 409
+    # write + read through PQL over HTTP
+    status, out = jpost(u, "/index/i/query", raw=b"Set(100, f=1)")
+    assert status == 200 and out["results"] == [True]
+    status, out = jpost(u, "/index/i/query", raw=f"Set({SHARD_WIDTH+5}, f=1)".encode())
+    status, out = jpost(u, "/index/i/query", raw=b"Row(f=1)")
+    assert out["results"][0]["columns"] == [100, SHARD_WIDTH + 5]
+    status, out = jpost(u, "/index/i/query", raw=b"Count(Row(f=1))")
+    assert out["results"] == [2]
+    # schema reflects everything
+    status, out = http("GET", u, "/schema")
+    schema = json.loads(out)
+    assert schema["indexes"][0]["name"] == "i"
+    assert schema["indexes"][0]["fields"][0]["name"] == "f"
+    # bad pql -> 400 with error
+    status, out = jpost(u, "/index/i/query", raw=b"Row(")
+    assert status == 400 and "error" in out
+    # missing index -> 404
+    status, out = jpost(u, "/index/nope/query", raw=b"Row(f=1)")
+    assert status == 404
+
+
+def test_import_and_export(server):
+    u = server.uri
+    jpost(u, "/index/i", {})
+    jpost(u, "/index/i/field/f", {})
+    status, _ = jpost(u, "/index/i/field/f/import",
+                      {"rowIDs": [1, 1, 2], "columnIDs": [3, 4, 5]})
+    assert status == 200
+    _, out = jpost(u, "/index/i/query", raw=b"Row(f=1)")
+    assert out["results"][0]["columns"] == [3, 4]
+    status, out = http("GET", u, "/export?index=i&field=f&shard=0")
+    assert status == 200
+    lines = sorted(out.decode().strip().splitlines())
+    assert lines == ["1,3", "1,4", "2,5"]
+
+
+def test_import_values_and_bsi_query(server):
+    u = server.uri
+    jpost(u, "/index/i", {})
+    jpost(u, "/index/i/field/v", {"options": {"type": "int", "min": 0, "max": 1000}})
+    status, _ = jpost(u, "/index/i/field/v/import",
+                      {"columnIDs": [1, 2, 3], "values": [10, 20, 30]})
+    assert status == 200
+    _, out = jpost(u, "/index/i/query", raw=b"Sum(field=v)")
+    assert out["results"][0] == {"value": 60, "count": 3}
+    _, out = jpost(u, "/index/i/query", raw=b"Range(v > 15)")
+    assert out["results"][0]["columns"] == [2, 3]
+
+
+def test_keyed_index(server):
+    u = server.uri
+    jpost(u, "/index/ki", {"options": {"keys": True}})
+    jpost(u, "/index/ki/field/f", {"options": {"keys": True}})
+    status, out = jpost(u, "/index/ki/query", raw=b"Set('col-a', f='row-x')")
+    assert status == 200 and out["results"] == [True]
+    jpost(u, "/index/ki/query", raw=b"Set('col-b', f='row-x')")
+    _, out = jpost(u, "/index/ki/query", raw=b"Row(f='row-x')")
+    assert sorted(out["results"][0]["keys"]) == ["col-a", "col-b"]
+    # translate endpoint
+    status, out = jpost(u, "/internal/translate/keys",
+                        {"index": "ki", "field": None, "keys": ["col-a", "col-new"]})
+    assert status == 200
+    assert out["ids"][0] == 1 and out["ids"][1] >= 2
+
+
+def test_fragment_internals_and_misc(server):
+    u = server.uri
+    jpost(u, "/index/i", {})
+    jpost(u, "/index/i/field/f", {})
+    jpost(u, "/index/i/query", raw=b"Set(1, f=1)")
+    status, out = http("GET", u, "/internal/fragment/blocks?index=i&field=f&view=standard&shard=0")
+    assert status == 200 and json.loads(out)["blocks"]
+    status, out = http("GET", u, "/internal/fragment/data?index=i&field=f&view=standard&shard=0")
+    assert status == 200 and out[:2] == (12348).to_bytes(2, "little")
+    status, out = http("GET", u, "/internal/shards/max")
+    assert json.loads(out)["standard"]["i"] == 0
+    status, out = http("GET", u, "/internal/nodes")
+    assert len(json.loads(out)) == 1
+    status, out = http("GET", u, "/info")
+    assert json.loads(out)["shardWidth"] == SHARD_WIDTH
+    status, _ = jpost(u, "/recalculate-caches")
+    assert status == 200
+    # unknown route / bad method
+    status, _ = http("GET", u, "/nope")
+    assert status == 404
+    status, _ = http("DELETE", u, "/schema")
+    assert status in (404, 405)
+
+
+def test_persistence_across_restart(tmp_path):
+    s = Server(str(tmp_path / "n"), port=0).open()
+    jpost(s.uri, "/index/i", {})
+    jpost(s.uri, "/index/i/field/f", {})
+    jpost(s.uri, "/index/i/query", raw=b"Set(7, f=3)")
+    node_id = s.node_id
+    s.close()
+    s2 = Server(str(tmp_path / "n"), port=0).open()
+    assert s2.node_id == node_id  # .id file persisted
+    _, out = jpost(s2.uri, "/index/i/query", raw=b"Row(f=3)")
+    assert out["results"][0]["columns"] == [7]
+    s2.close()
+
+
+# ---------------------------------------------------------------------------
+# multi-node cluster (MustRunCluster analog)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def cluster3(tmp_path):
+    servers = []
+    # boot 3 servers, then point them at each other and refresh membership
+    for i in range(3):
+        s = Server(str(tmp_path / f"n{i}"), port=0, replica_n=2).open()
+        servers.append(s)
+    uris = [s.uri for s in servers]
+    for s in servers:
+        s.cluster_hosts = uris
+        s.refresh_membership()
+    yield servers
+    for s in servers:
+        s.close()
+
+
+def test_cluster_membership(cluster3):
+    for s in cluster3:
+        assert len(s.cluster.nodes) == 3
+        assert s.cluster.state == "NORMAL"
+    # same coordinator everywhere
+    coords = {s.cluster.coordinator_id for s in cluster3}
+    assert len(coords) == 1
+
+
+def test_cluster_ddl_broadcast_and_distributed_query(cluster3):
+    s0, s1, s2 = cluster3
+    jpost(s0.uri, "/index/i", {})
+    jpost(s0.uri, "/index/i/field/f", {})
+    # DDL must have propagated
+    for s in cluster3:
+        assert s.holder.index("i") is not None
+        assert s.holder.index("i").field("f") is not None
+    # writes route to shard owners (with replication)
+    cols = [5, SHARD_WIDTH + 9, 2 * SHARD_WIDTH + 3, 3 * SHARD_WIDTH + 1]
+    for c in cols:
+        status, out = jpost(s0.uri, "/index/i/query", raw=f"Set({c}, f=1)".encode())
+        assert status == 200, out
+    # distributed read from any node sees all columns
+    for s in cluster3:
+        _, out = jpost(s.uri, "/index/i/query", raw=b"Row(f=1)")
+        assert out["results"][0]["columns"] == cols, s.uri
+        _, out = jpost(s.uri, "/index/i/query", raw=b"Count(Row(f=1))")
+        assert out["results"] == [4]
+    # each shard is stored on exactly replica_n nodes
+    for c in cols:
+        shard = c // SHARD_WIDTH
+        holders = sum(
+            1 for s in cluster3
+            if s.holder.index("i").field("f").view("standard")
+            and s.holder.index("i").field("f").view("standard").fragment(shard)
+            and s.holder.index("i").field("f").view("standard").fragment(shard).bit_count() > 0
+        )
+        assert holders == 2, f"shard {shard} on {holders} nodes"
+
+
+def test_cluster_distributed_topn_and_sum(cluster3):
+    s0 = cluster3[0]
+    jpost(s0.uri, "/index/i", {})
+    jpost(s0.uri, "/index/i/field/f", {})
+    jpost(s0.uri, "/index/i/field/v", {"options": {"type": "int", "min": 0, "max": 100}})
+    for c in range(6):
+        jpost(s0.uri, "/index/i/query", raw=f"Set({c * SHARD_WIDTH}, f=1)".encode())
+    for c in range(3):
+        jpost(s0.uri, "/index/i/query", raw=f"Set({c * SHARD_WIDTH + 1}, f=2)".encode())
+        jpost(s0.uri, "/index/i/query", raw=f"Set({c * SHARD_WIDTH + 1}, v=10)".encode())
+    _, out = jpost(cluster3[1].uri, "/index/i/query", raw=b"TopN(f, n=2)")
+    assert out["results"][0] == [{"id": 1, "count": 6}, {"id": 2, "count": 3}]
+    _, out = jpost(cluster3[2].uri, "/index/i/query", raw=b"Sum(field=v)")
+    assert out["results"][0] == {"value": 30, "count": 3}
+
+
+def test_anti_entropy_heals_divergence(cluster3):
+    s0, s1, s2 = cluster3
+    jpost(s0.uri, "/index/i", {})
+    jpost(s0.uri, "/index/i/field/f", {})
+    jpost(s0.uri, "/index/i/query", raw=b"Set(1, f=1)")
+    # find the two owners of shard 0 and diverge one replica manually
+    owners = [s for s in cluster3
+              if s.cluster.owns_shard(s.node_id, "i", 0)]
+    assert len(owners) == 2
+    frag = owners[0].holder.index("i").field("f").view("standard").fragment(0)
+    frag.set_bit(1, 99)  # local-only write, bypassing replication
+    # peer doesn't have it yet
+    peer_frag = owners[1].holder.index("i").field("f").view("standard").fragment(0)
+    assert not peer_frag.contains(1, 99)
+    merged = owners[0].sync_holder()
+    assert merged > 0
+    assert peer_frag.contains(1, 99)
+
+
+def test_cluster_empty_partials_and_options(cluster3):
+    s0 = cluster3[0]
+    jpost(s0.uri, "/index/i", {})
+    jpost(s0.uri, "/index/i/field/f", {})
+    jpost(s0.uri, "/index/i/query", raw=b"Set(1, f=1)")
+    # TopN/GroupBy where remote nodes have empty partials must not crash
+    _, out = jpost(cluster3[1].uri, "/index/i/query", raw=b"TopN(f, n=5)")
+    assert out["results"][0] == [{"id": 1, "count": 1}]
+    _, out = jpost(cluster3[1].uri, "/index/i/query", raw=b"GroupBy(Rows(field=f))")
+    assert out["results"][0] == [
+        {"group": [{"field": "f", "rowID": 1}], "count": 1}]
+    _, out = jpost(cluster3[1].uri, "/index/i/query", raw=b"Rows(field=f)")
+    assert out["results"][0] == {"rows": [1]}
+    # Options() must reduce over ALL nodes' shards, not just the first
+    jpost(s0.uri, "/index/i/query", raw=f"Set({SHARD_WIDTH * 3 + 7}, f=1)".encode())
+    for s in cluster3:
+        _, out = jpost(s.uri, "/index/i/query", raw=b"Options(Count(Row(f=1)))")
+        assert out["results"] == [2], s.uri
+
+
+def test_cluster_groupby_limit_correctness(cluster3):
+    s0 = cluster3[0]
+    jpost(s0.uri, "/index/i", {})
+    jpost(s0.uri, "/index/i/field/f", {})
+    # row 1 sparse on an early shard; row 2 heavy across shards: a per-node
+    # limit would truncate differently per node
+    jpost(s0.uri, "/index/i/query", raw=b"Set(1, f=1)")
+    for k in range(4):
+        jpost(s0.uri, "/index/i/query", raw=f"Set({k * SHARD_WIDTH + 2}, f=2)".encode())
+    _, out = jpost(cluster3[2].uri, "/index/i/query",
+                   raw=b"GroupBy(Rows(field=f), limit=2)")
+    assert out["results"][0] == [
+        {"group": [{"field": "f", "rowID": 1}], "count": 1},
+        {"group": [{"field": "f", "rowID": 2}], "count": 4},
+    ]
+
+
+def test_cluster_keyed_index_consistent_ids(cluster3):
+    s0, s1, s2 = cluster3
+    jpost(s0.uri, "/index/ki", {"options": {"keys": True}})
+    jpost(s0.uri, "/index/ki/field/f", {"options": {"keys": True}})
+    # writes through different nodes must agree on the key -> id mapping
+    jpost(s1.uri, "/index/ki/query", raw=b"Set('a', f='x')")
+    jpost(s2.uri, "/index/ki/query", raw=b"Set('b', f='x')")
+    jpost(s0.uri, "/index/ki/query", raw=b"Set('c', f='y')")
+    for s in cluster3:
+        _, out = jpost(s.uri, "/index/ki/query", raw=b"Row(f='x')")
+        assert sorted(out["results"][0]["keys"]) == ["a", "b"], s.uri
+    # id mappings agree across nodes (single-writer primary): a node may hold
+    # only a subset of keys, but never a conflicting id for the same key
+    combined: dict[str, int] = {}
+    for srv in cluster3:
+        for k, v in srv.translate._col_fwd.get("ki", {}).items():
+            assert combined.setdefault(k, v) == v, (k, v, combined)
+
+
+def test_cluster_failover_per_shard_remap(cluster3):
+    s0, s1, s2 = cluster3
+    jpost(s0.uri, "/index/i", {})
+    jpost(s0.uri, "/index/i/field/f", {})
+    cols = [k * SHARD_WIDTH for k in range(6)]
+    for c in cols:
+        jpost(s0.uri, "/index/i/query", raw=f"Set({c}, f=1)".encode())
+    # kill one server's HTTP abruptly; others must failover per shard
+    victim = s2
+    victim.http.close()
+    survivors = [s for s in cluster3 if s is not victim]
+    for s in survivors:
+        _, out = jpost(s.uri, "/index/i/query", raw=b"Count(Row(f=1))")
+        assert out["results"] == [6], s.uri

@@ -1,0 +1,113 @@
+"""Aux subsystem tests: stats, tracing, logger, attr store, translate store."""
+
+import io
+
+import pytest
+
+from pilosa_tpu.utils.attrstore import AttrStore, NopAttrStore
+from pilosa_tpu.utils.logger import Logger, NopLogger
+from pilosa_tpu.utils.stats import NopStatsClient, StatsClient, new_stats_client
+from pilosa_tpu.utils.tracing import NopTracer, Tracer
+from pilosa_tpu.utils.translate import TranslateStore
+
+
+def test_stats_counts_gauges_timings():
+    s = StatsClient()
+    s.count("queries")
+    s.count("queries", 2)
+    s.gauge("goroutines", 5)
+    s.timing("latency", 1.5)
+    s.timing("latency", 0.5)
+    s.set("indexes", "i")
+    snap = s.snapshot()
+    assert snap["counts"]["queries"] == 3
+    assert snap["gauges"]["goroutines"] == 5
+    assert snap["timings"]["latency"]["count"] == 2
+    assert snap["timings"]["latency"]["min"] == 0.5
+    assert snap["sets"]["indexes"] == ["i"]
+    # tags namespace, shared store
+    s.with_tags("index:i").count("queries")
+    assert s.snapshot()["counts"]["queries,index:i"] == 1
+    assert new_stats_client("nop").snapshot() == {}
+    NopStatsClient().count("x")  # no-op
+
+
+def test_tracer_spans_and_propagation():
+    t = Tracer()
+    with t.start_span("executor.Count") as span:
+        span.set_tag("index", "i")
+    spans = t.finished("executor.Count")
+    assert len(spans) == 1
+    assert spans[0].tags == {"index": "i"}
+    assert spans[0].duration() >= 0
+    headers = {}
+    t.inject_headers(spans[0], headers)
+    assert t.extract_trace_id(headers) == spans[0].trace_id
+    assert NopTracer().finished() == []
+
+
+def test_logger():
+    buf = io.StringIO()
+    log = Logger(verbose=False, out=buf)
+    log.printf("hello %s", "world")
+    log.debugf("hidden")
+    out = buf.getvalue()
+    assert "hello world" in out and "hidden" not in out
+    Logger(verbose=True, out=buf).debugf("shown")
+    assert "shown" in buf.getvalue()
+    NopLogger().printf("x")
+
+
+def test_attrstore(tmp_path):
+    s = AttrStore(str(tmp_path / "a.db")).open()
+    s.set_attrs(1, {"color": "red", "n": 5})
+    s.set_attrs(1, {"n": None, "x": True})  # merge + delete
+    assert s.attrs(1) == {"color": "red", "x": True}
+    s.set_attrs(250, {"y": 1})
+    assert s.ids() == [1, 250]
+    blocks = dict(s.blocks())
+    assert set(blocks) == {0, 2}
+    assert s.block_data(2) == [(250, {"y": 1})]
+    s.close()
+    # persistence
+    s2 = AttrStore(str(tmp_path / "a.db")).open()
+    assert s2.attrs(1) == {"color": "red", "x": True}
+    s2.close()
+    assert NopAttrStore().open().attrs(1) == {}
+
+
+def test_translate_store_persistence(tmp_path):
+    path = str(tmp_path / "keys")
+    t = TranslateStore(path).open()
+    a = t.translate_column("i", "alpha")
+    b = t.translate_column("i", "beta")
+    assert (a, b) == (1, 2)
+    assert t.translate_column("i", "alpha") == 1  # stable
+    r = t.translate_row("i", "f", "row-key")
+    assert r == 1  # row namespace separate from columns
+    assert t.translate_column_to_string("i", 1) == "alpha"
+    assert t.translate_row_to_string("i", "f", 1) == "row-key"
+    t.close()
+    t2 = TranslateStore(path).open()
+    assert t2.translate_column("i", "alpha", create=False) == 1
+    assert t2.translate_column("i", "gamma") == 3
+    t2.close()
+
+
+def test_translate_replication(tmp_path):
+    primary = TranslateStore(str(tmp_path / "p")).open()
+    primary.translate_column("i", "k1")
+    primary.translate_column("i", "k2")
+    replica = TranslateStore(str(tmp_path / "r")).open()
+    replica.read_only = True
+    replica.apply_log(primary.log_bytes(0))
+    assert replica.translate_column("i", "k1", create=False) == 1
+    with pytest.raises(ValueError):
+        replica.translate_column("i", "new-key")
+    # incremental tail
+    off = primary.log_size()
+    primary.translate_column("i", "k3")
+    replica.apply_log(primary.log_bytes(off))
+    assert replica.translate_column("i", "k3", create=False) == 3
+    primary.close()
+    replica.close()
