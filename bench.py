@@ -28,13 +28,28 @@ def main() -> None:
     program = ("and", ("leaf", 0), ("leaf", 1))
 
     # --- TPU path: HBM-resident slab, fused and+popcount ---
+    # Chained-dependency timing: iteration i's input depends on i-1's result,
+    # so N executions serialize on device and one final fetch amortizes the
+    # host<->device round trip. (Plain async loops under-measure; per-call
+    # fetches measure tunnel RTT instead of the kernel.)
+    import jax.numpy as jnp
+
     slab = jax.device_put(slab_np)
-    total = int(eval_count_total(slab, program))  # compile + warm
-    iters = 30
+
+    @jax.jit
+    def step(d, carry):
+        d2 = d.at[0, 0, 0].set(carry)
+        return eval_count_total(d2, program).astype(jnp.uint32)
+
+    total = int(eval_count_total(slab, program))  # compile + warm the plain path
+    carry = jnp.uint32(0)
+    int(step(slab, carry))  # compile + warm the chained step
+    iters = 40
     t0 = time.perf_counter()
+    carry = jnp.uint32(1)
     for _ in range(iters):
-        r = eval_count_total(slab, program)
-    jax.block_until_ready(r)
+        carry = step(slab, carry)
+    int(carry)  # forces the whole chain
     tpu_s = (time.perf_counter() - t0) / iters
 
     # --- CPU baseline: same kernel in numpy ---

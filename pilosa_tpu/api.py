@@ -387,10 +387,11 @@ class API:
     # -- translation --------------------------------------------------------
 
     def translate_keys(self, index_name: str, field_name: Optional[str],
-                       keys: list[str]) -> list[int]:
+                       keys: list[str], create: bool = True) -> list:
         if field_name:
-            return self.translate.translate_rows(index_name, field_name, keys)
-        return self.translate.translate_columns(index_name, keys)
+            return self.translate.translate_rows(index_name, field_name, keys,
+                                                 create=create)
+        return self.translate.translate_columns(index_name, keys, create=create)
 
     def translate_data(self, offset: int = 0) -> bytes:
         return self.translate.log_bytes(offset)

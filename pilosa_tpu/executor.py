@@ -212,6 +212,18 @@ class Executor:
             cols = columns_from_dense(dense[i])
             if cols.size:
                 out.segments[shard] = cols.astype(np.uint64) + np.uint64(shard * SHARD_WIDTH)
+        # top-level Row() results carry the row's attrs (executeBitmapCall
+        # attaches them from the row attr store, executor.go:1173-1208)
+        if call.name == "Row":
+            f = index.field(call.field_arg())
+            if f is not None:
+                row_id = self._translate_row(index, f,
+                                             call.args[call.field_arg()],
+                                             create=False)
+                if row_id is not None:
+                    attrs = f.row_attrs.attrs(row_id)
+                    if attrs:
+                        out.attrs = attrs
         return out
 
     def _execute_count(self, index: Index, call: Call, shards) -> int:
@@ -244,7 +256,9 @@ class Executor:
         f = index.field(field_name)
         if f is None:
             raise ExecutionError(f"field not found: {field_name}")
-        row_id = self._translate_row(index, f, row_val)
+        row_id = self._translate_row(index, f, row_val, create=False)
+        if row_id is None:  # unknown key: empty row, no id minting
+            return np.zeros((len(shards), WORDS), dtype=np.uint32)
         if f.options.type == FieldType.BOOL and isinstance(row_val, bool):
             row_id = 1 if row_val else 0
         # Row(f=r, from/to) time bounds are handled by Range in v1.2
@@ -586,20 +600,23 @@ class Executor:
 
     # -------------------------------------------------------------- writes
 
-    def _translate_col(self, index: Index, value):
+    def _translate_col(self, index: Index, value, create: bool = True):
+        """Column key -> id. Reads pass create=False: querying an unknown key
+        must not mint ids into the shared translate log."""
         if isinstance(value, str):
             if self.translator is None:
                 raise ExecutionError("string keys require a translator")
-            return self.translator.translate_column(index.name, value)
+            return self.translator.translate_column(index.name, value, create=create)
         return int(value)
 
-    def _translate_row(self, index: Index, f, value):
+    def _translate_row(self, index: Index, f, value, create: bool = True):
         if isinstance(value, bool):
             return 1 if value else 0
         if isinstance(value, str):
             if self.translator is None:
                 raise ExecutionError("string keys require a translator")
-            return self.translator.translate_row(index.name, f.name, value)
+            return self.translator.translate_row(index.name, f.name, value,
+                                                 create=create)
         return int(value)
 
     def _execute_set(self, index: Index, call: Call, shards) -> bool:
@@ -618,14 +635,18 @@ class Executor:
         return changed
 
     def _execute_clear(self, index: Index, call: Call, shards) -> bool:
-        col = self._translate_col(index, call.args["_col"])
+        col = self._translate_col(index, call.args["_col"], create=False)
         field_name = call.field_arg()
         f = index.field(field_name)
         if f is None:
             raise ExecutionError(f"field not found: {field_name}")
+        if col is None:
+            return False  # unknown column key: nothing to clear
         if f.options.type == FieldType.INT:
             return f.clear_value(col)
-        row_id = self._translate_row(index, f, call.args[field_name])
+        row_id = self._translate_row(index, f, call.args[field_name], create=False)
+        if row_id is None:
+            return False
         return f.clear_bit(row_id, col)
 
     def _execute_clear_row(self, index: Index, call: Call, shards) -> bool:
@@ -633,7 +654,9 @@ class Executor:
         f = index.field(field_name)
         if f is None:
             raise ExecutionError(f"field not found: {field_name}")
-        row_id = self._translate_row(index, f, call.args[field_name])
+        row_id = self._translate_row(index, f, call.args[field_name], create=False)
+        if row_id is None:
+            return False
         changed = False
         for v in f.views.values():
             if v.name.startswith("bsig_"):
@@ -815,9 +838,12 @@ class Executor:
             if not isinstance(obj, dict):
                 return Row()
             if "keys" in obj and self.translator is not None:
-                # keyed index: the node JSON-encodes columns as keys
-                cols = [self.translator.translate_column(index.name, k)
+                # keyed index: the node JSON-encodes columns as keys. Lookup
+                # only (create=False) — decoding a result must never mint ids.
+                cols = [self.translator.translate_column(index.name, k,
+                                                         create=False)
                         for k in obj["keys"]]
+                cols = [c for c in cols if c is not None]
             else:
                 cols = obj.get("columns", [])
             return Row(np.array(cols, dtype=np.uint64))

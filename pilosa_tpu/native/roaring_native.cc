@@ -1,0 +1,185 @@
+// Native storage-side kernels for the host roaring layer.
+//
+// The reference's hot host paths are Go compiled code leaning on
+// math/bits.OnesCount64 (roaring/roaring.go:3801) and hand-specialized
+// container pairwise loops (roaring/roaring.go:2162-3353). Here the TPU owns
+// query compute, but the *storage* side — container set algebra during
+// imports/merges, dense row materialization for HBM upload, op-log
+// checksums — still runs on host, so those are C++ (SURVEY.md §2.9).
+//
+// Plain C ABI for ctypes. All buffers are caller-allocated.
+
+#include <cstddef>
+#include <cstdint>
+#include <cstring>
+
+extern "C" {
+
+// ---------------------------------------------------------------- hashes
+
+// FNV-1a 32: op-log record checksums (roaring/roaring.go:3354-3420).
+uint32_t pt_fnv1a32(const uint8_t* data, size_t n) {
+  uint32_t h = 2166136261u;
+  for (size_t i = 0; i < n; i++) {
+    h ^= data[i];
+    h *= 16777619u;
+  }
+  return h;
+}
+
+// FNV-1a 64: partition hashing (cluster.go:828).
+uint64_t pt_fnv64a(const uint8_t* data, size_t n) {
+  uint64_t h = 14695981039346656037ull;
+  for (size_t i = 0; i < n; i++) {
+    h ^= data[i];
+    h *= 1099511628211ull;
+  }
+  return h;
+}
+
+// -------------------------------------------------------------- popcount
+
+uint64_t pt_popcount64(const uint64_t* words, size_t n) {
+  uint64_t total = 0;
+  for (size_t i = 0; i < n; i++) total += (uint64_t)__builtin_popcountll(words[i]);
+  return total;
+}
+
+uint64_t pt_and_count(const uint64_t* a, const uint64_t* b, size_t n) {
+  uint64_t total = 0;
+  for (size_t i = 0; i < n; i++)
+    total += (uint64_t)__builtin_popcountll(a[i] & b[i]);
+  return total;
+}
+
+// --------------------------------------------- sorted-uint16 container ops
+// (array-container set algebra: intersect/union/difference/xor,
+//  roaring/roaring.go:2292-3353). out must hold na+nb elements.
+
+size_t pt_array_intersect(const uint16_t* a, size_t na, const uint16_t* b,
+                          size_t nb, uint16_t* out) {
+  size_t i = 0, j = 0, k = 0;
+  while (i < na && j < nb) {
+    if (a[i] < b[j]) i++;
+    else if (a[i] > b[j]) j++;
+    else { out[k++] = a[i]; i++; j++; }
+  }
+  return k;
+}
+
+size_t pt_array_union(const uint16_t* a, size_t na, const uint16_t* b,
+                      size_t nb, uint16_t* out) {
+  size_t i = 0, j = 0, k = 0;
+  while (i < na && j < nb) {
+    if (a[i] < b[j]) out[k++] = a[i++];
+    else if (a[i] > b[j]) out[k++] = b[j++];
+    else { out[k++] = a[i]; i++; j++; }
+  }
+  while (i < na) out[k++] = a[i++];
+  while (j < nb) out[k++] = b[j++];
+  return k;
+}
+
+size_t pt_array_difference(const uint16_t* a, size_t na, const uint16_t* b,
+                           size_t nb, uint16_t* out) {
+  size_t i = 0, j = 0, k = 0;
+  while (i < na && j < nb) {
+    if (a[i] < b[j]) out[k++] = a[i++];
+    else if (a[i] > b[j]) j++;
+    else { i++; j++; }
+  }
+  while (i < na) out[k++] = a[i++];
+  return k;
+}
+
+size_t pt_array_xor(const uint16_t* a, size_t na, const uint16_t* b, size_t nb,
+                    uint16_t* out) {
+  size_t i = 0, j = 0, k = 0;
+  while (i < na && j < nb) {
+    if (a[i] < b[j]) out[k++] = a[i++];
+    else if (a[i] > b[j]) out[k++] = b[j++];
+    else { i++; j++; }
+  }
+  while (i < na) out[k++] = a[i++];
+  while (j < nb) out[k++] = b[j++];
+  return k;
+}
+
+// ------------------------------------------- bitmap-container word algebra
+
+void pt_bitmap_op(const uint64_t* a, const uint64_t* b, uint64_t* out,
+                  size_t n, int op) {
+  switch (op) {
+    case 0: for (size_t i = 0; i < n; i++) out[i] = a[i] & b[i]; break;
+    case 1: for (size_t i = 0; i < n; i++) out[i] = a[i] | b[i]; break;
+    case 2: for (size_t i = 0; i < n; i++) out[i] = a[i] & ~b[i]; break;
+    case 3: for (size_t i = 0; i < n; i++) out[i] = a[i] ^ b[i]; break;
+  }
+}
+
+// ------------------------------------------------- dense materialization
+
+// Scatter sorted uint16 values into a 2^16-bit little-endian bitmap
+// (array container -> dense words; the to_dense_words hot path that feeds
+// HBM uploads, storage/roaring.py).
+void pt_array_to_bits(const uint16_t* vals, size_t n, uint64_t* words) {
+  memset(words, 0, 1024 * sizeof(uint64_t));
+  for (size_t i = 0; i < n; i++) {
+    uint16_t v = vals[i];
+    words[v >> 6] |= 1ull << (v & 63);
+  }
+}
+
+// Extract set positions of a 1024-word bitmap into out (size >= popcount).
+size_t pt_bits_to_array(const uint64_t* words, uint16_t* out) {
+  size_t k = 0;
+  for (size_t w = 0; w < 1024; w++) {
+    uint64_t word = words[w];
+    while (word) {
+      int bit = __builtin_ctzll(word);
+      out[k++] = (uint16_t)((w << 6) | (unsigned)bit);
+      word &= word - 1;
+    }
+  }
+  return k;
+}
+
+// Scatter absolute uint64 positions in [start, start + width) into a dense
+// little-endian uint32-lane bitvector of width bits (row materialization
+// across containers — OffsetRange analog, roaring/roaring.go:320).
+void pt_positions_to_dense(const uint64_t* positions, size_t n, uint64_t start,
+                           uint64_t width, uint32_t* words) {
+  memset(words, 0, (size_t)(width / 8));
+  for (size_t i = 0; i < n; i++) {
+    uint64_t p = positions[i];
+    if (p < start || p >= start + width) continue;
+    uint64_t off = p - start;
+    words[off >> 5] |= (uint32_t)1 << (off & 31);
+  }
+}
+
+// ---------------------------------------------------------- op-log replay
+
+// Validate op-log records (13 bytes each: type u8 | value u64 LE | fnv1a32)
+// into order-preserving (type, value) arrays — order matters for replay
+// correctness (add/remove interleavings on the same bit). Returns the number
+// of ops, or (size_t)-1 on checksum/type/truncation error. types/values must
+// hold n/13 entries.
+size_t pt_oplog_parse(const uint8_t* data, size_t n, uint8_t* types,
+                      uint64_t* values) {
+  size_t pos = 0, count = 0;
+  while (pos + 13 <= n) {
+    uint32_t chk;
+    memcpy(&chk, data + pos + 9, 4);
+    if (chk != pt_fnv1a32(data + pos, 9)) return (size_t)-1;
+    uint8_t typ = data[pos];
+    if (typ > 1) return (size_t)-1;
+    memcpy(&values[count], data + pos + 1, 8);
+    types[count] = typ;
+    pos += 13;
+    count++;
+  }
+  return (pos == n) ? count : (size_t)-1;
+}
+
+}  // extern "C"

@@ -104,9 +104,10 @@ class InternalClient:
         return self._json("GET", uri, "/status")
 
     def translate_keys(self, uri: str, index: str, field: Optional[str],
-                       keys: list[str]) -> list[int]:
+                       keys: list[str], create: bool = True) -> list:
         out = self._json("POST", uri, "/internal/translate/keys",
-                         {"index": index, "field": field, "keys": keys})
+                         {"index": index, "field": field, "keys": keys,
+                          "create": create})
         return out.get("ids", [])
 
     def translate_data(self, uri: str, offset: int = 0) -> bytes:

@@ -276,7 +276,8 @@ class Handler:
     def post_translate_keys(self, params, query, body):
         req = self._body_json(body)
         ids = self.api.translate_keys(req.get("index"), req.get("field"),
-                                      req.get("keys", []))
+                                      req.get("keys", []),
+                                      create=req.get("create", True))
         return self._json({"ids": ids})
 
 

@@ -72,6 +72,8 @@ class ResizeJob:
     id: str
     event: str  # join | leave
     node_id: str
+    # the full joining/leaving node (keeps its URI for registration)
+    node: Optional[Node] = None
     # target node id -> fragment sources to fetch
     instructions: dict[str, list[ResizeSource]] = field(default_factory=dict)
     completed: set = field(default_factory=set)
@@ -192,7 +194,8 @@ class Cluster:
         else:
             raise ValueError(f"unsupported resize event: {event}")
 
-        job = ResizeJob(id=str(uuid.uuid4()), event=event, node_id=node.id)
+        job = ResizeJob(id=str(uuid.uuid4()), event=event, node_id=node.id,
+                        node=node)
         schema = self.schema_fn()
         for index, fields in schema.items():
             for fname, views in fields.items():
@@ -241,7 +244,7 @@ class Cluster:
         job.completed.add(node_id)
         if job.done():
             if job.event == EVENT_JOIN:
-                node = Node(id=job.node_id)
+                node = job.node or Node(id=job.node_id)
                 if self.node_by_id(job.node_id) is None:
                     self.add_node(node)
             else:

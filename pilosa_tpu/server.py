@@ -33,6 +33,7 @@ class Server:
                  cluster_hosts: Optional[list[str]] = None,
                  replica_n: int = 1,
                  anti_entropy_interval: float = 0.0,
+                 membership_interval: float = 5.0,
                  mesh=None):
         self.data_dir = data_dir
         self.holder = Holder(data_dir)
@@ -65,7 +66,9 @@ class Server:
         self.http = HTTPServer(self.handler, host=host, port=port)
         self.cluster_hosts = cluster_hosts or []
         self.anti_entropy_interval = anti_entropy_interval
+        self.membership_interval = membership_interval
         self._ae_timer: Optional[threading.Timer] = None
+        self._member_timer: Optional[threading.Timer] = None
         self.closed = False
 
     # -- lifecycle (server.go Open, §3.1) -----------------------------------
@@ -106,10 +109,30 @@ class Server:
             # refresh_membership once peers answer /internal/nodes.
             self.cluster.set_static([me])
             self.refresh_membership()
+            # peers may come up later: keep refreshing until everyone answers
+            # (the gossip-convergence analog for static clusters)
+            if self.membership_interval > 0:
+                self._schedule_membership_refresh()
         self.api.broadcast_fn = self.broadcast
         if self.anti_entropy_interval > 0:
             self._schedule_anti_entropy()
         return self
+
+    def _schedule_membership_refresh(self) -> None:
+        if self.closed:
+            return
+        self._member_timer = threading.Timer(self.membership_interval,
+                                             self._membership_tick)
+        self._member_timer.daemon = True
+        self._member_timer.start()
+
+    def _membership_tick(self) -> None:
+        from pilosa_tpu.parallel.cluster import STATE_RESIZING
+        try:
+            if self.cluster.state != STATE_RESIZING:
+                self.refresh_membership()
+        finally:
+            self._schedule_membership_refresh()
 
     def refresh_membership(self) -> None:
         """Merge peer node lists from all configured hosts (the static-mode
@@ -136,6 +159,8 @@ class Server:
         self.closed = True
         if self._ae_timer is not None:
             self._ae_timer.cancel()
+        if self._member_timer is not None:
+            self._member_timer.cancel()
         self.http.close()
         self.holder.close()
         self.translate.close()

@@ -64,14 +64,13 @@ def fnv1a32(data: bytes) -> int:
 
 
 def _array_to_words(arr: np.ndarray) -> np.ndarray:
-    bits = np.zeros(CONTAINER_BITS, dtype=np.uint8)
-    bits[arr] = 1
-    return np.packbits(bits, bitorder="little").view("<u8").copy()
+    from pilosa_tpu import native
+    return native.array_to_bits(arr)  # numpy fallback lives in the wrapper
 
 
 def _words_to_array(words: np.ndarray) -> np.ndarray:
-    bits = np.unpackbits(words.view(np.uint8), bitorder="little")
-    return np.flatnonzero(bits).astype(np.uint16)
+    from pilosa_tpu import native
+    return native.bits_to_array(words)
 
 
 class Container:
@@ -157,15 +156,8 @@ class Container:
 
     def op(self, other: "Container", kind: str) -> "Container":
         if self.kind == "array" and other.kind == "array":
-            a, b = self.data, other.data
-            if kind == "and":
-                out = np.intersect1d(a, b, assume_unique=True)
-            elif kind == "or":
-                out = np.union1d(a, b)
-            elif kind == "andnot":
-                out = np.setdiff1d(a, b, assume_unique=True)
-            else:  # xor
-                out = np.setxor1d(a, b, assume_unique=True)
+            from pilosa_tpu import native
+            out = native.array_op(self.data, other.data, kind)
             return Container.from_values(out)
         aw, bw = self.words(), other.words()
         if kind == "and":
@@ -179,18 +171,19 @@ class Container:
         return Container("bitmap", out)._normalize()
 
     def op_count(self, other: "Container", kind: str) -> int:
+        from pilosa_tpu import native
         if self.kind == "array" and other.kind == "array" and kind == "and":
-            return int(np.intersect1d(self.data, other.data, assume_unique=True).size)
+            return int(native.array_op(self.data, other.data, "and").size)
         aw, bw = self.words(), other.words()
         if kind == "and":
-            out = aw & bw
-        elif kind == "or":
+            return native.and_count(aw, bw)
+        if kind == "or":
             out = aw | bw
         elif kind == "andnot":
             out = aw & ~bw
         else:
             out = aw ^ bw
-        return int(np.sum(np.bitwise_count(out)))
+        return native.popcount64(out)
 
     # -- serialization ------------------------------------------------------
 
@@ -545,7 +538,23 @@ class Bitmap:
             c, consumed = Container.from_payload(code, n_minus_1 + 1, mv[offset:])
             b._store(int(key), c)
             ops_offset = offset + consumed
-        # Trailing op-log replay.
+        # Trailing op-log replay — batched native parse when available
+        # (order-preserving runs applied via the bulk paths).
+        if ops_offset < len(data):
+            from pilosa_tpu import native
+            parsed = native.oplog_parse(bytes(data[ops_offset:]))
+            if parsed is not None:
+                types, values = parsed
+                if types.size:
+                    bounds = np.flatnonzero(np.diff(types)) + 1
+                    for t_run, v_run in zip(np.split(types, bounds),
+                                            np.split(values, bounds)):
+                        if t_run[0] == OP_ADD:
+                            b.add_many(v_run)
+                        else:
+                            b.remove_many(v_run)
+                b.op_n += int(types.size)
+                return b
         pos = ops_offset
         while pos < len(data):
             if pos + OP_SIZE > len(data):

@@ -33,13 +33,14 @@ class ClusterTranslator:
         node = self.cluster.node_by_id(self.cluster.coordinator_id)
         return node.uri if node is not None and node.uri else None
 
-    def _forward(self, index: str, field, keys: list[str]):
+    def _forward(self, index: str, field, keys: list[str], create: bool = True):
         from pilosa_tpu.net.client import ClientError
         uri = self._primary_uri()
         if uri is None:
             return None
         try:
-            return self.client.translate_keys(uri, index, field, keys)
+            return self.client.translate_keys(uri, index, field, keys,
+                                              create=create)
         except ClientError:
             return None
 
@@ -66,7 +67,7 @@ class ClusterTranslator:
         if uri is None:
             # we are the primary (or single-node): mint locally
             return self.store.translate_column(index, key, create=create)
-        ids = self._forward(index, None, [key])
+        ids = self._forward(index, None, [key], create=create)
         if not ids or ids[0] is None:
             return None
         self.store.ensure_mapping(KIND_COLUMN, index, "", key, ids[0])
@@ -82,7 +83,7 @@ class ClusterTranslator:
         uri = self._primary_uri()
         if uri is None:
             return self.store.translate_row(index, field, key, create=create)
-        ids = self._forward(index, field, [key])
+        ids = self._forward(index, field, [key], create=create)
         if not ids or ids[0] is None:
             return None
         self.store.ensure_mapping(KIND_ROW, index, field, key, ids[0])

@@ -328,3 +328,18 @@ def test_cluster_failover_per_shard_remap(cluster3):
     for s in survivors:
         _, out = jpost(s.uri, "/index/i/query", raw=b"Count(Row(f=1))")
         assert out["results"] == [6], s.uri
+
+
+def test_read_does_not_mint_keys(server):
+    u = server.uri
+    jpost(u, "/index/ki", {"options": {"keys": True}})
+    jpost(u, "/index/ki/field/f", {"options": {"keys": True}})
+    jpost(u, "/index/ki/query", raw=b"Set('a', f='x')")
+    size_before = server.translate.log_size()
+    # reads with unknown keys return empty, and must not grow the key log
+    _, out = jpost(u, "/index/ki/query", raw=b"Row(f='typo-key')")
+    assert out["results"][0]["keys"] == []
+    _, out = jpost(u, "/index/ki/query", raw=b"Count(Row(f='typo-key'))")
+    assert out["results"] == [0]
+    jpost(u, "/index/ki/query", raw=b"Clear('nope', f='x')")
+    assert server.translate.log_size() == size_before
