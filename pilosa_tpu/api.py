@@ -56,11 +56,13 @@ class ConflictError(ApiError):
         super().__init__(msg, status=409)
 
 
-# method -> states in which it is permitted (api.go:1212-1278). Methods not
-# listed are permitted in NORMAL and DEGRADED.
+# method -> states in which it is permitted (api.go:1247-1278: methodsCommon
+# always; methodsNormal in NORMAL+DEGRADED; methodsResizing adds FragmentData
+# + ResizeAbort during RESIZING). Methods not listed are permitted in NORMAL
+# and DEGRADED.
 _STATE_GATES = {
     "query": (STATE_NORMAL, STATE_DEGRADED),
-    "write": (STATE_NORMAL,),
+    "write": (STATE_NORMAL, STATE_DEGRADED),
     "schema_read": (STATE_NORMAL, STATE_DEGRADED, STATE_RESIZING, STATE_STARTING),
     "resize": (STATE_NORMAL, STATE_DEGRADED, STATE_RESIZING),
 }
@@ -79,6 +81,18 @@ class API:
         # DDL broadcast hook; set by Server on multi-node clusters
         # (broadcaster.SendSync, broadcast.go:30)
         self.broadcast_fn = None
+        # resize execution hooks; set by Server. resize_fn(event, node)
+        # routes node removal through the coordinator's resize engine
+        # (cluster.go:1150-1515) instead of mutating membership locally;
+        # abort_fn() cancels the coordinator's active job.
+        self.resize_fn = None
+        self.abort_fn = None
+        # import forwarding hooks; set by Server to client.import_bits /
+        # client.import_roaring. Imports are split by shard and routed to
+        # every owning replica (the reference's client-side shard routing +
+        # api.validateShardOwnership, api.go:804)
+        self.forward_import_fn = None
+        self.forward_roaring_fn = None
 
     def _broadcast(self, msg: dict) -> None:
         if self.broadcast_fn is not None:
@@ -215,7 +229,7 @@ class API:
     def import_bits(self, index_name: str, field_name: str,
                     row_ids=None, column_ids=None,
                     row_keys=None, column_keys=None,
-                    timestamps=None) -> None:
+                    timestamps=None, remote: bool = False) -> None:
         self._validate("write")
         index = self.holder.index(index_name)
         if index is None:
@@ -227,17 +241,75 @@ class API:
             column_ids = self.translate.translate_columns(index_name, list(column_keys))
         if row_ids is None or column_ids is None:
             raise ApiError("import requires rows and columns")
+        row_ids, column_ids = list(row_ids), list(column_ids)
+        timestamps = list(timestamps) if timestamps else None
+        if timestamps:
+            # normalize to epoch numbers BEFORE routing: forwarded payloads
+            # are JSON and must not carry datetime objects
+            timestamps = [
+                t.replace(tzinfo=timezone.utc).timestamp()
+                if isinstance(t, datetime) and t.tzinfo is None
+                else (t.timestamp() if isinstance(t, datetime) else t)
+                for t in timestamps]
+        if not remote:
+            row_ids, column_ids, timestamps = self._route_import(
+                index_name, field_name, row_ids, column_ids, timestamps)
+            if not column_ids:
+                return
         ts = None
         if timestamps:
             ts = [datetime.fromtimestamp(t, tz=timezone.utc).replace(tzinfo=None)
-                  if isinstance(t, (int, float)) and t else
+                  if isinstance(t, (int, float)) and not isinstance(t, bool) else
                   (t if isinstance(t, datetime) else None)
                   for t in timestamps]
-        f.import_bits(list(row_ids), list(column_ids), ts)
+        f.import_bits(row_ids, column_ids, ts)
         self._import_existence(index, column_ids)
 
+    def _route_import(self, index_name: str, field_name: str,
+                      a_ids: list, column_ids: list, extra,
+                      values: bool = False):
+        """Split an import by shard and forward each shard's batch to every
+        owning replica; returns the locally-owned remainder (possibly empty
+        lists). a_ids is rowIDs (set import) or the values list (see
+        import_values)."""
+        if self.forward_import_fn is None or len(self.cluster.nodes) <= 1:
+            return a_ids, column_ids, extra
+        by_node: dict[str, dict] = {}
+        local_idx: list[int] = []
+        owners_by_shard: dict[int, list] = {}
+        for i, col in enumerate(column_ids):
+            shard = int(col) // SHARD_WIDTH
+            owners = owners_by_shard.get(shard)
+            if owners is None:
+                owners = owners_by_shard[shard] = \
+                    self.cluster.shard_nodes(index_name, shard)
+            for node in owners:
+                if node.id == self.cluster.local_id:
+                    local_idx.append(i)
+                else:
+                    by_node.setdefault(node.id, {"uri": node.uri,
+                                                 "idx": []})["idx"].append(i)
+        for group in by_node.values():
+            sel = group["idx"]
+            if values:
+                payload = {"columnIDs": [column_ids[i] for i in sel],
+                           "values": [a_ids[i] for i in sel],
+                           "remote": True}
+            else:
+                payload = {"rowIDs": [a_ids[i] for i in sel],
+                           "columnIDs": [column_ids[i] for i in sel],
+                           "remote": True}
+                if extra:
+                    payload["timestamps"] = [extra[i] for i in sel]
+            self.forward_import_fn(group["uri"], index_name, field_name,
+                                   payload)
+        return ([a_ids[i] for i in local_idx],
+                [column_ids[i] for i in local_idx],
+                [extra[i] for i in local_idx] if extra else None)
+
     def import_values(self, index_name: str, field_name: str,
-                      column_ids=None, values=None, column_keys=None) -> None:
+                      column_ids=None, values=None, column_keys=None,
+                      remote: bool = False) -> None:
         self._validate("write")
         index = self.holder.index(index_name)
         if index is None:
@@ -247,18 +319,34 @@ class API:
             column_ids = self.translate.translate_columns(index_name, list(column_keys))
         if column_ids is None or values is None:
             raise ApiError("import requires columns and values")
+        column_ids, values = list(column_ids), list(values)
+        if not remote:
+            values, column_ids, _ = self._route_import(
+                index_name, field_name, values, column_ids, None, values=True)
+            if not column_ids:
+                return
         try:
-            f.import_values(list(column_ids), list(values))
+            f.import_values(column_ids, values)
         except ValueError as e:
             raise ApiError(str(e))
         self._import_existence(index, column_ids)
 
     def import_roaring(self, index_name: str, field_name: str, shard: int,
-                       views: dict[str, bytes], clear: bool = False) -> None:
+                       views: dict[str, bytes], clear: bool = False,
+                       remote: bool = False) -> None:
         """POST /index/{i}/field/{f}/import-roaring/{shard}: pre-serialized
         roaring payloads per view (api.go:290)."""
         self._validate("write")
         f = self._field(index_name, field_name)
+        if not remote and self.forward_roaring_fn is not None \
+                and len(self.cluster.nodes) > 1:
+            owners = self.cluster.shard_nodes(index_name, shard)
+            for node in owners:
+                if node.id != self.cluster.local_id:
+                    self.forward_roaring_fn(node.uri, index_name, field_name,
+                                            shard, views, clear)
+            if not any(n.id == self.cluster.local_id for n in owners):
+                return
         for vname, data in views.items():
             vname = vname or VIEW_STANDARD
             view = f.create_view_if_not_exists(vname)
@@ -333,13 +421,28 @@ class API:
 
     def remove_node(self, node_id: str):
         self._validate("resize")
-        if self.cluster.node_by_id(node_id) is None:
+        node = self.cluster.node_by_id(node_id)
+        if node is None:
             raise NotFoundError(f"node not found: {node_id}")
-        return self.cluster.node_leave(node_id)
+        try:
+            if self.resize_fn is not None:
+                return self.resize_fn("leave", node)
+            return self.cluster.node_leave(node_id)
+        except ValueError as e:
+            raise ApiError(str(e))
 
     def resize_abort(self) -> None:
         if self.cluster.state != STATE_RESIZING:
             raise ApiError("no resize job currently running")
+        if self.abort_fn is not None:
+            # route through the coordinator so the active job is actually
+            # cancelled before peers are un-gated (api.ResizeAbort runs on
+            # the coordinator, api.go:1131)
+            try:
+                self.abort_fn()
+            except ValueError as e:
+                raise ApiError(str(e))
+            return
         self.cluster.abort_resize()
 
     def recalculate_caches(self) -> None:
@@ -369,6 +472,14 @@ class API:
             raise NotFoundError("fragment not found")
         rows, cols = frag.block_data(block)
         return {"rowIDs": rows.tolist(), "columnIDs": cols.tolist()}
+
+    def fragment_views(self, index_name: str, field_name: str,
+                       shard: int) -> list[str]:
+        """View names holding a fragment for `shard` — the donor-side
+        enumeration behind resize field/shard copies."""
+        f = self._field(index_name, field_name)
+        return sorted(v.name for v in f.views.values()
+                      if v.fragment(shard) is not None)
 
     def fragment_data(self, index_name: str, field_name: str, view_name: str,
                       shard: int) -> bytes:

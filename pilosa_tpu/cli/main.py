@@ -32,6 +32,9 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--cluster-replicas", type=int, help="replica count")
     sp.add_argument("--anti-entropy-interval", type=float,
                     help="seconds between anti-entropy passes (0 = off)")
+    sp.add_argument("--join", action="store_true",
+                    help="join an existing cluster via --cluster-hosts seeds "
+                         "(triggers a coordinator resize)")
     sp.add_argument("--verbose", action="store_true")
 
     ip = sub.add_parser("import", help="bulk-import CSV (row,col or col,value)")
@@ -93,6 +96,7 @@ def cmd_server(args) -> int:
         cluster_hosts=cfg.cluster.hosts if not cfg.cluster.disabled else None,
         replica_n=cfg.cluster.replicas,
         anti_entropy_interval=cfg.anti_entropy.interval,
+        join=getattr(args, "join", False),
     ).open()
     print(f"pilosa-tpu {__version__} serving at {server.uri} "
           f"(data: {data_dir}, node: {server.node_id})", flush=True)

@@ -88,6 +88,18 @@ class View:
     def shards(self) -> list[int]:
         return sorted(self.fragments)
 
+    def delete_fragment(self, shard: int) -> None:
+        """Drop a fragment and its files — post-resize GC of shards this node
+        no longer owns (holderCleaner, holder.go:855-906)."""
+        frag = self.fragments.pop(shard, None)
+        if frag is None:
+            return
+        frag.close()
+        for p in (frag.path, frag.path + ".cache", frag.path + ".snapshotting"):
+            if os.path.exists(p):
+                os.remove(p)
+        self.rank_caches.pop(shard, None)
+
     # -- writes (global column space; view.setBit view.go:309) --------------
 
     def set_bit(self, row_id: int, column: int) -> bool:

@@ -64,10 +64,12 @@ class InternalClient:
         self._json("POST", uri, f"/index/{index}/field/{field}/import", payload)
 
     def import_roaring(self, uri: str, index: str, field: str, shard: int,
-                       views: dict[str, bytes], clear: bool = False) -> None:
+                       views: dict[str, bytes], clear: bool = False,
+                       remote: bool = False) -> None:
         payload = {
             "views": {k: base64.b64encode(v).decode() for k, v in views.items()},
             "clear": clear,
+            "remote": remote,
         }
         self._json("POST", uri,
                    f"/index/{index}/field/{field}/import-roaring/{shard}", payload)
@@ -84,6 +86,13 @@ class InternalClient:
         return self._json("GET", uri,
                           f"/internal/fragment/block/data?index={index}&field={field}"
                           f"&view={view}&shard={shard}&block={block}")
+
+    def fragment_views(self, uri: str, index: str, field: str,
+                       shard: int) -> list[str]:
+        out = self._json("GET", uri,
+                         f"/internal/fragment/views?index={index}"
+                         f"&field={field}&shard={shard}")
+        return out.get("views", [])
 
     def retrieve_shard(self, uri: str, index: str, field: str, view: str,
                        shard: int) -> bytes:

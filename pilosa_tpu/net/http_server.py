@@ -44,6 +44,7 @@ ROUTES: list[tuple[str, re.Pattern, str]] = [
     ("GET", re.compile(r"^/internal/fragment/block/data$"), "get_fragment_block_data"),
     ("GET", re.compile(r"^/internal/fragment/blocks$"), "get_fragment_blocks"),
     ("GET", re.compile(r"^/internal/fragment/data$"), "get_fragment_data"),
+    ("GET", re.compile(r"^/internal/fragment/views$"), "get_fragment_views"),
     ("GET", re.compile(r"^/internal/fragment/nodes$"), "get_fragment_nodes"),
     ("DELETE", re.compile(r"^/internal/index/(?P<index>[^/]+)/field/(?P<field>[^/]+)/remote-available-shards/(?P<shard>\d+)$"), "delete_remote_available_shard"),
     ("GET", re.compile(r"^/internal/nodes$"), "get_nodes"),
@@ -157,17 +158,18 @@ class Handler:
 
     def post_import(self, params, query, body):
         req = self._body_json(body)
+        remote = bool(req.get("remote", False))
         if "values" in req:
             self.api.import_values(
                 params["index"], params["field"],
                 column_ids=req.get("columnIDs"), values=req.get("values"),
-                column_keys=req.get("columnKeys"))
+                column_keys=req.get("columnKeys"), remote=remote)
         else:
             self.api.import_bits(
                 params["index"], params["field"],
                 row_ids=req.get("rowIDs"), column_ids=req.get("columnIDs"),
                 row_keys=req.get("rowKeys"), column_keys=req.get("columnKeys"),
-                timestamps=req.get("timestamps"))
+                timestamps=req.get("timestamps"), remote=remote)
         return self._json({})
 
     def post_import_roaring(self, params, query, body):
@@ -176,7 +178,8 @@ class Handler:
                  for name, data in req.get("views", {}).items()}
         self.api.import_roaring(params["index"], params["field"],
                                 int(params["shard"]), views,
-                                clear=bool(req.get("clear", False)))
+                                clear=bool(req.get("clear", False)),
+                                remote=bool(req.get("remote", False)))
         return self._json({})
 
     def get_export(self, params, query, body):
@@ -252,6 +255,12 @@ class Handler:
     def get_fragment_data(self, params, query, body):
         i, f, v, s = self._frag_args(query)
         return 200, "application/octet-stream", self.api.fragment_data(i, f, v, s)
+
+    def get_fragment_views(self, params, query, body):
+        index = self._arg(query, "index")
+        field = self._arg(query, "field")
+        shard = int(self._arg(query, "shard", "0"))
+        return self._json({"views": self.api.fragment_views(index, field, shard)})
 
     def get_fragment_nodes(self, params, query, body):
         index = self._arg(query, "index")

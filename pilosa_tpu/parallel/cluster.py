@@ -53,15 +53,18 @@ class Node:
 
 @dataclass
 class ResizeSource:
-    """One fragment copy instruction (internal ResizeSource message)."""
+    """One fragment-copy instruction (internal ResizeSource message). The
+    copy is field/shard-granular: the follower asks the donor which views it
+    holds for the shard and streams each — views are a donor-local detail
+    the coordinator need not know (unlike cluster.go:741-826 which plans
+    per-view from the broadcast-synced view list)."""
     index: str
     field: str
-    view: str
     shard: int
     from_node: str
 
     def to_dict(self) -> dict:
-        return {"index": self.index, "field": self.field, "view": self.view,
+        return {"index": self.index, "field": self.field,
                 "shard": self.shard, "fromNode": self.from_node}
 
 
@@ -85,8 +88,11 @@ class ResizeJob:
 class Cluster:
     """Placement + membership + resize planning.
 
-    `schema_fn` returns {index: {field: {view: [shards]}}} — what fragments
-    exist; used to plan resize copies (fragSources, cluster.go:741-826).
+    `schema_fn` returns {index: {field: [shards]}} — the cluster-wide
+    available-shard sets (NOT this node's local fragments: a shard may live
+    only on peers); used to plan resize copies (fragSources,
+    cluster.go:741-826, which likewise plans from availableShards-derived
+    placement, not local files).
     """
 
     def __init__(self, local_id: str, partition_n: int = DEFAULT_PARTITION_N,
@@ -198,20 +204,24 @@ class Cluster:
                         node=node)
         schema = self.schema_fn()
         for index, fields in schema.items():
-            for fname, views in fields.items():
-                for vname, shards in views.items():
-                    for shard in shards:
-                        old = {n.id for n in before.shard_nodes(index, shard)}
-                        new = {n.id for n in after.shard_nodes(index, shard)}
-                        for target in new - old:
-                            # fetch from any surviving old owner
-                            donors = [i for i in old if any(
-                                n.id == i for n in after.nodes)]
-                            if not donors:
-                                continue  # data loss: no surviving replica
-                            job.instructions.setdefault(target, []).append(
-                                ResizeSource(index, fname, vname, shard,
-                                             sorted(donors)[0]))
+            for fname, shards in fields.items():
+                for shard in shards:
+                    old = {n.id for n in before.shard_nodes(index, shard)}
+                    new = {n.id for n in after.shard_nodes(index, shard)}
+                    for target in new - old:
+                        # fetch from any surviving old owner
+                        donors = [i for i in old if any(
+                            n.id == i for n in after.nodes)]
+                        if not donors:
+                            # a leave with no surviving replica would drop
+                            # data — refuse, as the reference does
+                            # (fragSources, cluster.go:806-811)
+                            raise ValueError(
+                                "not enough data to perform resize "
+                                "(replica factor may need to be increased)")
+                        job.instructions.setdefault(target, []).append(
+                            ResizeSource(index, fname, shard,
+                                         sorted(donors)[0]))
         for n in after.nodes:
             job.instructions.setdefault(n.id, [])
         return job

@@ -17,7 +17,15 @@ from pilosa_tpu.executor import Executor
 from pilosa_tpu.models import FieldOptions, Holder
 from pilosa_tpu.net.client import ClientError, InternalClient
 from pilosa_tpu.net.http_server import Handler, HTTPServer
-from pilosa_tpu.parallel.cluster import Cluster, Node, STATE_NORMAL
+from pilosa_tpu.parallel.cluster import (
+    Cluster,
+    EVENT_LEAVE,
+    Node,
+    ResizeJob,
+    STATE_NORMAL,
+    STATE_RESIZING,
+    STATE_STARTING,
+)
 from pilosa_tpu.parallel.mesh import DeviceRunner
 from pilosa_tpu.utils.translate import TranslateStore
 
@@ -34,6 +42,8 @@ class Server:
                  replica_n: int = 1,
                  anti_entropy_interval: float = 0.0,
                  membership_interval: float = 5.0,
+                 join: bool = False,
+                 resize_timeout: float = 120.0,
                  mesh=None):
         self.data_dir = data_dir
         self.holder = Holder(data_dir)
@@ -67,8 +77,26 @@ class Server:
         self.cluster_hosts = cluster_hosts or []
         self.anti_entropy_interval = anti_entropy_interval
         self.membership_interval = membership_interval
+        # join=True: this node is being added to an existing cluster —
+        # cluster_hosts are seed URIs (the gossip-seeds analog). It announces
+        # itself and stays STARTING until the coordinator's resize completes
+        # and a topology broadcast admits it (nodeJoin, cluster.go:1715).
+        self.join = join
         self._ae_timer: Optional[threading.Timer] = None
         self._member_timer: Optional[threading.Timer] = None
+        # coordinator-side queue of membership events that arrived while a
+        # resize was already running (listenForJoins, cluster.go:1095-1148)
+        self._pending_resizes: list[tuple[str, Node]] = []
+        self._resize_lock = threading.Lock()
+        # tombstones: ids removed by resize. Without these the additive
+        # membership merge would resurrect a removed-but-still-running node
+        # (the memberlist leave-event analog for static clusters).
+        self._removed_ids: set[str] = set()
+        self._left = False  # this node itself was removed from the cluster
+        # a lost resize-complete ack must not wedge the cluster in RESIZING
+        # forever: the coordinator aborts the job after resize_timeout
+        self.resize_timeout = resize_timeout
+        self._resize_watchdog: Optional[threading.Timer] = None
         self.closed = False
 
     # -- lifecycle (server.go Open, §3.1) -----------------------------------
@@ -86,11 +114,14 @@ class Server:
         return node_id
 
     def _schema_shards(self) -> dict:
+        """{index: {field: [shards]}} from the cluster-wide available-shards
+        bitmaps (broadcast-synced), NOT local fragments — a shard that was
+        migrated away must still be planned over on the next resize."""
         out: dict = {}
         for iname, idx in self.holder.indexes.items():
             for fname, field in idx.fields.items():
-                for vname, view in field.views.items():
-                    out.setdefault(iname, {}).setdefault(fname, {})[vname] = view.shards()
+                out.setdefault(iname, {})[fname] = [
+                    int(s) for s in field.available_shards.slice()]
         return out
 
     def open(self) -> "Server":
@@ -100,7 +131,14 @@ class Server:
         self.http.serve_background()
         me = Node(id=self.node_id, uri=self.http.uri,
                   is_coordinator=not self.cluster_hosts)
-        if not self.cluster_hosts:
+        if self.join and self.cluster_hosts:
+            # dynamic member: knock on the seeds and wait in STARTING for the
+            # coordinator's resize + topology broadcast to admit us
+            self.cluster.nodes = [me]
+            self.request_join()
+            if self.membership_interval > 0:
+                self._schedule_membership_refresh()
+        elif not self.cluster_hosts:
             self.cluster.set_static([me])
             self.cluster.coordinator_id = self.node_id
         else:
@@ -114,6 +152,13 @@ class Server:
             if self.membership_interval > 0:
                 self._schedule_membership_refresh()
         self.api.broadcast_fn = self.broadcast
+        self.api.resize_fn = self._resize_request
+        self.api.abort_fn = self._abort_request
+        self.api.forward_import_fn = self.client.import_bits
+        self.api.forward_roaring_fn = (
+            lambda uri, index, field, shard, views, clear:
+            self.client.import_roaring(uri, index, field, shard, views,
+                                       clear=clear, remote=True))
         if self.anti_entropy_interval > 0:
             self._schedule_anti_entropy()
         return self
@@ -127,10 +172,20 @@ class Server:
         self._member_timer.start()
 
     def _membership_tick(self) -> None:
-        from pilosa_tpu.parallel.cluster import STATE_RESIZING
         try:
-            if self.cluster.state != STATE_RESIZING:
-                self.refresh_membership()
+            if self.join and self.cluster.state == STATE_STARTING:
+                self.request_join()  # keep knocking until admitted
+            else:
+                # fetch over the network WITHOUT the lock, then apply the
+                # merge under it so it cannot interleave with a join/leave
+                # job flipping state (set_static would un-gate writes
+                # mid-resize and orphan the active job)
+                reports = self._fetch_peer_nodes()
+                if reports is not None:
+                    with self._resize_lock:
+                        if self.cluster.state != STATE_RESIZING \
+                                and self.cluster.active_job is None:
+                            self._apply_membership(reports)
         finally:
             self._schedule_membership_refresh()
 
@@ -138,19 +193,36 @@ class Server:
         """Merge peer node lists from all configured hosts (the static-mode
         analog of a gossip LocalState/MergeRemoteState sync,
         gossip/gossip.go:274-316)."""
-        if not self.cluster_hosts:
+        reports = self._fetch_peer_nodes()
+        if reports is None:
             return
-        me = Node(id=self.node_id, uri=self.http.uri)
-        nodes = {self.node_id: me}
+        self._apply_membership(reports)
+
+    def _fetch_peer_nodes(self) -> Optional[list[dict]]:
+        """Network half of refresh_membership: peer reports, no locks, no
+        cluster mutation (safe to run outside _resize_lock)."""
+        if not self.cluster_hosts or self._left:
+            return None
+        reports: list[dict] = []
         for huri in self.cluster_hosts:
             if huri == self.http.uri:
                 continue
             try:
-                for nd in self.client.nodes(huri) or []:
-                    if nd["id"] not in nodes:
-                        nodes[nd["id"]] = Node.from_dict(nd)
+                reports.extend(self.client.nodes(huri) or [])
             except ClientError:
                 pass
+        return reports
+
+    def _apply_membership(self, reports: list[dict]) -> None:
+        me = Node(id=self.node_id, uri=self.http.uri)
+        # seed with current membership: nodes admitted dynamically (topology
+        # broadcasts) stay known even when a seed host is briefly down
+        nodes = {n.id: n for n in self.cluster.nodes
+                 if n.id not in self._removed_ids}
+        nodes[self.node_id] = me
+        for nd in reports:
+            if nd["id"] not in nodes and nd["id"] not in self._removed_ids:
+                nodes[nd["id"]] = Node.from_dict(nd)
         self.cluster.set_static(list(nodes.values()))
         # lowest node id coordinates (deterministic across peers)
         self.cluster.coordinator_id = min(nodes)
@@ -161,6 +233,8 @@ class Server:
             self._ae_timer.cancel()
         if self._member_timer is not None:
             self._member_timer.cancel()
+        if self._resize_watchdog is not None:
+            self._resize_watchdog.cancel()
         self.http.close()
         self.holder.close()
         self.translate.close()
@@ -198,6 +272,25 @@ class Server:
             self.cluster.add_node(node)
         elif mtype == "recalculate-caches":
             self.api.recalculate_caches()
+        elif mtype == "node-join-request":
+            self._handle_join_request(Node.from_dict(msg["node"]))
+        elif mtype == "node-leave-request":
+            self._handle_leave_request(msg["id"])
+        elif mtype == "resize-instruction":
+            # async: fetching fragments over HTTP must not block the
+            # coordinator's send (followResizeInstruction runs in a
+            # goroutine, cluster.go:1251)
+            t = threading.Thread(target=self.follow_resize_instruction,
+                                 args=(msg,), daemon=True)
+            t.start()
+        elif mtype == "resize-complete":
+            self._handle_resize_complete(msg)
+        elif mtype == "resize-abort":
+            self._abort_request()
+        elif mtype == "topology":
+            self._apply_topology(msg["nodes"], msg.get("removed"))
+        elif mtype == "cluster-state":
+            self.cluster._set_state(msg["state"])
         else:
             raise ValueError(f"unknown cluster message type: {mtype}")
 
@@ -216,6 +309,402 @@ class Server:
                 self.client.send_message(node.uri, msg)
             except ClientError:
                 pass  # peers converge via anti-entropy
+
+    # -- resize engine (cluster.go:1150-1515) -------------------------------
+
+    def request_join(self) -> None:
+        """Announce this node to the first answering seed; the request is
+        forwarded to the coordinator which runs a resize job for us."""
+        me = {"id": self.node_id, "uri": self.http.uri}
+        for huri in self.cluster_hosts:
+            if huri == self.http.uri:
+                continue
+            try:
+                self.client.send_message(huri, {"type": "node-join-request",
+                                                "node": me})
+                return
+            except ClientError:
+                continue
+
+    def _resize_request(self, event: str, node: Node):
+        """API hook: route a membership change through the coordinator
+        (api.RemoveNode → coordinator resize, api.go:1092). Raises
+        ValueError so a refusal (e.g. too few replicas) surfaces to the
+        operator's HTTP request instead of vanishing in forwarding."""
+        if event != "leave":
+            raise ValueError(f"unsupported resize event: {event}")
+        if not self.cluster.is_coordinator():
+            coord = self.cluster.node_by_id(self.cluster.coordinator_id)
+            if coord is None or not coord.uri:
+                raise ValueError("no coordinator available")
+            try:
+                self.client.send_message(coord.uri, {
+                    "type": "node-leave-request", "id": node.id})
+            except ClientError as e:
+                raise ValueError(f"remove-node refused by coordinator: {e}")
+            return None
+        self._handle_leave_request(node.id)
+        return self.cluster.active_job
+
+    def _abort_request(self) -> None:
+        """API hook for /cluster/resize/abort: cancel the coordinator's
+        active job, then un-gate peers."""
+        if not self.cluster.is_coordinator():
+            coord = self.cluster.node_by_id(self.cluster.coordinator_id)
+            if coord is None or not coord.uri:
+                raise ValueError("no coordinator available")
+            try:
+                self.client.send_message(coord.uri, {"type": "resize-abort"})
+            except ClientError as e:
+                raise ValueError(f"abort refused by coordinator: {e}")
+            return
+        with self._resize_lock:
+            self.cluster.abort_resize()
+        if self._resize_watchdog is not None:
+            self._resize_watchdog.cancel()
+        self._resize_aborted()
+
+    def _forward_to_coordinator(self, msg: dict) -> bool:
+        coord = self.cluster.node_by_id(self.cluster.coordinator_id)
+        if coord is None or coord.id == self.node_id or not coord.uri:
+            return False
+        try:
+            self.client.send_message(coord.uri, msg)
+            return True
+        except ClientError:
+            return False
+
+    def _handle_join_request(self, node: Node) -> None:
+        if node.id == self.node_id:
+            return
+        # a previously-removed node may rejoin: clear its tombstone
+        self._removed_ids.discard(node.id)
+        if self.cluster.node_by_id(node.id) is not None:
+            # already a member (e.g. re-knock after a lost topology message):
+            # resend the topology directly so the requester converges
+            try:
+                self.client.send_message(node.uri, {
+                    "type": "topology",
+                    "nodes": [n.to_dict() for n in self.cluster.nodes],
+                    "removed": sorted(self._removed_ids)})
+            except ClientError:
+                pass
+            return
+        if not self.cluster.is_coordinator():
+            self._forward_to_coordinator({"type": "node-join-request",
+                                          "node": node.to_dict()})
+            return
+        with self._resize_lock:
+            if self.cluster.state == STATE_RESIZING \
+                    or self.cluster.active_job is not None:
+                if all(n.id != node.id for _, n in self._pending_resizes):
+                    self._pending_resizes.append(("join", node))
+                return
+            job = self.cluster.node_join(node)
+        if job is not None:
+            self._broadcast_state(STATE_RESIZING)
+            self._distribute_resize(job)
+
+    def _handle_leave_request(self, node_id: str) -> None:
+        if not self.cluster.is_coordinator():
+            self._forward_to_coordinator({"type": "node-leave-request",
+                                          "id": node_id})
+            return
+        with self._resize_lock:
+            victim = self.cluster.node_by_id(node_id)
+            if self.cluster.state == STATE_RESIZING \
+                    or self.cluster.active_job is not None:
+                if victim is not None:
+                    self._pending_resizes.append(("leave", victim))
+                return
+            job = self.cluster.node_leave(node_id)
+        if job is not None:
+            self._broadcast_state(STATE_RESIZING)
+            self._distribute_resize(job)
+        else:
+            # degraded removal (too few nodes to rebuild replicas) — the
+            # membership already changed; converge peers now
+            self._removed_ids.add(node_id)
+            self._broadcast_topology()
+            # tell the victim it is out so it stops acting as a member
+            if victim is not None and victim.uri:
+                try:
+                    self.client.send_message(victim.uri, {
+                        "type": "topology",
+                        "nodes": [n.to_dict() for n in self.cluster.nodes],
+                        "removed": sorted(self._removed_ids)})
+                except ClientError:
+                    pass
+            self.clean_holder()
+
+    def _distribute_resize(self, job: ResizeJob) -> None:
+        """Send each node its fetch instructions (distributeResizeInstructions,
+        cluster.go:1499). Includes the schema so a joining node can apply DDL
+        before loading fragments (followResizeInstruction applies schema
+        first, cluster.go:1251-1340)."""
+        uri_by_id = {n.id: n.uri for n in self.cluster.nodes}
+        if job.node is not None:
+            uri_by_id.setdefault(job.node.id, job.node.uri)
+        self._arm_watchdog(job.id)
+        schema = self.holder.schema()
+        # cluster-wide available-shards state rides along so a joining node
+        # fans queries out over ALL shards, not just the ones it received
+        # (the reference ships this in NodeStatus on join, server.go:485-580
+        # → holder merge of remote available shards)
+        avail = {
+            iname: {fname: [int(s) for s in f.available_shards.slice()]
+                    for fname, f in idx.fields.items()}
+            for iname, idx in self.holder.indexes.items()
+        }
+        for target, sources in job.instructions.items():
+            msg = {
+                "type": "resize-instruction",
+                "job": job.id,
+                "coordinator": self.node_id,
+                "coordinatorURI": self.http.uri,
+                "schema": schema,
+                "availableShards": avail,
+                "sources": [dict(s.to_dict(),
+                                 fromURI=uri_by_id.get(s.from_node, ""))
+                            for s in sources],
+            }
+            if target == self.node_id:
+                t = threading.Thread(target=self.follow_resize_instruction,
+                                     args=(msg,), daemon=True)
+                t.start()
+            else:
+                try:
+                    self.client.send_message(uri_by_id[target], msg)
+                except ClientError as e:
+                    self.logger.printf("resize: instruction undeliverable to "
+                                       "%s: %s — aborting job", target, e)
+                    with self._resize_lock:
+                        self.cluster.abort_resize()
+                    self._resize_aborted()
+                    return
+
+    def follow_resize_instruction(self, msg: dict) -> None:
+        """Apply schema, stream each source fragment from its donor, ack the
+        coordinator (followResizeInstruction, cluster.go:1251-1393)."""
+        done = {"type": "resize-complete", "job": msg["job"],
+                "node": self.node_id}
+        try:
+            self._apply_schema(msg.get("schema", []))
+            for iname, fields in msg.get("availableShards", {}).items():
+                idx = self.holder.index(iname)
+                if idx is None:
+                    continue
+                for fname, shards in fields.items():
+                    f = idx.field(fname)
+                    if f is not None:
+                        for s in shards:
+                            f.add_available_shard(int(s), quiet=True)
+            for src in msg.get("sources", []):
+                idx = self.holder.index(src["index"])
+                f = idx.field(src["field"]) if idx is not None else None
+                if f is None:
+                    raise ClientError(
+                        f"schema missing for {src['index']}/{src['field']}")
+                # the donor enumerates which views hold this shard; stream
+                # each (fragment tar-walk analog, fragment.go:1823-1998)
+                views = self.client.fragment_views(
+                    src["fromURI"], src["index"], src["field"], src["shard"])
+                for vname in views:
+                    try:
+                        data = self.client.retrieve_shard(
+                            src["fromURI"], src["index"], src["field"],
+                            vname, src["shard"])
+                    except ClientError as e:
+                        if e.status == 404:
+                            continue  # raced away; anti-entropy will heal
+                        raise
+                    view = f.create_view_if_not_exists(vname)
+                    frag = view.create_fragment_if_not_exists(src["shard"])
+                    frag.import_roaring(data)
+                    view.refresh_rank_cache(src["shard"])
+                f.add_available_shard(src["shard"], quiet=True)
+        except (ClientError, ValueError, OSError) as e:
+            done["error"] = str(e)
+        if msg.get("coordinator") == self.node_id:
+            self._handle_resize_complete(done)
+        else:
+            # the ack must arrive or the cluster wedges in RESIZING until
+            # the watchdog aborts — retry transient failures
+            import time as _time
+            for attempt in range(5):
+                try:
+                    self.client.send_message(msg["coordinatorURI"], done)
+                    break
+                except ClientError:
+                    _time.sleep(0.5 * (attempt + 1))
+
+    def _apply_schema(self, schema: list[dict]) -> None:
+        """Create any indexes/fields we don't have yet from schema dicts
+        (the resize instruction's Schema payload)."""
+        for idx_d in schema:
+            opts = idx_d.get("options", {})
+            idx = self.holder.create_index_if_not_exists(
+                idx_d["name"], keys=opts.get("keys", False),
+                track_existence=opts.get("trackExistence", True))
+            for fd in idx_d.get("fields", []):
+                o = fd.get("options", {})
+                idx.create_field_if_not_exists(fd["name"], FieldOptions(
+                    type=o.get("type", "set"),
+                    cache_type=o.get("cacheType", "ranked"),
+                    cache_size=o.get("cacheSize", 50000),
+                    min=o.get("min", 0),
+                    max=o.get("max", 0),
+                    time_quantum=o.get("timeQuantum", ""),
+                    keys=o.get("keys", False)))
+
+    def _handle_resize_complete(self, msg: dict) -> None:
+        with self._resize_lock:
+            job = self.cluster.active_job
+            if job is None or job.id != msg.get("job"):
+                return
+            if msg.get("error"):
+                self.logger.printf("resize: job %s failed on %s: %s",
+                                   job.id, msg.get("node"), msg["error"])
+                self.cluster.abort_resize()
+                aborted, finished = True, False
+            else:
+                aborted = False
+                self.cluster.complete_resize(job, msg["node"])
+                finished = (self.cluster.active_job is None
+                            and self.cluster.state == STATE_NORMAL)
+                if finished and job.event == EVENT_LEAVE:
+                    self._removed_ids.add(job.node_id)
+        if aborted:
+            if self._resize_watchdog is not None:
+                self._resize_watchdog.cancel()
+            self._resize_aborted()
+            return
+        if not finished:
+            return
+        if self._resize_watchdog is not None:
+            self._resize_watchdog.cancel()
+        self._broadcast_topology()
+        # tell the departed node it is out so it stops acting as a member
+        if job.event == EVENT_LEAVE and job.node is not None and job.node.uri:
+            try:
+                self.client.send_message(job.node.uri, {
+                    "type": "topology",
+                    "nodes": [n.to_dict() for n in self.cluster.nodes],
+                    "removed": sorted(self._removed_ids)})
+            except ClientError:
+                pass
+        self.clean_holder()
+        self._drain_pending_resizes()
+
+    def _arm_watchdog(self, job_id: str) -> None:
+        if self._resize_watchdog is not None:
+            self._resize_watchdog.cancel()
+        if self.resize_timeout <= 0:
+            return
+        t = threading.Timer(self.resize_timeout, self._watchdog_fire,
+                            args=(job_id,))
+        t.daemon = True
+        t.start()
+        self._resize_watchdog = t
+
+    def _watchdog_fire(self, job_id: str) -> None:
+        with self._resize_lock:
+            job = self.cluster.active_job
+            if job is None or job.id != job_id:
+                return
+            self.logger.printf("resize: job %s timed out after %.0fs — "
+                               "aborting", job_id, self.resize_timeout)
+            self.cluster.abort_resize()
+        self._resize_aborted()
+
+    def _broadcast_state(self, state: str) -> None:
+        """Propagate the cluster state to every member so e.g. RESIZING
+        blocks writes cluster-wide, not just on the coordinator (the
+        reference's ClusterStatus broadcast, server.go:485-580)."""
+        self.broadcast({"type": "cluster-state", "state": state})
+
+    def _resize_aborted(self) -> None:
+        """Un-wedge peers stuck in RESIZING, then try the next queued
+        membership event (an aborted join self-heals by re-knocking)."""
+        self._broadcast_state(self.cluster.state)
+        self._drain_pending_resizes()
+
+    def _drain_pending_resizes(self) -> None:
+        """Dispatch queued membership events one at a time (listenForJoins,
+        cluster.go:1095-1148). A queued event that became invalid (e.g. a
+        leave now refused for lack of replicas) is logged and skipped so it
+        cannot wedge the rest of the queue."""
+        while True:
+            with self._resize_lock:
+                if not self._pending_resizes:
+                    return
+                event, node = self._pending_resizes.pop(0)
+            try:
+                if event == "join":
+                    self._handle_join_request(node)
+                else:
+                    self._handle_leave_request(node.id)
+                with self._resize_lock:
+                    started = self.cluster.active_job is not None
+                if started:
+                    return  # a job is running; its completion drains next
+                # event completed synchronously (degraded removal,
+                # already-member join) — keep draining
+            except ValueError as e:
+                self.logger.printf("resize: dropping queued %s(%s): %s",
+                                   event, node.id, e)
+
+    def _broadcast_topology(self) -> None:
+        """Push the final membership to every node (the coordinator's
+        cluster-status broadcast after a resize completes)."""
+        nodes_d = [n.to_dict() for n in self.cluster.nodes]
+        self.cluster.coordinator_id = min(
+            (n.id for n in self.cluster.nodes), default=self.node_id)
+        msg = {"type": "topology", "nodes": nodes_d,
+               "removed": sorted(self._removed_ids)}
+        for n in self.cluster.nodes:
+            if n.id == self.node_id or not n.uri:
+                continue
+            try:
+                self.client.send_message(n.uri, msg)
+            except ClientError:
+                pass
+
+    def _apply_topology(self, nodes_d: list[dict],
+                        removed: Optional[list[str]] = None) -> None:
+        # the coordinator's removed-set is authoritative: REPLACE (a union
+        # would tombstone a removed-then-rejoined node on peers forever,
+        # silently diverging membership)
+        if removed is not None:
+            self._removed_ids = set(removed)
+        if self.node_id in self._removed_ids:
+            # we were removed: become a standalone node and stop merging
+            # ourselves back into the cluster (operator shuts us down)
+            self._left = True
+            me = Node(id=self.node_id, uri=self.http.uri)
+            self.cluster.set_static([me])
+            self.cluster.coordinator_id = self.node_id
+            return
+        nodes = [Node.from_dict(d) for d in nodes_d
+                 if d["id"] not in self._removed_ids]
+        self.cluster.set_static(nodes)
+        self.cluster.coordinator_id = min(
+            (n.id for n in nodes), default=self.node_id)
+        self.clean_holder()
+
+    def clean_holder(self) -> int:
+        """Drop fragments this node no longer owns after a resize
+        (holderCleaner, holder.go:855-906). Returns fragments dropped."""
+        dropped = 0
+        for iname, idx in self.holder.indexes.items():
+            for f in idx.fields.values():
+                for view in f.views.values():
+                    for shard in view.shards():
+                        if not self.cluster.owns_shard(self.node_id, iname,
+                                                       shard):
+                            view.delete_fragment(shard)
+                            dropped += 1
+        return dropped
 
     # -- anti-entropy (server.go:430-483; fragmentSyncer fragment.go:2170) --
 
@@ -284,7 +773,7 @@ class Server:
                     payload = Bitmap(positions).to_bytes()
                     try:
                         self.client.import_roaring(node.uri, iname, fname, shard,
-                                                   {vname: payload})
+                                                   {vname: payload}, remote=True)
                     except ClientError:
                         pass
         return merged

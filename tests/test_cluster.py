@@ -82,7 +82,7 @@ def test_owns_and_group_by_node():
 
 
 def test_resize_plan_join():
-    schema = {"i": {"f": {"standard": list(range(8))}}}
+    schema = {"i": {"f": list(range(8))}}
     c = make_cluster(2, schema=schema)
     job = c.node_join(Node(id="node9", uri="http://host9:10101"))
     assert c.state == STATE_RESIZING
@@ -103,7 +103,7 @@ def test_resize_plan_join():
 
 
 def test_resize_plan_leave():
-    schema = {"i": {"f": {"standard": list(range(8))}}}
+    schema = {"i": {"f": list(range(8))}}
     c = make_cluster(3, replica_n=2, schema=schema)
     job = c.node_leave("node2")
     assert job is not None and c.state == STATE_RESIZING
@@ -127,7 +127,7 @@ def test_leave_below_replica_degrades():
 
 
 def test_abort_resize():
-    schema = {"i": {"f": {"standard": [0]}}}
+    schema = {"i": {"f": [0]}}
     c = make_cluster(2, schema=schema)
     c.node_join(Node(id="nodez"))
     assert c.state == STATE_RESIZING

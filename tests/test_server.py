@@ -343,3 +343,216 @@ def test_read_does_not_mint_keys(server):
     assert out["results"] == [0]
     jpost(u, "/index/ki/query", raw=b"Clear('nope', f='x')")
     assert server.translate.log_size() == size_before
+
+
+# ---------------------------------------------------------------------------
+# resize: dynamic node join / remove with fragment migration
+# ---------------------------------------------------------------------------
+
+
+def wait_until(fn, timeout=15.0, interval=0.05):
+    import time
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if fn():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def _fragment_count(server):
+    n = 0
+    for idx in server.holder.indexes.values():
+        for f in idx.fields.values():
+            for v in f.views.values():
+                n += len(v.shards())
+    return n
+
+
+def test_resize_join_migrates_fragments(tmp_path):
+    # 1-node cluster with data spanning 8 shards
+    a = Server(str(tmp_path / "a"), port=0, membership_interval=0.2).open()
+    jpost(a.uri, "/index/i", {})
+    jpost(a.uri, "/index/i/field/f", {})
+    cols = [k * SHARD_WIDTH + 5 for k in range(8)]
+    jpost(a.uri, "/index/i/field/f/import",
+          {"rowIDs": [1] * 8, "columnIDs": cols})
+    _, out = jpost(a.uri, "/index/i/query", raw=b"Count(Row(f=1))")
+    assert out["results"] == [8]
+
+    # dynamic join (gossip-seed analog): B knocks, coordinator A resizes
+    b = Server(str(tmp_path / "b"), port=0, cluster_hosts=[a.uri],
+               membership_interval=0.2, join=True).open()
+    try:
+        assert wait_until(lambda: b.cluster.state == "NORMAL"
+                          and len(b.cluster.nodes) == 2
+                          and len(a.cluster.nodes) == 2)
+        # schema arrived on B
+        assert b.holder.index("i") is not None
+        assert b.holder.index("i").field("f") is not None
+        # B owns some shards and received their fragments
+        owned_b = [s for s in range(8) if b.cluster.owns_shard(b.node_id, "i", s)]
+        assert owned_b, "placement should give the new node shards"
+        # migration: B holds data for its owned shards
+        assert wait_until(lambda: _fragment_count(b) > 0)
+        # cleaner: A dropped what it no longer owns (replica_n=1)
+        assert wait_until(lambda: all(
+            a.holder.index("i").field("f").views["standard"].fragment(s) is None
+            for s in owned_b))
+        # the data is still fully queryable from BOTH nodes
+        for s in (a, b):
+            _, out = jpost(s.uri, "/index/i/query", raw=b"Count(Row(f=1))")
+            assert out["results"] == [8], s.uri
+            _, out = jpost(s.uri, "/index/i/query", raw=b"Row(f=1)")
+            assert sorted(out["results"][0]["columns"]) == sorted(cols)
+    finally:
+        b.close()
+        a.close()
+
+
+def test_resize_remove_node(tmp_path):
+    # 3 nodes, replica_n=2 so a removed node's shards have surviving donors
+    servers = []
+    for i in range(3):
+        s = Server(str(tmp_path / f"n{i}"), port=0, replica_n=2,
+                   membership_interval=0.2).open()
+        servers.append(s)
+    uris = [s.uri for s in servers]
+    for s in servers:
+        s.cluster_hosts = uris
+        s.refresh_membership()
+    try:
+        s0 = servers[0]
+        jpost(s0.uri, "/index/i", {})
+        jpost(s0.uri, "/index/i/field/f", {})
+        cols = [k * SHARD_WIDTH + 9 for k in range(6)]
+        jpost(s0.uri, "/index/i/field/f/import",
+              {"rowIDs": [2] * 6, "columnIDs": cols})
+
+        # remove the last node (by id) via the public endpoint on any node
+        victim = max(servers, key=lambda s: s.node_id)
+        survivors = [s for s in servers if s is not victim]
+        jpost(s0.uri, "/cluster/resize/remove-node", {"id": victim.node_id})
+        assert wait_until(lambda: all(
+            s.cluster.state == "NORMAL" and len(s.cluster.nodes) == 2
+            for s in survivors))
+        # data remains fully queryable on the survivors
+        for s in survivors:
+            _, out = jpost(s.uri, "/index/i/query", raw=b"Count(Row(f=2))")
+            assert out["results"] == [6], s.uri
+    finally:
+        for s in servers:
+            s.close()
+
+
+def test_resize_join_queues_while_resizing(tmp_path):
+    # two nodes joining in quick succession both end up admitted
+    a = Server(str(tmp_path / "a"), port=0, membership_interval=0.2).open()
+    jpost(a.uri, "/index/i", {})
+    jpost(a.uri, "/index/i/field/f", {})
+    jpost(a.uri, "/index/i/field/f/import",
+          {"rowIDs": [1] * 4, "columnIDs": [k * SHARD_WIDTH for k in range(4)]})
+    b = Server(str(tmp_path / "b"), port=0, cluster_hosts=[a.uri],
+               membership_interval=0.2, join=True).open()
+    c = Server(str(tmp_path / "c"), port=0, cluster_hosts=[a.uri],
+               membership_interval=0.2, join=True).open()
+    try:
+        assert wait_until(lambda: all(
+            s.cluster.state == "NORMAL" and len(s.cluster.nodes) == 3
+            for s in (a, b, c)), timeout=30)
+        for s in (a, b, c):
+            _, out = jpost(s.uri, "/index/i/query", raw=b"Count(Row(f=1))")
+            assert out["results"] == [4], s.uri
+    finally:
+        c.close()
+        b.close()
+        a.close()
+
+
+def test_cluster_state_broadcast_blocks_writes(server):
+    # a "cluster-state" RESIZING message must gate writes on every node
+    # (methodsNormal excludes Import during RESIZING, api.go:1247-1278)
+    server.receive_message({"type": "cluster-state", "state": "RESIZING"})
+    st, out = jpost(server.uri, "/index/i2", {})
+    assert st == 503
+    st, _ = jpost(server.uri, "/cluster/resize/abort")
+    assert st == 200
+    st, out = jpost(server.uri, "/index/i2", {})
+    assert st == 200
+
+
+def test_remove_node_refuses_without_replicas(tmp_path):
+    # replica_n=1: removing a node would drop its shards' only copy — the
+    # request must be refused (fragSources error, cluster.go:806-811)
+    servers = []
+    for i in range(2):
+        s = Server(str(tmp_path / f"n{i}"), port=0, replica_n=1,
+                   membership_interval=0.2).open()
+        servers.append(s)
+    uris = [s.uri for s in servers]
+    for s in servers:
+        s.cluster_hosts = uris
+        s.refresh_membership()
+    try:
+        s0 = servers[0]
+        jpost(s0.uri, "/index/i", {})
+        jpost(s0.uri, "/index/i/field/f", {})
+        jpost(s0.uri, "/index/i/field/f/import",
+              {"rowIDs": [1] * 4, "columnIDs": [k * SHARD_WIDTH for k in range(4)]})
+        victim = max(servers, key=lambda s: s.node_id)
+        coordinator = min(servers, key=lambda s: s.node_id)
+        st, out = jpost(coordinator.uri, "/cluster/resize/remove-node",
+                        {"id": victim.node_id})
+        assert st == 400
+        assert "replica factor" in out["error"]
+        # membership unchanged, data intact
+        assert len(coordinator.cluster.nodes) == 2
+        _, out = jpost(coordinator.uri, "/index/i/query", raw=b"Count(Row(f=1))")
+        assert out["results"] == [4]
+    finally:
+        for s in servers:
+            s.close()
+
+
+def test_remove_then_rejoin(tmp_path):
+    # a removed node must be able to rejoin: peers' tombstones are replaced
+    # by the coordinator's authoritative removed-set on each topology
+    servers = []
+    for i in range(3):
+        s = Server(str(tmp_path / f"n{i}"), port=0, replica_n=2,
+                   membership_interval=0.2).open()
+        servers.append(s)
+    uris = [s.uri for s in servers]
+    for s in servers:
+        s.cluster_hosts = uris
+        s.refresh_membership()
+    rejoined = None
+    try:
+        jpost(servers[0].uri, "/index/i", {})
+        jpost(servers[0].uri, "/index/i/field/f", {})
+        jpost(servers[0].uri, "/index/i/query", raw=b"Set(5, f=1)")
+        victim = max(servers, key=lambda s: s.node_id)
+        survivors = [s for s in servers if s is not victim]
+        jpost(servers[0].uri, "/cluster/resize/remove-node",
+              {"id": victim.node_id})
+        assert wait_until(lambda: all(
+            s.cluster.state == "NORMAL" and len(s.cluster.nodes) == 2
+            for s in survivors))
+        victim.close()
+        # rejoin with the same identity (same data dir -> same .id file)
+        rejoined = Server(str(tmp_path / f"n{servers.index(victim)}"), port=0,
+                          replica_n=2, cluster_hosts=[survivors[0].uri],
+                          membership_interval=0.2, join=True).open()
+        assert rejoined.node_id == victim.node_id
+        assert wait_until(lambda: all(
+            s.cluster.state == "NORMAL" and len(s.cluster.nodes) == 3
+            for s in survivors + [rejoined]), timeout=30)
+        for s in survivors + [rejoined]:
+            _, out = jpost(s.uri, "/index/i/query", raw=b"Count(Row(f=1))")
+            assert out["results"] == [1], s.uri
+    finally:
+        if rejoined is not None:
+            rejoined.close()
+        for s in servers:
+            if s.http._thread is not None:
+                s.close()
