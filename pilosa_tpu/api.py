@@ -109,18 +109,30 @@ class API:
 
     # -- queries ------------------------------------------------------------
 
-    def query(self, index_name: str, pql: str,
-              shards: Optional[list[int]] = None, remote: bool = False) -> dict:
-        """POST /index/{index}/query (api.Query, api.go:102)."""
+    def query_results(self, index_name: str, pql: str,
+                      shards: Optional[list[int]] = None,
+                      remote: bool = False) -> list:
+        """Execute PQL and return raw result objects (Row/Pairs/ValCount/...).
+
+        Both wire writers consume this: query() renders JSON, the protobuf
+        path encodes with encoding.protobuf.Serializer (api.Query, api.go:102).
+        """
         self._validate("query")
         index = self.holder.index(index_name)
         if index is None:
             raise NotFoundError(f"index not found: {index_name}")
         try:
-            results = self.executor.execute(index_name, pql, shards=shards,
-                                            remote=remote)
+            return self.executor.execute(index_name, pql, shards=shards,
+                                         remote=remote)
         except (ExecutionError, ValueError) as e:
             raise ApiError(str(e))
+
+    def query(self, index_name: str, pql: str,
+              shards: Optional[list[int]] = None, remote: bool = False) -> dict:
+        """POST /index/{index}/query (api.Query, api.go:102)."""
+        results = self.query_results(index_name, pql, shards=shards,
+                                     remote=remote)
+        index = self.holder.index(index_name)
         return {"results": [self._result_to_json(index, r) for r in results]}
 
     def _result_to_json(self, index, result):
@@ -258,8 +270,10 @@ class API:
                 return
         ts = None
         if timestamps:
+            # 0 means "no timestamp" (wire zero value), not epoch 0
             ts = [datetime.fromtimestamp(t, tz=timezone.utc).replace(tzinfo=None)
-                  if isinstance(t, (int, float)) and not isinstance(t, bool) else
+                  if isinstance(t, (int, float)) and not isinstance(t, bool)
+                  and t else
                   (t if isinstance(t, datetime) else None)
                   for t in timestamps]
         f.import_bits(row_ids, column_ids, ts)
@@ -301,8 +315,12 @@ class API:
                            "remote": True}
                 if extra:
                     payload["timestamps"] = [extra[i] for i in sel]
-            self.forward_import_fn(group["uri"], index_name, field_name,
-                                   payload)
+            try:
+                self.forward_import_fn(group["uri"], index_name, field_name,
+                                       payload)
+            except Exception as e:  # noqa: BLE001 — surface as a 502, not 500
+                raise ApiError(
+                    f"forwarding import to {group['uri']}: {e}", status=502)
         return ([a_ids[i] for i in local_idx],
                 [column_ids[i] for i in local_idx],
                 [extra[i] for i in local_idx] if extra else None)
@@ -343,8 +361,14 @@ class API:
             owners = self.cluster.shard_nodes(index_name, shard)
             for node in owners:
                 if node.id != self.cluster.local_id:
-                    self.forward_roaring_fn(node.uri, index_name, field_name,
-                                            shard, views, clear)
+                    try:
+                        self.forward_roaring_fn(node.uri, index_name,
+                                                field_name, shard, views,
+                                                clear)
+                    except Exception as e:  # noqa: BLE001
+                        raise ApiError(
+                            f"forwarding import to {node.uri}: {e}",
+                            status=502)
             if not any(n.id == self.cluster.local_id for n in owners):
                 return
         for vname, data in views.items():

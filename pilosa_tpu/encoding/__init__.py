@@ -1,0 +1,1 @@
+"""Wire codecs: protobuf serializer for the HTTP surface (encoding/proto analog)."""

@@ -1,0 +1,248 @@
+"""Protobuf Serializer: raw executor results <-> wire messages.
+
+Reference: encoding/proto/proto.go:29-45 (Serializer Marshal/Unmarshal for
+every message), http/handler.go:915-988 (per-request JSON/protobuf content
+negotiation). The HTTP layer calls this when a request carries
+Content-Type/Accept: application/x-protobuf; JSON stays the default.
+
+Result type tags follow the reference's queryResultType* iota
+(encoding/proto/proto.go:1047-1057).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from pilosa_tpu.executor import GroupCounts, Pairs, RowIdentifiers, ValCount
+from pilosa_tpu.models.row import Row
+from pilosa_tpu.proto import pilosa_pb2 as pb
+
+CONTENT_TYPE = "application/x-protobuf"
+
+RESULT_NIL = 0
+RESULT_ROW = 1
+RESULT_PAIRS = 2
+RESULT_VALCOUNT = 3
+RESULT_UINT64 = 4
+RESULT_BOOL = 5
+RESULT_ROWIDS = 6
+RESULT_GROUPCOUNTS = 7
+RESULT_ROWIDENTIFIERS = 8
+
+ATTR_STRING = 1
+ATTR_INT = 2
+ATTR_BOOL = 3
+ATTR_FLOAT = 4
+
+
+def _encode_attrs(attrs: dict) -> list:
+    out = []
+    for key in sorted(attrs):
+        val = attrs[key]
+        a = pb.Attr(Key=key)
+        if isinstance(val, bool):
+            a.Type, a.BoolValue = ATTR_BOOL, val
+        elif isinstance(val, int):
+            a.Type, a.IntValue = ATTR_INT, val
+        elif isinstance(val, float):
+            a.Type, a.FloatValue = ATTR_FLOAT, val
+        else:
+            a.Type, a.StringValue = ATTR_STRING, str(val)
+        out.append(a)
+    return out
+
+
+def _decode_attrs(pb_attrs) -> dict:
+    out = {}
+    for a in pb_attrs:
+        if a.Type == ATTR_BOOL:
+            out[a.Key] = a.BoolValue
+        elif a.Type == ATTR_INT:
+            out[a.Key] = a.IntValue
+        elif a.Type == ATTR_FLOAT:
+            out[a.Key] = a.FloatValue
+        else:
+            out[a.Key] = a.StringValue
+    return out
+
+
+def _encode_result(result) -> pb.QueryResult:
+    r = pb.QueryResult()
+    if isinstance(result, Row):
+        r.Type = RESULT_ROW
+        r.Row.Columns.extend(int(c) for c in result.columns())
+        if result.keys:
+            r.Row.Keys.extend(result.keys)
+        r.Row.Attrs.extend(_encode_attrs(result.attrs))
+    elif isinstance(result, Pairs):
+        r.Type = RESULT_PAIRS
+        r.Pairs.extend(pb.Pair(ID=int(i), Count=int(c)) for i, c in result)
+    elif isinstance(result, ValCount):
+        r.Type = RESULT_VALCOUNT
+        r.ValCount.Val = int(result.val)
+        r.ValCount.Count = int(result.count)
+    elif isinstance(result, RowIdentifiers):
+        r.Type = RESULT_ROWIDENTIFIERS
+        r.RowIdentifiers.Rows.extend(int(x) for x in result)
+    elif isinstance(result, GroupCounts):
+        r.Type = RESULT_GROUPCOUNTS
+        for gc in result:
+            g = pb.GroupCount(Count=int(gc["count"]))
+            g.Group.extend(
+                pb.FieldRow(Field=fr["field"], RowID=int(fr["rowID"]))
+                for fr in gc["group"])
+            r.GroupCounts.append(g)
+    elif isinstance(result, bool):
+        r.Type = RESULT_BOOL
+        r.Changed = result
+    elif isinstance(result, (int, np.integer)):
+        r.Type = RESULT_UINT64
+        r.N = int(result)
+    elif result is None:
+        r.Type = RESULT_NIL
+    else:
+        raise TypeError(f"unserializable result type: {type(result)!r}")
+    return r
+
+
+def decode_result(r: pb.QueryResult):
+    """Wire result -> plain Python value (mirror of _encode_result)."""
+    if r.Type == RESULT_ROW:
+        row = Row(np.array(list(r.Row.Columns), dtype=np.uint64))
+        row.attrs = _decode_attrs(r.Row.Attrs)
+        row.keys = list(r.Row.Keys)
+        return row
+    if r.Type == RESULT_PAIRS:
+        return Pairs((p.ID, p.Count) for p in r.Pairs)
+    if r.Type == RESULT_VALCOUNT:
+        return ValCount(r.ValCount.Val, r.ValCount.Count)
+    if r.Type == RESULT_UINT64:
+        return int(r.N)
+    if r.Type == RESULT_BOOL:
+        return bool(r.Changed)
+    if r.Type == RESULT_ROWIDENTIFIERS:
+        return RowIdentifiers(r.RowIdentifiers.Rows)
+    if r.Type == RESULT_GROUPCOUNTS:
+        return GroupCounts(
+            {"group": [{"field": fr.Field, "rowID": fr.RowID} for fr in g.Group],
+             "count": g.Count}
+            for g in r.GroupCounts)
+    return None
+
+
+class Serializer:
+    """Marshal/unmarshal the wire messages the HTTP surface speaks."""
+
+    content_type = CONTENT_TYPE
+
+    # -- query ---------------------------------------------------------------
+
+    def encode_query_request(self, pql: str, shards: Optional[list[int]] = None,
+                             remote: bool = False,
+                             column_attrs: bool = False) -> bytes:
+        m = pb.QueryRequest(Query=pql, Remote=remote, ColumnAttrs=column_attrs)
+        if shards:
+            m.Shards.extend(shards)
+        return m.SerializeToString()
+
+    def decode_query_request(self, data: bytes) -> dict:
+        m = pb.QueryRequest()
+        m.ParseFromString(data)
+        return {"query": m.Query, "shards": list(m.Shards) or None,
+                "remote": m.Remote, "columnAttrs": m.ColumnAttrs,
+                "excludeRowAttrs": m.ExcludeRowAttrs,
+                "excludeColumns": m.ExcludeColumns}
+
+    def encode_query_response(self, results: list, err: str = "",
+                              column_attr_sets=None) -> bytes:
+        m = pb.QueryResponse(Err=err)
+        m.Results.extend(_encode_result(r) for r in results)
+        for cas in column_attr_sets or []:
+            c = pb.ColumnAttrSet(ID=int(cas["id"]))
+            c.Attrs.extend(_encode_attrs(cas.get("attrs", {})))
+            m.ColumnAttrSets.append(c)
+        return m.SerializeToString()
+
+    def decode_query_response(self, data: bytes) -> dict:
+        m = pb.QueryResponse()
+        m.ParseFromString(data)
+        return {"err": m.Err,
+                "results": [decode_result(r) for r in m.Results],
+                "columnAttrSets": [
+                    {"id": c.ID, "attrs": _decode_attrs(c.Attrs)}
+                    for c in m.ColumnAttrSets]}
+
+    # -- imports -------------------------------------------------------------
+
+    def encode_import_request(self, index: str, field: str, shard: int = 0,
+                              row_ids=None, column_ids=None, timestamps=None,
+                              row_keys=None, column_keys=None) -> bytes:
+        m = pb.ImportRequest(Index=index, Field=field, Shard=shard)
+        m.RowIDs.extend(row_ids or [])
+        m.ColumnIDs.extend(column_ids or [])
+        m.Timestamps.extend(timestamps or [])
+        m.RowKeys.extend(row_keys or [])
+        m.ColumnKeys.extend(column_keys or [])
+        return m.SerializeToString()
+
+    def decode_import_request(self, data: bytes) -> dict:
+        m = pb.ImportRequest()
+        m.ParseFromString(data)
+        return {"index": m.Index, "field": m.Field, "shard": m.Shard,
+                "rowIDs": list(m.RowIDs) or None,
+                "columnIDs": list(m.ColumnIDs) or None,
+                "timestamps": list(m.Timestamps) or None,
+                "rowKeys": list(m.RowKeys) or None,
+                "columnKeys": list(m.ColumnKeys) or None}
+
+    def encode_import_value_request(self, index: str, field: str,
+                                    shard: int = 0, column_ids=None,
+                                    values=None, column_keys=None) -> bytes:
+        m = pb.ImportValueRequest(Index=index, Field=field, Shard=shard)
+        m.ColumnIDs.extend(column_ids or [])
+        m.Values.extend(values or [])
+        m.ColumnKeys.extend(column_keys or [])
+        return m.SerializeToString()
+
+    def decode_import_value_request(self, data: bytes) -> dict:
+        m = pb.ImportValueRequest()
+        m.ParseFromString(data)
+        return {"index": m.Index, "field": m.Field, "shard": m.Shard,
+                "columnIDs": list(m.ColumnIDs) or None,
+                "values": list(m.Values) or None,
+                "columnKeys": list(m.ColumnKeys) or None}
+
+    def encode_import_roaring_request(self, views: dict[str, bytes],
+                                      clear: bool = False) -> bytes:
+        m = pb.ImportRoaringRequest(Clear=clear)
+        for name, data in views.items():
+            m.views.append(pb.ImportRoaringRequestView(Name=name, Data=data))
+        return m.SerializeToString()
+
+    def decode_import_roaring_request(self, data: bytes) -> dict:
+        m = pb.ImportRoaringRequest()
+        m.ParseFromString(data)
+        return {"clear": m.Clear, "views": {v.Name: v.Data for v in m.views}}
+
+    # -- key translation -----------------------------------------------------
+
+    def encode_translate_keys_request(self, index: str, field: Optional[str],
+                                      keys: list[str]) -> bytes:
+        return pb.TranslateKeysRequest(
+            Index=index, Field=field or "", Keys=keys).SerializeToString()
+
+    def decode_translate_keys_request(self, data: bytes) -> dict:
+        m = pb.TranslateKeysRequest()
+        m.ParseFromString(data)
+        return {"index": m.Index, "field": m.Field or None,
+                "keys": list(m.Keys)}
+
+    def encode_translate_keys_response(self, ids: list[int]) -> bytes:
+        return pb.TranslateKeysResponse(IDs=ids).SerializeToString()
+
+    def decode_translate_keys_response(self, data: bytes) -> list[int]:
+        m = pb.TranslateKeysResponse()
+        m.ParseFromString(data)
+        return list(m.IDs)

@@ -746,9 +746,10 @@ class Executor:
         err: Exception | None = None
         if node is not None and node.uri:
             try:
-                resp = self.client.query(node.uri, index.name, call.to_pql(),
-                                         shards=node_shards, remote=True)
-                return [self._result_from_json(index, call, resp["results"][0])]
+                results = self.client.query_proto(
+                    node.uri, index.name, call.to_pql(),
+                    shards=node_shards, remote=True)
+                return [results[0]]
             except ClientError as e:
                 err = e
         # failover: per-shard re-mapping onto surviving replicas
@@ -791,10 +792,10 @@ class Executor:
                     else:
                         node = self.cluster.node_by_id(rid)
                         try:
-                            resp = self.client.query(node.uri, index.name, pql,
-                                                     shards=rshards, remote=True)
-                            partials.append(self._result_from_json(
-                                index, call, resp["results"][0]))
+                            results = self.client.query_proto(
+                                node.uri, index.name, pql,
+                                shards=rshards, remote=True)
+                            partials.append(results[0])
                         except ClientError as e:
                             raise ExecutionError(f"replica write failed: {e}")
             return any(bool(p) for p in partials)
@@ -810,44 +811,14 @@ class Executor:
                 r = self._execute_call(index, call, None)
             else:
                 try:
-                    resp = self.client.query(node.uri, index.name, pql,
-                                             shards=None, remote=True)
-                    r = self._result_from_json(index, call, resp["results"][0])
+                    results = self.client.query_proto(node.uri, index.name,
+                                                      pql, shards=None,
+                                                      remote=True)
+                    r = results[0]
                 except ClientError as e:
                     raise ExecutionError(f"replica write failed: {e}")
             result = r if result is None else (result or r)
         return result
-
-    def _result_from_json(self, index: Index, call: Call, obj):
-        """Inverse of the API's JSON encoding, per call type — remote
-        responses come back as JSON (QueryResponse union,
-        internal/public.proto:62-88)."""
-        if call.name == "Count":
-            return int(obj)
-        if call.name in ("Sum", "Min", "Max"):
-            return ValCount(obj.get("value", 0), obj.get("count", 0))
-        if call.name == "TopN":
-            return Pairs((p["id"], p["count"]) for p in obj) \
-                if isinstance(obj, list) else Pairs()
-        if call.name == "Rows":
-            return RowIdentifiers(
-                obj.get("rows", []) if isinstance(obj, dict) else obj)
-        if call.name == "GroupBy":
-            return GroupCounts(obj if isinstance(obj, list) else [])
-        if call.name in BITMAP_CALLS:
-            if not isinstance(obj, dict):
-                return Row()
-            if "keys" in obj and self.translator is not None:
-                # keyed index: the node JSON-encodes columns as keys. Lookup
-                # only (create=False) — decoding a result must never mint ids.
-                cols = [self.translator.translate_column(index.name, k,
-                                                         create=False)
-                        for k in obj["keys"]]
-                cols = [c for c in cols if c is not None]
-            else:
-                cols = obj.get("columns", [])
-            return Row(np.array(cols, dtype=np.uint64))
-        return obj
 
     def _reduce(self, call: Call, partials: list, index: Optional[Index] = None,
                 shards: Optional[list[int]] = None):

@@ -29,10 +29,13 @@ class InternalClient:
 
     def _request(self, method: str, uri: str, path: str,
                  body: Optional[bytes] = None,
-                 content_type: str = "application/json") -> bytes:
+                 content_type: str = "application/json",
+                 accept: Optional[str] = None) -> bytes:
+        headers = {"Content-Type": content_type} if body is not None else {}
+        if accept:
+            headers["Accept"] = accept
         req = urllib.request.Request(
-            uri + path, data=body, method=method,
-            headers={"Content-Type": content_type} if body is not None else {})
+            uri + path, data=body, method=method, headers=headers)
         try:
             with urllib.request.urlopen(req, timeout=self.timeout) as resp:
                 return resp.read()
@@ -49,16 +52,21 @@ class InternalClient:
 
     # -- interface (client.go:32-59) ----------------------------------------
 
-    def query(self, uri: str, index: str, pql: str,
-              shards: Optional[list[int]] = None, remote: bool = False) -> dict:
-        args = []
-        if shards:
-            args.append("shards=" + ",".join(str(s) for s in shards))
-        if remote:
-            args.append("remote=1")
-        path = f"/index/{index}/query" + ("?" + "&".join(args) if args else "")
-        out = self._request("POST", uri, path, pql.encode(), "text/plain")
-        return json.loads(out)
+    def query_proto(self, uri: str, index: str, pql: str,
+                    shards: Optional[list[int]] = None,
+                    remote: bool = False) -> list:
+        """Remote query over the protobuf wire codec; returns raw decoded
+        result objects (the reference's internal fan-out path — remoteExec
+        sends QueryRequest protobuf, executor.go:2142-2159)."""
+        from pilosa_tpu.encoding.protobuf import CONTENT_TYPE, Serializer
+        s = Serializer()
+        body = s.encode_query_request(pql, shards=shards, remote=remote)
+        out = self._request("POST", uri, f"/index/{index}/query", body,
+                            CONTENT_TYPE, accept=CONTENT_TYPE)
+        resp = s.decode_query_response(out)
+        if resp["err"]:
+            raise ClientError(f"remote query: {resp['err']}")
+        return resp["results"]
 
     def import_bits(self, uri: str, index: str, field: str, payload: dict) -> None:
         self._json("POST", uri, f"/index/{index}/field/{field}/import", payload)

@@ -15,6 +15,8 @@ from typing import Callable, Optional
 from urllib.parse import parse_qs, urlparse
 
 from pilosa_tpu.api import API, ApiError
+from pilosa_tpu.encoding.protobuf import CONTENT_TYPE as PROTO_CONTENT_TYPE
+from pilosa_tpu.encoding.protobuf import Serializer
 from pilosa_tpu.models.field import FieldOptions
 
 # (method, regex) -> handler name; ordered
@@ -63,9 +65,13 @@ class Handler:
         self.api = api
         self.cluster_message_fn = cluster_message_fn
         self.stats = stats
+        self.serializer = Serializer()
+        self._local = threading.local()
 
-    def dispatch(self, method: str, path: str, query: dict, body: bytes):
+    def dispatch(self, method: str, path: str, query: dict, body: bytes,
+                 headers=None):
         """-> (status, content_type, payload bytes)."""
+        self._local.headers = headers
         for m, rx, name in ROUTES:
             if m != method:
                 continue
@@ -106,16 +112,39 @@ class Handler:
         vals = query.get(name)
         return vals[0] if vals else default
 
+    # content negotiation (http/handler.go:915-988): JSON is the default;
+    # application/x-protobuf selects the wire codec per request.
+    def _header(self, name: str, default: str = "") -> str:
+        h = getattr(self._local, "headers", None)
+        if h is None:
+            return default
+        return h.get(name, default) if hasattr(h, "get") else default
+
+    def _wants_proto(self) -> bool:
+        return PROTO_CONTENT_TYPE in self._header("Accept")
+
+    def _sends_proto(self) -> bool:
+        return PROTO_CONTENT_TYPE in self._header("Content-Type")
+
     # -- public handlers ----------------------------------------------------
 
     def home(self, params, query, body):
         return self._json({"name": "pilosa-tpu", "version": self.api.version()})
 
     def post_query(self, params, query, body):
-        shards = self._arg(query, "shards")
-        shard_list = [int(s) for s in shards.split(",")] if shards else None
-        remote = self._arg(query, "remote") in ("1", "true")
-        pql = body.decode()
+        if self._sends_proto():
+            req = self.serializer.decode_query_request(body)
+            pql, shard_list, remote = req["query"], req["shards"], req["remote"]
+        else:
+            shards = self._arg(query, "shards")
+            shard_list = [int(s) for s in shards.split(",")] if shards else None
+            remote = self._arg(query, "remote") in ("1", "true")
+            pql = body.decode()
+        if self._wants_proto():
+            results = self.api.query_results(params["index"], pql,
+                                             shards=shard_list, remote=remote)
+            payload = self.serializer.encode_query_response(results)
+            return 200, PROTO_CONTENT_TYPE, payload
         return self._json(self.api.query(params["index"], pql,
                                          shards=shard_list, remote=remote))
 
@@ -157,7 +186,17 @@ class Handler:
         return self._json({"success": True})
 
     def post_import(self, params, query, body):
-        req = self._body_json(body)
+        if self._sends_proto():
+            # the wire carries ImportRequest or ImportValueRequest on the same
+            # endpoint; the field's type picks the message (handler.go:990)
+            fld = self.api.holder.index(params["index"])
+            fld = fld.field(params["field"]) if fld is not None else None
+            if fld is not None and fld.options.type == "int":
+                req = self.serializer.decode_import_value_request(body)
+            else:
+                req = self.serializer.decode_import_request(body)
+        else:
+            req = self._body_json(body)
         remote = bool(req.get("remote", False))
         if "values" in req:
             self.api.import_values(
@@ -173,9 +212,13 @@ class Handler:
         return self._json({})
 
     def post_import_roaring(self, params, query, body):
-        req = self._body_json(body)
-        views = {name: base64.b64decode(data)
-                 for name, data in req.get("views", {}).items()}
+        if self._sends_proto():
+            req = self.serializer.decode_import_roaring_request(body)
+            views = req["views"]
+        else:
+            req = self._body_json(body)
+            views = {name: base64.b64decode(data)
+                     for name, data in req.get("views", {}).items()}
         self.api.import_roaring(params["index"], params["field"],
                                 int(params["shard"]), views,
                                 clear=bool(req.get("clear", False)),
@@ -283,10 +326,16 @@ class Handler:
         return 200, "application/octet-stream", self.api.translate_data(offset)
 
     def post_translate_keys(self, params, query, body):
-        req = self._body_json(body)
+        if self._sends_proto():
+            req = self.serializer.decode_translate_keys_request(body)
+        else:
+            req = self._body_json(body)
         ids = self.api.translate_keys(req.get("index"), req.get("field"),
                                       req.get("keys", []),
                                       create=req.get("create", True))
+        if self._wants_proto():
+            return (200, PROTO_CONTENT_TYPE,
+                    self.serializer.encode_translate_keys_response(ids))
         return self._json({"ids": ids})
 
 
@@ -299,7 +348,8 @@ class _RequestHandler(BaseHTTPRequestHandler):
         length = int(self.headers.get("Content-Length", 0) or 0)
         body = self.rfile.read(length) if length else b""
         status, ctype, payload = self.handler.dispatch(
-            method, parsed.path, parse_qs(parsed.query), body)
+            method, parsed.path, parse_qs(parsed.query), body,
+            headers=self.headers)
         self.send_response(status)
         self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(payload)))

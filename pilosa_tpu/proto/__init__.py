@@ -1,0 +1,4 @@
+"""Generated protobuf messages (see pilosa.proto; regenerate with
+`protoc --python_out=. pilosa.proto` in this directory)."""
+
+from pilosa_tpu.proto import pilosa_pb2  # noqa: F401

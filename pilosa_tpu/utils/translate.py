@@ -116,13 +116,18 @@ class TranslateStore:
 
     def ensure_mapping(self, kind: int, index: str, field: str, key: str,
                        id_: int) -> None:
-        """Install a mapping minted by the primary (replica-side apply)."""
+        """Install a mapping minted by the primary (replica-side apply).
+
+        Memory-only: the on-disk log must stay a byte-prefix of the primary's
+        log so tailing (/internal/translate/data with offset=log_size) stays
+        aligned. Durable replication happens only through apply_log; mappings
+        installed here are recovered after restart by re-forwarding or
+        re-tailing."""
         with self._lock:
             fwd = (self._col_fwd.setdefault(index, {}) if kind == KIND_COLUMN
                    else self._row_fwd.setdefault((index, field), {}))
             if key not in fwd:
                 self._apply(kind, index, field, key, id_)
-                self._append(kind, index, field, key, id_)
 
     # -- replication (replicas tail the primary's log;
     #    /internal/translate/data, translate.go:662) ------------------------

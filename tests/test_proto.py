@@ -1,0 +1,148 @@
+"""Protobuf wire codec tests: serializer roundtrips + HTTP content
+negotiation end-to-end (reference: encoding/proto/proto.go,
+http/handler.go:915-988)."""
+
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+from pilosa_tpu.encoding.protobuf import CONTENT_TYPE, Serializer
+from pilosa_tpu.executor import GroupCounts, Pairs, RowIdentifiers, ValCount
+from pilosa_tpu.models.row import Row
+from pilosa_tpu.server import Server
+
+
+@pytest.fixture(scope="module")
+def ser():
+    return Serializer()
+
+
+def test_query_request_roundtrip(ser):
+    data = ser.encode_query_request("Count(Row(f=1))", shards=[0, 3], remote=True)
+    req = ser.decode_query_request(data)
+    assert req["query"] == "Count(Row(f=1))"
+    assert req["shards"] == [0, 3]
+    assert req["remote"] is True
+
+
+def test_result_roundtrip_all_types(ser):
+    row = Row(np.array([1, 5, 2**20 + 3], dtype=np.uint64))
+    row.attrs = {"name": "x", "n": 7, "ok": True, "score": 1.5}
+    results = [
+        row,
+        Pairs([(10, 100), (20, 50)]),
+        ValCount(42, 3),
+        7,               # Count
+        True,            # Set
+        RowIdentifiers([1, 2, 3]),
+        GroupCounts([{"group": [{"field": "f", "rowID": 4}], "count": 9}]),
+        None,
+    ]
+    data = ser.encode_query_response(results)
+    out = ser.decode_query_response(data)
+    assert out["err"] == ""
+    dec = out["results"]
+    assert list(dec[0].columns()) == [1, 5, 2**20 + 3]
+    assert dec[0].attrs == {"name": "x", "n": 7, "ok": True, "score": 1.5}
+    assert dec[1] == [(10, 100), (20, 50)]
+    assert dec[2] == ValCount(42, 3)
+    assert dec[3] == 7
+    assert dec[4] is True
+    assert dec[5] == [1, 2, 3]
+    assert dec[6] == [{"group": [{"field": "f", "rowID": 4}], "count": 9}]
+    assert dec[7] is None
+
+
+def test_import_request_roundtrip(ser):
+    data = ser.encode_import_request("i", "f", shard=2, row_ids=[1, 2],
+                                     column_ids=[10, 20], timestamps=[0, 5])
+    req = ser.decode_import_request(data)
+    assert req["index"] == "i" and req["field"] == "f" and req["shard"] == 2
+    assert req["rowIDs"] == [1, 2]
+    assert req["columnIDs"] == [10, 20]
+    assert req["timestamps"] == [0, 5]
+
+    data = ser.encode_import_value_request("i", "v", column_ids=[3], values=[-7])
+    req = ser.decode_import_value_request(data)
+    assert req["columnIDs"] == [3] and req["values"] == [-7]
+
+    data = ser.encode_import_roaring_request({"standard": b"\x01\x02"}, clear=True)
+    req = ser.decode_import_roaring_request(data)
+    assert req["clear"] is True and req["views"] == {"standard": b"\x01\x02"}
+
+
+def test_translate_keys_roundtrip(ser):
+    data = ser.encode_translate_keys_request("i", None, ["a", "b"])
+    req = ser.decode_translate_keys_request(data)
+    assert req == {"index": "i", "field": None, "keys": ["a", "b"]}
+    ids = ser.decode_translate_keys_response(
+        ser.encode_translate_keys_response([4, 5]))
+    assert ids == [4, 5]
+
+
+# ---------------------------------------------------------------- end-to-end
+
+
+@pytest.fixture
+def server(tmp_path):
+    s = Server(str(tmp_path / "node"), port=0).open()
+    yield s
+    s.close()
+
+
+def _req(uri, path, body=None, method="POST", headers=None):
+    req = urllib.request.Request(uri + path, data=body, method=method,
+                                 headers=headers or {})
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        return resp.status, resp.headers.get("Content-Type"), resp.read()
+
+
+def test_http_protobuf_negotiation(server, ser):
+    u = server.uri
+    _req(u, "/index/i", json.dumps({"options": {}}).encode())
+    _req(u, "/index/i/field/f",
+         json.dumps({"options": {"type": "set"}}).encode())
+
+    # import over protobuf
+    body = ser.encode_import_request("i", "f", row_ids=[1, 1, 2],
+                                     column_ids=[10, 20, 10])
+    status, _, _ = _req(u, "/index/i/field/f/import", body,
+                        headers={"Content-Type": CONTENT_TYPE})
+    assert status == 200
+
+    # protobuf request + protobuf response
+    qbody = ser.encode_query_request("Count(Row(f=1))")
+    status, ctype, out = _req(u, "/index/i/query", qbody,
+                              headers={"Content-Type": CONTENT_TYPE,
+                                       "Accept": CONTENT_TYPE})
+    assert status == 200 and ctype == CONTENT_TYPE
+    resp = ser.decode_query_response(out)
+    assert resp["results"] == [2]
+
+    # JSON request + protobuf response (Accept only)
+    status, ctype, out = _req(u, "/index/i/query", b"Row(f=1)",
+                              headers={"Accept": CONTENT_TYPE})
+    assert ctype == CONTENT_TYPE
+    resp = ser.decode_query_response(out)
+    assert list(resp["results"][0].columns()) == [10, 20]
+
+    # JSON path still default
+    status, ctype, out = _req(u, "/index/i/query", b"Count(Row(f=2))")
+    assert ctype == "application/json"
+    assert json.loads(out)["results"] == [1]
+
+
+def test_http_protobuf_value_import(server, ser):
+    u = server.uri
+    _req(u, "/index/i", json.dumps({"options": {}}).encode())
+    _req(u, "/index/i/field/v",
+         json.dumps({"options": {"type": "int", "min": -100, "max": 100}}).encode())
+    body = ser.encode_import_value_request("i", "v", column_ids=[1, 2, 3],
+                                           values=[5, -7, 30])
+    status, _, _ = _req(u, "/index/i/field/v/import", body,
+                        headers={"Content-Type": CONTENT_TYPE})
+    assert status == 200
+    _, _, out = _req(u, "/index/i/query", b"Sum(field=v)")
+    assert json.loads(out)["results"][0] == {"value": 28, "count": 3}
