@@ -93,6 +93,10 @@ class API:
         # api.validateShardOwnership, api.go:804)
         self.forward_import_fn = None
         self.forward_roaring_fn = None
+        # slow-query logging (cluster.longQueryTime, api.go:1038; server
+        # option server.go:121). 0 disables.
+        self.long_query_time = 0.0
+        self.logger = None
 
     def _broadcast(self, msg: dict) -> None:
         if self.broadcast_fn is not None:
@@ -121,11 +125,19 @@ class API:
         index = self.holder.index(index_name)
         if index is None:
             raise NotFoundError(f"index not found: {index_name}")
+        import time as _time
+        start = _time.perf_counter()
         try:
             return self.executor.execute(index_name, pql, shards=shards,
                                          remote=remote)
         except (ExecutionError, ValueError) as e:
             raise ApiError(str(e))
+        finally:
+            elapsed = _time.perf_counter() - start
+            if (self.long_query_time > 0 and elapsed > self.long_query_time
+                    and self.logger is not None):
+                self.logger.printf("%.3fs SLOW QUERY %s %s",
+                                   elapsed, index_name, pql)
 
     def query(self, index_name: str, pql: str,
               shards: Optional[list[int]] = None, remote: bool = False) -> dict:
@@ -497,6 +509,20 @@ class API:
         rows, cols = frag.block_data(block)
         return {"rowIDs": rows.tolist(), "columnIDs": cols.tolist()}
 
+    def column_attr_diff(self, index_name: str, blocks: list[dict]) -> dict:
+        """Attrs in blocks whose checksum differs from the caller's
+        (api.ColumnAttrDiff — the attr anti-entropy pull, holder.go:726)."""
+        index = self.holder.index(index_name)
+        if index is None:
+            raise NotFoundError(f"index not found: {index_name}")
+        return _attr_diff(index.column_attrs, blocks)
+
+    def row_attr_diff(self, index_name: str, field_name: str,
+                      blocks: list[dict]) -> dict:
+        """api.RowAttrDiff (holder.go:772 syncField)."""
+        f = self._field(index_name, field_name)
+        return _attr_diff(f.row_attrs, blocks)
+
     def fragment_views(self, index_name: str, field_name: str,
                        shard: int) -> list[str]:
         """View names holding a fragment for `shard` — the donor-side
@@ -530,3 +556,15 @@ class API:
 
     def translate_data(self, offset: int = 0) -> bytes:
         return self.translate.log_bytes(offset)
+
+
+def _attr_diff(store, blocks: list[dict]) -> dict:
+    """Return {id: attrs} for every local block whose checksum differs from
+    the caller's view (attr.go blocks; boltdb/attrstore.go BlockData)."""
+    remote = {int(b["id"]): b.get("checksum", "") for b in blocks}
+    out: dict[int, dict] = {}
+    for blk, chk in store.blocks():
+        if remote.get(blk) == chk.hex():
+            continue
+        out.update(store.block_data(blk))
+    return out

@@ -28,8 +28,15 @@ class AntiEntropyConfig:
 
 @dataclass
 class MetricConfig:
-    service: str = "expvar"  # expvar | nop
+    service: str = "expvar"  # expvar | statsd | nop
+    host: str = "127.0.0.1:8125"  # statsd agent address
     poll_interval: float = 0.0
+
+
+@dataclass
+class DiagnosticsConfig:
+    url: str = ""  # phone-home endpoint; empty disables
+    interval: float = 0.0
 
 
 @dataclass
@@ -49,6 +56,7 @@ class Config:
     cluster: ClusterConfig = field(default_factory=ClusterConfig)
     anti_entropy: AntiEntropyConfig = field(default_factory=AntiEntropyConfig)
     metric: MetricConfig = field(default_factory=MetricConfig)
+    diagnostics: DiagnosticsConfig = field(default_factory=DiagnosticsConfig)
     tracing: TracingConfig = field(default_factory=TracingConfig)
 
     @property
@@ -70,7 +78,7 @@ class Config:
     def _apply_dict(self, data: dict) -> None:
         for key, value in data.items():
             attr = key.replace("-", "_")
-            if attr in ("cluster", "anti_entropy", "metric", "tracing") and isinstance(value, dict):
+            if attr in ("cluster", "anti_entropy", "metric", "diagnostics", "tracing") and isinstance(value, dict):
                 sub = getattr(self, attr)
                 for k, v in value.items():
                     sk = k.replace("-", "_")
@@ -90,7 +98,7 @@ class Config:
 
     def _set_path(self, parts: list[str], raw: str) -> None:
         # try sub-config first (cluster_replicas -> cluster.replicas)
-        for sub_name in ("cluster", "anti_entropy", "metric", "tracing"):
+        for sub_name in ("cluster", "anti_entropy", "metric", "diagnostics", "tracing"):
             sub_parts = sub_name.split("_")
             if parts[: len(sub_parts)] == sub_parts and len(parts) > len(sub_parts):
                 sub = getattr(self, sub_name)
@@ -119,7 +127,12 @@ class Config:
             "",
             "[metric]",
             f'service = "{self.metric.service}"',
+            f'host = "{self.metric.host}"',
             f"poll-interval = {self.metric.poll_interval}",
+            "",
+            "[diagnostics]",
+            f'url = "{self.diagnostics.url}"',
+            f"interval = {self.diagnostics.interval}",
             "",
             "[tracing]",
             f'sampler-type = "{self.tracing.sampler_type}"',

@@ -97,6 +97,12 @@ def cmd_server(args) -> int:
         replica_n=cfg.cluster.replicas,
         anti_entropy_interval=cfg.anti_entropy.interval,
         join=getattr(args, "join", False),
+        long_query_time=cfg.cluster.long_query_time,
+        metric_service=cfg.metric.service,
+        metric_host=cfg.metric.host,
+        metric_poll_interval=cfg.metric.poll_interval,
+        diagnostics_url=cfg.diagnostics.url,
+        diagnostics_interval=cfg.diagnostics.interval,
     ).open()
     print(f"pilosa-tpu {__version__} serving at {server.uri} "
           f"(data: {data_dir}, node: {server.node_id})", flush=True)

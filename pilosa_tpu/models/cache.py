@@ -29,12 +29,10 @@ THRESHOLD_FACTOR = 1.5
 
 
 class RankCache:
-    """Tracks per-row approximate counts; prunes to cache_size by rank.
+    """Tracks per-row approximate counts; prunes to cache_size by rank
+    (cache.go:136-302 rankCache, CacheTypeRanked default for set fields)."""
 
-    Used for both "ranked" and "lru" cache types — LRU eviction differs in
-    the reference (cache.go:58-130) but its observable role in queries is the
-    same: a bounded candidate set for TopN.
-    """
+    cache_type = CACHE_TYPE_RANKED
 
     def __init__(self, cache_size: int = 50000):
         self.cache_size = cache_size
@@ -81,7 +79,7 @@ class RankCache:
         tmp = path + ".tmp"
         os.makedirs(os.path.dirname(path), exist_ok=True)
         with open(tmp, "w") as f:
-            json.dump({"cacheSize": self.cache_size,
+            json.dump({"type": self.cache_type, "cacheSize": self.cache_size,
                        "counts": {str(k): v for k, v in self.counts.items()}}, f)
         os.replace(tmp, path)
 
@@ -92,6 +90,72 @@ class RankCache:
         c = cls(data.get("cacheSize", 50000))
         c.counts = {int(k): v for k, v in data.get("counts", {}).items()}
         return c
+
+
+class LRUCache(RankCache):
+    """Recency-evicting candidate cache (cache.go:58-130 lruCache over lru/):
+    rows fall out by last-touch order rather than rank, so cold rows leave
+    the TopN candidate set even if they once ranked high."""
+
+    cache_type = CACHE_TYPE_LRU
+
+    def add(self, row_id: int, count: int) -> None:
+        if count <= 0:
+            self.counts.pop(row_id, None)
+            return
+        # dict preserves insertion order: delete+insert marks recency
+        self.counts.pop(row_id, None)
+        self.counts[row_id] = count
+        while len(self.counts) > self.cache_size:
+            self.counts.pop(next(iter(self.counts)))
+
+    def bulk_add(self, pairs: Iterable[tuple[int, int]]) -> None:
+        for row_id, count in pairs:
+            self.add(row_id, count)
+
+    def invalidate(self) -> None:
+        while len(self.counts) > self.cache_size:
+            self.counts.pop(next(iter(self.counts)))
+
+
+class NopCache(RankCache):
+    """cache.go:461-481 nopCache: tracks nothing; TopN falls back to a full
+    row-id scan of the fragment."""
+
+    cache_type = CACHE_TYPE_NONE
+
+    def add(self, row_id: int, count: int) -> None:
+        pass
+
+    def bulk_add(self, pairs: Iterable[tuple[int, int]]) -> None:
+        pass
+
+    def save(self, path: str) -> None:
+        pass
+
+
+_CACHE_TYPES = {
+    CACHE_TYPE_RANKED: RankCache,
+    CACHE_TYPE_LRU: LRUCache,
+    CACHE_TYPE_NONE: NopCache,
+}
+
+
+def make_cache(cache_type: str, cache_size: int = 50000) -> RankCache:
+    cls = _CACHE_TYPES.get(cache_type)
+    if cls is None:
+        raise ValueError(f"invalid cache type: {cache_type}")
+    return cls(cache_size)
+
+
+def load_cache(path: str) -> RankCache:
+    """Load a persisted .cache file, dispatching on its recorded type."""
+    with open(path) as f:
+        data = json.load(f)
+    c = make_cache(data.get("type", CACHE_TYPE_RANKED),
+                   data.get("cacheSize", 50000))
+    c.counts = {int(k): v for k, v in data.get("counts", {}).items()}
+    return c
 
 
 def merge_pairs(lists: Iterable[list[tuple[int, int]]]) -> list[tuple[int, int]]:

@@ -49,8 +49,11 @@ class FieldOptions:
     keys: bool = False
 
     def validate(self) -> None:
+        from pilosa_tpu.models.cache import _CACHE_TYPES
         if self.type not in FieldType.ALL:
             raise ValueError(f"invalid field type: {self.type}")
+        if self.cache_type not in _CACHE_TYPES:
+            raise ValueError(f"invalid cache type: {self.cache_type}")
         if self.type == FieldType.INT and self.max < self.min:
             raise ValueError("int field max must be >= min")
         if self.type == FieldType.TIME:
@@ -138,7 +141,8 @@ class Field:
         if v is None:
             v = View(view_path(self.path, name), self.index, self.name, name,
                      track_rank=self._track_rank() and not name.startswith(VIEW_BSI_PREFIX),
-                     cache_size=self.options.cache_size).open()
+                     cache_size=self.options.cache_size,
+                     cache_type=self.options.cache_type).open()
             self.views[name] = v
         return v
 

@@ -11,7 +11,13 @@ import os
 from typing import Optional
 
 from pilosa_tpu.constants import SHARD_WIDTH
-from pilosa_tpu.models.cache import RankCache
+from pilosa_tpu.models.cache import (
+    CACHE_TYPE_NONE,
+    CACHE_TYPE_RANKED,
+    RankCache,
+    load_cache,
+    make_cache,
+)
 from pilosa_tpu.storage.fragment import Fragment
 
 VIEW_STANDARD = "standard"
@@ -24,14 +30,16 @@ def view_path(field_path: str, name: str) -> str:
 
 class View:
     def __init__(self, path: str, index: str, field: str, name: str,
-                 track_rank: bool = False, cache_size: int = 50000):
+                 track_rank: bool = False, cache_size: int = 50000,
+                 cache_type: str = CACHE_TYPE_RANKED):
         self.path = path
         self.index = index
         self.field = field
         self.name = name
         self.fragments: dict[int, Fragment] = {}
-        self.track_rank = track_rank
+        self.track_rank = track_rank and cache_type != CACHE_TYPE_NONE
         self.cache_size = cache_size
+        self.cache_type = cache_type
         self.rank_caches: dict[int, RankCache] = {}
 
     # -- lifecycle ----------------------------------------------------------
@@ -67,9 +75,9 @@ class View:
         if self.track_rank:
             cache_path = frag.path + ".cache"
             if os.path.exists(cache_path):
-                self.rank_caches[shard] = RankCache.load(cache_path)
+                self.rank_caches[shard] = load_cache(cache_path)
             else:
-                cache = RankCache(self.cache_size)
+                cache = make_cache(self.cache_type, self.cache_size)
                 cache.bulk_add((rid, frag.row_count(rid)) for rid in frag.row_ids())
                 self.rank_caches[shard] = cache
         return frag
@@ -134,6 +142,6 @@ class View:
         frag = self.fragments.get(shard)
         if frag is None:
             return
-        cache = RankCache(self.cache_size)
+        cache = make_cache(self.cache_type, self.cache_size)
         cache.bulk_add((rid, frag.row_count(rid)) for rid in frag.row_ids())
         self.rank_caches[shard] = cache

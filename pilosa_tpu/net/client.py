@@ -95,6 +95,20 @@ class InternalClient:
                           f"/internal/fragment/block/data?index={index}&field={field}"
                           f"&view={view}&shard={shard}&block={block}")
 
+    def column_attr_diff(self, uri: str, index: str,
+                         blocks: list[dict]) -> dict[int, dict]:
+        """Pull column attrs whose blocks differ (AttrDiff, client.go:32)."""
+        out = self._json("POST", uri, f"/internal/index/{index}/attr/diff",
+                         {"blocks": blocks})
+        return {int(k): v for k, v in out.get("attrs", {}).items()}
+
+    def row_attr_diff(self, uri: str, index: str, field: str,
+                      blocks: list[dict]) -> dict[int, dict]:
+        out = self._json(
+            "POST", uri, f"/internal/index/{index}/field/{field}/attr/diff",
+            {"blocks": blocks})
+        return {int(k): v for k, v in out.get("attrs", {}).items()}
+
     def fragment_views(self, uri: str, index: str, field: str,
                        shard: int) -> list[str]:
         out = self._json("GET", uri,

@@ -48,6 +48,8 @@ ROUTES: list[tuple[str, re.Pattern, str]] = [
     ("GET", re.compile(r"^/internal/fragment/data$"), "get_fragment_data"),
     ("GET", re.compile(r"^/internal/fragment/views$"), "get_fragment_views"),
     ("GET", re.compile(r"^/internal/fragment/nodes$"), "get_fragment_nodes"),
+    ("POST", re.compile(r"^/internal/index/(?P<index>[^/]+)/attr/diff$"), "post_column_attr_diff"),
+    ("POST", re.compile(r"^/internal/index/(?P<index>[^/]+)/field/(?P<field>[^/]+)/attr/diff$"), "post_row_attr_diff"),
     ("DELETE", re.compile(r"^/internal/index/(?P<index>[^/]+)/field/(?P<field>[^/]+)/remote-available-shards/(?P<shard>\d+)$"), "delete_remote_available_shard"),
     ("GET", re.compile(r"^/internal/nodes$"), "get_nodes"),
     ("GET", re.compile(r"^/internal/shards/max$"), "get_shards_max"),
@@ -82,14 +84,22 @@ class Handler:
             try:
                 return handler(match.groupdict(), query, body)
             except ApiError as e:
-                return e.status, "application/json", json.dumps({"error": str(e)}).encode()
+                return self._error(e.status, str(e))
             except Exception as e:  # noqa: BLE001 — surface as 500
-                return 500, "application/json", json.dumps({"error": str(e)}).encode()
+                return self._error(500, str(e))
         if any(rx.match(path) for _, rx, _ in ROUTES):
             return 405, "application/json", b'{"error": "method not allowed"}'
         return 404, "application/json", b'{"error": "not found"}'
 
     # -- helpers ------------------------------------------------------------
+
+    def _error(self, status: int, msg: str):
+        """Protobuf clients get errors as QueryResponse{Err} so they can
+        unmarshal them (proto.go encodes Err the same way); JSON otherwise."""
+        if self._wants_proto():
+            return (status, PROTO_CONTENT_TYPE,
+                    self.serializer.encode_query_response([], err=msg))
+        return status, "application/json", json.dumps({"error": msg}).encode()
 
     @staticmethod
     def _json(payload, status: int = 200):
@@ -309,6 +319,18 @@ class Handler:
         index = self._arg(query, "index")
         shard = int(self._arg(query, "shard", "0"))
         return self._json(self.api.shard_nodes(index, shard))
+
+    def post_column_attr_diff(self, params, query, body):
+        req = self._body_json(body)
+        attrs = self.api.column_attr_diff(params["index"],
+                                          req.get("blocks", []))
+        return self._json({"attrs": {str(k): v for k, v in attrs.items()}})
+
+    def post_row_attr_diff(self, params, query, body):
+        req = self._body_json(body)
+        attrs = self.api.row_attr_diff(params["index"], params["field"],
+                                       req.get("blocks", []))
+        return self._json({"attrs": {str(k): v for k, v in attrs.items()}})
 
     def delete_remote_available_shard(self, params, query, body):
         self.api.delete_remote_available_shard(

@@ -44,7 +44,13 @@ class Server:
                  membership_interval: float = 5.0,
                  join: bool = False,
                  resize_timeout: float = 120.0,
-                 mesh=None):
+                 mesh=None,
+                 long_query_time: float = 0.0,
+                 metric_service: str = "expvar",
+                 metric_host: str = "127.0.0.1:8125",
+                 metric_poll_interval: float = 0.0,
+                 diagnostics_url: str = "",
+                 diagnostics_interval: float = 0.0):
         self.data_dir = data_dir
         self.holder = Holder(data_dir)
         self.node_id = node_id or self._load_or_create_id()
@@ -58,9 +64,19 @@ class Server:
         from pilosa_tpu.utils.logger import Logger
         from pilosa_tpu.utils.stats import new_stats_client
         from pilosa_tpu.utils.tracing import Tracer
-        self.stats = new_stats_client()
+        self.stats = new_stats_client(metric_service, metric_host)
         self.tracer = Tracer()
         self.logger = Logger()
+        from pilosa_tpu.utils.diagnostics import (
+            DiagnosticsCollector,
+            RuntimeMonitor,
+        )
+        from pilosa_tpu import __version__
+        self.runtime_monitor = RuntimeMonitor(self.stats,
+                                              metric_poll_interval)
+        self.diagnostics = DiagnosticsCollector(
+            __version__, url=diagnostics_url, interval=diagnostics_interval,
+            holder=self.holder, cluster=self.cluster, logger=self.logger)
         from pilosa_tpu.utils.cluster_translate import ClusterTranslator
         self.cluster_translate = ClusterTranslator(self.translate, self.cluster,
                                                    self.client)
@@ -75,6 +91,7 @@ class Server:
                                stats=self.stats)
         self.http = HTTPServer(self.handler, host=host, port=port)
         self.cluster_hosts = cluster_hosts or []
+        self.long_query_time = long_query_time
         self.anti_entropy_interval = anti_entropy_interval
         self.membership_interval = membership_interval
         # join=True: this node is being added to an existing cluster —
@@ -159,8 +176,12 @@ class Server:
             lambda uri, index, field, shard, views, clear:
             self.client.import_roaring(uri, index, field, shard, views,
                                        clear=clear, remote=True))
+        self.api.long_query_time = self.long_query_time
+        self.api.logger = self.logger
         if self.anti_entropy_interval > 0:
             self._schedule_anti_entropy()
+        self.runtime_monitor.start()
+        self.diagnostics.start()
         return self
 
     def _schedule_membership_refresh(self) -> None:
@@ -235,6 +256,8 @@ class Server:
             self._member_timer.cancel()
         if self._resize_watchdog is not None:
             self._resize_watchdog.cancel()
+        self.runtime_monitor.close()
+        self.diagnostics.close()
         self.http.close()
         self.holder.close()
         self.translate.close()
@@ -723,16 +746,43 @@ class Server:
             self._schedule_anti_entropy()
 
     def sync_holder(self) -> int:
-        """One full anti-entropy pass over owned fragments; returns number of
-        blocks merged (holderSyncer.SyncHolder, holder.go:633-853)."""
+        """One full anti-entropy pass: index column attrs, field row attrs,
+        then owned fragments; returns blocks merged (holderSyncer.SyncHolder,
+        holder.go:633-853 — syncIndex :726, syncField :772, fragments :821)."""
         merged = 0
         for iname, idx in self.holder.indexes.items():
+            merged += self._sync_attrs(
+                idx.column_attrs,
+                lambda uri, blocks: self.client.column_attr_diff(uri, iname,
+                                                                 blocks))
             for fname, field in idx.fields.items():
+                merged += self._sync_attrs(
+                    field.row_attrs,
+                    lambda uri, blocks, fn=fname: self.client.row_attr_diff(
+                        uri, iname, fn, blocks))
                 for vname, view in field.views.items():
                     for shard in view.shards():
                         if not self.cluster.owns_shard(self.node_id, iname, shard):
                             continue
                         merged += self._sync_fragment(iname, fname, vname, shard)
+        return merged
+
+    def _sync_attrs(self, store, diff_fn) -> int:
+        """Pull attr blocks that differ from each peer and merge them in
+        (attrs replicate to every node; each node pulls on its own pass)."""
+        merged = 0
+        for node in self.cluster.nodes:
+            if node.id == self.node_id or not node.uri:
+                continue
+            blocks = [{"id": b, "checksum": chk.hex()}
+                      for b, chk in store.blocks()]
+            try:
+                attrs = diff_fn(node.uri, blocks)
+            except ClientError:
+                continue
+            if attrs:
+                store.set_bulk_attrs(attrs.items())
+                merged += 1
         return merged
 
     def _sync_fragment(self, iname: str, fname: str, vname: str, shard: int) -> int:

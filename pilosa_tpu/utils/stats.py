@@ -87,8 +87,73 @@ class NopStatsClient:
         return {}
 
 
-def new_stats_client(service: str = "expvar"):
-    """metric.service selection (server/server.go:361-374)."""
-    if service in ("expvar", "statsd"):  # statsd egress not available: in-mem
+class StatsDClient:
+    """UDP statsd emitter, DataDog dialect with |#tag suffixes
+    (statsd/statsd.go:41-130). Sends are fire-and-forget datagrams to a
+    local agent; network errors are swallowed like the reference's."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 8125,
+                 prefix: str = "pilosa.",
+                 tags: Optional[list[str]] = None, _sock=None):
+        import socket
+        self.host, self.port, self.prefix = host, port, prefix
+        self.tags = sorted(tags or [])
+        self._sock = _sock or socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+
+    def with_tags(self, *tags: str) -> "StatsDClient":
+        return StatsDClient(self.host, self.port, self.prefix,
+                            self.tags + list(tags), self._sock)
+
+    def _send(self, name: str, value, kind: str, rate: float,
+              tags: Optional[list[str]] = None) -> None:
+        if rate < 1.0:
+            # client-side sampling: drop (1-rate) of events; the aggregator
+            # scales received values back up by 1/rate via the @ suffix
+            import random
+            if random.random() > rate:
+                return
+        msg = f"{self.prefix}{name}:{value}|{kind}"
+        if rate < 1.0:
+            msg += f"|@{rate}"
+        all_tags = self.tags + (tags or [])
+        if all_tags:
+            msg += "|#" + ",".join(all_tags)
+        try:
+            self._sock.sendto(msg.encode(), (self.host, self.port))
+        except OSError:
+            pass
+
+    def count(self, name, value=1, rate=1.0):
+        self._send(name, value, "c", rate)
+
+    def count_with_custom_tags(self, name, value, rate, tags):
+        self._send(name, value, "c", rate, tags)
+
+    def gauge(self, name, value, rate=1.0):
+        self._send(name, value, "g", rate)
+
+    def histogram(self, name, value, rate=1.0):
+        self._send(name, value, "h", rate)
+
+    def set(self, name, value, rate=1.0):
+        self._send(name, value, "s", rate)
+
+    def timing(self, name, value, rate=1.0):
+        self._send(name, value, "ms", rate)
+
+    def snapshot(self):
+        return {}
+
+    def close(self):
+        self._sock.close()
+
+
+def new_stats_client(service: str = "expvar", host: str = "127.0.0.1:8125"):
+    """metric.service selection (server/server.go:361-374):
+    expvar (default, in-memory /debug/vars), statsd (UDP agent), nop."""
+    if service == "statsd":
+        h, _, p = host.partition(":")
+        return StatsDClient(h or "127.0.0.1", int(p or 8125))
+    if service == "expvar":
         return StatsClient()
     return NopStatsClient()

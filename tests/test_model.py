@@ -186,3 +186,58 @@ def test_rank_cache_update(holder):
     top = cache.top(2)
     assert top[0] == (1, 20)
     assert top[1] == (2, 1)
+
+
+# -- cache types (cache.go:58-130 lru, :461 nop; field option cacheType) -----
+
+def test_lru_cache_evicts_by_recency():
+    from pilosa_tpu.models.cache import LRUCache
+    c = LRUCache(cache_size=3)
+    c.add(1, 10)
+    c.add(2, 20)
+    c.add(3, 30)
+    c.add(1, 11)      # touch 1 -> 2 is now least recent
+    c.add(4, 40)
+    assert sorted(c.ids()) == [1, 3, 4]
+    assert c.top() == [(4, 40), (3, 30), (1, 11)]
+
+
+def test_nop_cache_tracks_nothing():
+    from pilosa_tpu.models.cache import NopCache
+    c = NopCache(cache_size=3)
+    c.add(1, 10)
+    c.bulk_add([(2, 5)])
+    assert len(c) == 0 and c.ids() == [] and c.top() == []
+
+
+def test_cache_persistence_dispatches_on_type(tmp_path):
+    from pilosa_tpu.models.cache import LRUCache, load_cache
+    c = LRUCache(cache_size=5)
+    c.add(7, 70)
+    p = str(tmp_path / "x.cache")
+    c.save(p)
+    loaded = load_cache(p)
+    assert isinstance(loaded, LRUCache)
+    assert loaded.top() == [(7, 70)]
+
+
+def test_field_cache_type_options(tmp_path):
+    from pilosa_tpu.models.cache import LRUCache, NopCache
+    from pilosa_tpu.models.field import Field, FieldOptions
+    import pytest as _pytest
+
+    f = Field(str(tmp_path / "f"), "i", "f",
+              FieldOptions(cache_type="lru", cache_size=10)).open()
+    f.set_bit(1, 5)
+    v = f.view("standard")
+    assert isinstance(v.rank_caches[0], LRUCache)
+    f.close()
+
+    g = Field(str(tmp_path / "g"), "i", "g",
+              FieldOptions(cache_type="none")).open()
+    g.set_bit(1, 5)
+    assert g.view("standard").rank_caches == {}
+    g.close()
+
+    with _pytest.raises(ValueError):
+        FieldOptions(cache_type="bogus").validate()

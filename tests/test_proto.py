@@ -146,3 +146,20 @@ def test_http_protobuf_value_import(server, ser):
     assert status == 200
     _, _, out = _req(u, "/index/i/query", b"Sum(field=v)")
     assert json.loads(out)["results"][0] == {"value": 28, "count": 3}
+
+
+def test_protobuf_error_response(server, ser):
+    """Errors reach protobuf clients as QueryResponse{Err}, not JSON
+    (proto.go error encoding; handler negotiation)."""
+    import urllib.error
+    u = server.uri
+    _req(u, "/index/i", json.dumps({"options": {}}).encode())
+    qbody = ser.encode_query_request("Bogus(")
+    try:
+        _req(u, "/index/i/query", qbody,
+             headers={"Content-Type": CONTENT_TYPE, "Accept": CONTENT_TYPE})
+        assert False, "expected HTTPError"
+    except urllib.error.HTTPError as e:
+        assert e.headers.get("Content-Type") == CONTENT_TYPE
+        resp = ser.decode_query_response(e.read())
+        assert resp["err"]

@@ -556,3 +556,37 @@ def test_remove_then_rejoin(tmp_path):
         for s in servers:
             if s.http._thread is not None:
                 s.close()
+
+
+def test_attr_anti_entropy_sync(cluster3):
+    """Attr blocks diff + pull heals diverged row/column attr stores
+    (holderSyncer.syncIndex/syncField, holder.go:726,772)."""
+    s0, s1, s2 = cluster3
+    jpost(s0.uri, "/index/i", {})
+    jpost(s0.uri, "/index/i/field/f", {})
+    # write attrs only on s0's stores, bypassing broadcast
+    s0.holder.index("i").column_attrs.set_attrs(7, {"city": "x", "n": 3})
+    s0.holder.index("i").field("f").row_attrs.set_attrs(2, {"label": "two"})
+    assert s1.holder.index("i").column_attrs.attrs(7) == {}
+    # s1 pulls the diff on its own anti-entropy pass
+    merged = s1.sync_holder()
+    assert merged >= 2
+    assert s1.holder.index("i").column_attrs.attrs(7) == {"city": "x", "n": 3}
+    assert s1.holder.index("i").field("f").row_attrs.attrs(2) == {"label": "two"}
+    # converged stores stop reporting diffs
+    assert s1.sync_holder() == 0
+
+
+def test_attr_diff_endpoint(server):
+    u = server.uri
+    jpost(u, "/index/i", {})
+    jpost(u, "/index/i/field/f", {})
+    server.holder.index("i").column_attrs.set_attrs(1, {"a": 1})
+    status, out = jpost(u, "/internal/index/i/attr/diff", {"blocks": []})
+    assert status == 200
+    assert out["attrs"] == {"1": {"a": 1}}
+    # matching checksum -> empty diff
+    blocks = [{"id": b, "checksum": c.hex()}
+              for b, c in server.holder.index("i").column_attrs.blocks()]
+    _, out = jpost(u, "/internal/index/i/attr/diff", {"blocks": blocks})
+    assert out["attrs"] == {}

@@ -111,3 +111,101 @@ def test_translate_replication(tmp_path):
     assert replica.translate_column("i", "k3", create=False) == 3
     primary.close()
     replica.close()
+
+
+# -- statsd client (statsd/statsd.go) ----------------------------------------
+
+def test_statsd_client_datagrams():
+    import socket
+    from pilosa_tpu.utils.stats import StatsDClient, new_stats_client
+
+    rx = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    rx.bind(("127.0.0.1", 0))
+    rx.settimeout(5)
+    port = rx.getsockname()[1]
+    c = StatsDClient("127.0.0.1", port, prefix="pilosa.")
+    c.count("queries", 2)
+    assert rx.recvfrom(1024)[0] == b"pilosa.queries:2|c"
+    c.gauge("heap", 12.5)
+    assert rx.recvfrom(1024)[0] == b"pilosa.heap:12.5|g"
+    c.with_tags("index:i").timing("latency", 3)
+    assert rx.recvfrom(1024)[0] == b"pilosa.latency:3|ms|#index:i"
+    # factory selection
+    s = new_stats_client("statsd", f"127.0.0.1:{port}")
+    s.count("x")
+    assert rx.recvfrom(1024)[0] == b"pilosa.x:1|c"
+    rx.close()
+    # unreachable agent must not raise
+    dead = StatsDClient("127.0.0.1", 1)
+    dead.count("x")
+
+
+# -- system info / diagnostics / runtime monitor (diagnostics.go) ------------
+
+def test_system_info_proc():
+    from pilosa_tpu.utils.diagnostics import SystemInfo
+    si = SystemInfo()
+    assert si.uptime() > 0
+    assert si.platform() == "Linux"
+    assert si.mem_total() > si.mem_used() > 0
+    assert si.cpu_count() >= 1
+
+
+def test_diagnostics_collect_and_flush():
+    import json
+    import threading
+    from http.server import BaseHTTPRequestHandler, HTTPServer
+    from pilosa_tpu.utils.diagnostics import DiagnosticsCollector
+
+    received = []
+
+    class H(BaseHTTPRequestHandler):
+        def do_POST(self):
+            n = int(self.headers["Content-Length"])
+            received.append(json.loads(self.rfile.read(n)))
+            self.send_response(200)
+            self.end_headers()
+
+        def log_message(self, *a):
+            pass
+
+    srv = HTTPServer(("127.0.0.1", 0), H)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    url = f"http://127.0.0.1:{srv.server_address[1]}/diagnostics"
+    d = DiagnosticsCollector("1.0.0", url=url)
+    info = d.collect()
+    assert info["Version"] == "1.0.0" and info["OS"] == "Linux"
+    assert d.flush() is True
+    assert received[0]["NumCPU"] >= 1
+    srv.shutdown()
+    # no URL -> disabled, flush is a no-op
+    assert DiagnosticsCollector("1.0.0").flush() is False
+
+
+def test_runtime_monitor_gauges():
+    from pilosa_tpu.utils.diagnostics import RuntimeMonitor
+    from pilosa_tpu.utils.stats import StatsClient
+    stats = StatsClient()
+    RuntimeMonitor(stats).sample()
+    snap = stats.snapshot()["gauges"]
+    assert snap["memory/rss"] > 0
+    assert snap["threads"] >= 1
+
+
+def test_long_query_logging(tmp_path):
+    import io
+    from pilosa_tpu.server import Server
+    from pilosa_tpu.utils.logger import Logger
+
+    s = Server(str(tmp_path / "n"), port=0, long_query_time=0.0000001).open()
+    try:
+        buf = io.StringIO()
+        s.api.logger = Logger(out=buf)
+        s.api.create_index("i")
+        from pilosa_tpu.models.field import FieldOptions
+        s.api.create_field("i", "f", FieldOptions())
+        s.api.query("i", "Count(Row(f=1))")
+        assert "SLOW QUERY i Count(Row(f=1))" in buf.getvalue()
+    finally:
+        s.close()
