@@ -1,12 +1,29 @@
-"""Benchmark: PQL Intersect+Count on TPU vs CPU-numpy reference baseline.
+"""Benchmark: PQL Intersect+Count query stream on TPU vs CPU-numpy baseline.
 
-Config 2 of BASELINE.md: synthetic set field, two rows spanning S shards,
-Count(Intersect(Row, Row)) — the hot path the reference serves with roaring
-container kernels + goroutine fan-out (executor.go:2183, roaring
-intersectionCount kernels). No Go toolchain exists in this image, so the
-baseline is a measured CPU implementation of the same dense kernel in numpy
-(vectorized AND + popcount — an upper bound on the Go implementation's
-single-node throughput for dense data, and the same algorithmic work).
+Config 2 of BASELINE.md: synthetic set field with R resident rows spanning
+S = 1024 shards (1024 x 2^20 = 1.07B columns per row), serving a stream of
+Count(Intersect(Row(i), Row(j))) queries — the hot path the reference serves
+with roaring container kernels + goroutine fan-out (executor.go:2183,2283;
+intersectionCount kernels roaring/roaring.go:2162-2291). No Go toolchain
+exists in this image, so the baseline is a measured CPU implementation of the
+same dense kernel in numpy (vectorized AND + popcount — an upper bound on the
+Go implementation's single-node throughput for dense data, and the same
+algorithmic work per query).
+
+Methodology notes (the axon tunnel makes naive timing lie in both
+directions):
+- Queries are chained: each dispatch's carry feeds the next, so device
+  executions serialize and one final int() fetch forces the whole chain
+  (block_until_ready returns early under the tunnel; per-query fetches would
+  measure tunnel RTT instead of the kernel).
+- Each dispatch runs a lax.scan over K (row_i, row_j) index pairs — a batch
+  of K *distinct* queries against the resident row slab, the shape of a real
+  query stream. Row indices are dynamic scan inputs, so XLA cannot hoist or
+  CSE the per-query work (a loop-invariant body would be hoisted and
+  under-measure by orders of magnitude).
+- The carry folds into the output only; it never touches the slab (an
+  input-side .at[].set() chain would add a full slab copy per dispatch and
+  over-measure).
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 """
@@ -16,53 +33,59 @@ import time
 
 import numpy as np
 
+N_SHARDS = 1024      # 1024 shards x 2^20 cols = 1.07B columns per row
+N_ROWS = 16          # resident rows: 16 x 134MB = 2.1GB HBM
+K_BATCH = 32         # distinct queries per dispatch
+N_DISPATCH = 6       # chained dispatches measured
+
 
 def main() -> None:
     import jax
-    from pilosa_tpu.constants import WORDS_PER_SHARD
-    from pilosa_tpu.parallel.mesh import eval_count_total
-
-    n_shards = 1024  # 1024 shards x 2^20 cols = 1.07B columns per operand
-    rng = np.random.default_rng(7)
-    slab_np = rng.integers(0, 2**32, size=(2, n_shards, WORDS_PER_SHARD), dtype=np.uint32)
-    program = ("and", ("leaf", 0), ("leaf", 1))
-
-    # --- TPU path: HBM-resident slab, fused and+popcount ---
-    # Chained-dependency timing: iteration i's input depends on i-1's result,
-    # so N executions serialize on device and one final fetch amortizes the
-    # host<->device round trip. (Plain async loops under-measure; per-call
-    # fetches measure tunnel RTT instead of the kernel.)
     import jax.numpy as jnp
+    from pilosa_tpu.constants import WORDS_PER_SHARD
+    from pilosa_tpu.parallel.mesh import count_pair_stream, eval_count_total
 
-    slab = jax.device_put(slab_np)
+    rng = np.random.default_rng(7)
+    rows_np = rng.integers(
+        0, 2**32, size=(N_ROWS, N_SHARDS, WORDS_PER_SHARD), dtype=np.uint32)
+    # distinct (i, j) pairs cycling through the resident rows
+    pairs = [((p * 5 + 1) % N_ROWS, (p * 11 + 3) % N_ROWS)
+             for p in range(K_BATCH)]
+    ii = jnp.array([p[0] for p in pairs], dtype=jnp.int32)
+    jj = jnp.array([p[1] for p in pairs], dtype=jnp.int32)
 
-    @jax.jit
-    def step(d, carry):
-        d2 = d.at[0, 0, 0].set(carry)
-        return eval_count_total(d2, program).astype(jnp.uint32)
+    rows = jax.device_put(rows_np)
 
-    total = int(eval_count_total(slab, program))  # compile + warm the plain path
-    carry = jnp.uint32(0)
-    int(step(slab, carry))  # compile + warm the chained step
-    iters = 40
+    int(count_pair_stream(rows, ii, jj, jnp.uint32(0)))  # compile + warm
     t0 = time.perf_counter()
     carry = jnp.uint32(1)
-    for _ in range(iters):
-        carry = step(slab, carry)
+    for _ in range(N_DISPATCH):
+        carry = count_pair_stream(rows, ii, jj, carry)
     int(carry)  # forces the whole chain
-    tpu_s = (time.perf_counter() - t0) / iters
+    tpu_s = (time.perf_counter() - t0) / (N_DISPATCH * K_BATCH)
 
-    # --- CPU baseline: same kernel in numpy ---
-    a, b = slab_np[0], slab_np[1]
-    cpu_total = int(np.bitwise_count(a & b).sum())
-    assert cpu_total == total
+    # --- CPU baseline: same kernel in numpy, same query stream ---
+    i0, j0 = pairs[0]
     cpu_iters = 3
     t0 = time.perf_counter()
-    for _ in range(cpu_iters):
-        np.bitwise_count(a & b).sum()
+    for it in range(cpu_iters):
+        i, j = pairs[it % len(pairs)]
+        np.bitwise_count(rows_np[i] & rows_np[j]).sum()
     cpu_s = (time.perf_counter() - t0) / cpu_iters
 
-    cols = n_shards * (WORDS_PER_SHARD * 32)
+    # correctness cross-check on one pair: numpy vs the engine's executor
+    # kernel (eval_count_total, the single-query path) vs the stream kernel
+    expect = int(np.bitwise_count(rows_np[i0] & rows_np[j0]).sum())
+    got = int(eval_count_total(
+        jnp.stack([rows[i0], rows[j0]]), ("and", ("leaf", 0), ("leaf", 1))))
+    got_stream = int(count_pair_stream(
+        rows, ii[:1], jj[:1], jnp.uint32(0)))
+    expect_stream = int(np.bitwise_count(
+        rows_np[pairs[0][0]] & rows_np[pairs[0][1]]).sum())
+    assert got == expect, (got, expect)
+    assert got_stream == expect_stream, (got_stream, expect_stream)
+
+    cols = N_SHARDS * (WORDS_PER_SHARD * 32)
     qps = 1.0 / tpu_s
     result = {
         "metric": "intersect_count_qps_1Bcol",
@@ -73,6 +96,8 @@ def main() -> None:
             "tpu_ms_per_query": round(tpu_s * 1e3, 4),
             "cpu_numpy_ms_per_query": round(cpu_s * 1e3, 4),
             "columns_per_operand": cols,
+            "resident_rows": N_ROWS,
+            "queries_per_dispatch": K_BATCH,
             "tpu_gcols_per_s": round(cols / tpu_s / 1e9, 2),
             "hbm_gb_per_s": round(2 * cols / 8 / tpu_s / 1e9, 1),
             "device": str(jax.devices()[0]),

@@ -11,6 +11,21 @@ import os
 import tomllib
 from dataclasses import dataclass, field
 
+from pilosa_tpu.utils.duration import parse_duration
+
+
+@dataclass
+class TLSConfig:
+    """server/config.go:26-33 — TLS section; certificate+key enable HTTPS
+    serving, skip_verify disables peer verification on the internal client."""
+    certificate: str = ""
+    key: str = ""
+    skip_verify: bool = False
+
+    @property
+    def enabled(self) -> bool:
+        return bool(self.certificate and self.key)
+
 
 @dataclass
 class ClusterConfig:
@@ -53,6 +68,7 @@ class Config:
     max_writes_per_request: int = 5000
     log_path: str = ""
     verbose: bool = False
+    tls: TLSConfig = field(default_factory=TLSConfig)
     cluster: ClusterConfig = field(default_factory=ClusterConfig)
     anti_entropy: AntiEntropyConfig = field(default_factory=AntiEntropyConfig)
     metric: MetricConfig = field(default_factory=MetricConfig)
@@ -78,11 +94,13 @@ class Config:
     def _apply_dict(self, data: dict) -> None:
         for key, value in data.items():
             attr = key.replace("-", "_")
-            if attr in ("cluster", "anti_entropy", "metric", "diagnostics", "tracing") and isinstance(value, dict):
+            if attr in ("tls", "cluster", "anti_entropy", "metric", "diagnostics", "tracing") and isinstance(value, dict):
                 sub = getattr(self, attr)
                 for k, v in value.items():
                     sk = k.replace("-", "_")
                     if hasattr(sub, sk):
+                        if isinstance(getattr(sub, sk), float) and isinstance(v, str):
+                            v = parse_duration(v)  # toml/toml.go durations
                         setattr(sub, sk, v)
             elif hasattr(self, attr):
                 setattr(self, attr, value)
@@ -98,7 +116,7 @@ class Config:
 
     def _set_path(self, parts: list[str], raw: str) -> None:
         # try sub-config first (cluster_replicas -> cluster.replicas)
-        for sub_name in ("cluster", "anti_entropy", "metric", "diagnostics", "tracing"):
+        for sub_name in ("tls", "cluster", "anti_entropy", "metric", "diagnostics", "tracing"):
             sub_parts = sub_name.split("_")
             if parts[: len(sub_parts)] == sub_parts and len(parts) > len(sub_parts):
                 sub = getattr(self, sub_name)
@@ -116,6 +134,11 @@ class Config:
             f'bind = "{self.bind}"',
             f"max-writes-per-request = {self.max_writes_per_request}",
             f"verbose = {str(self.verbose).lower()}",
+            "",
+            "[tls]",
+            f'certificate = "{self.tls.certificate}"',
+            f'key = "{self.tls.key}"',
+            f"skip-verify = {str(self.tls.skip_verify).lower()}",
             "",
             "[cluster]",
             f"disabled = {str(self.cluster.disabled).lower()}",
@@ -148,7 +171,7 @@ def _coerce(raw: str, current):
     if isinstance(current, int):
         return int(raw)
     if isinstance(current, float):
-        return float(raw)
+        return parse_duration(raw)
     if isinstance(current, list):
         return [s for s in raw.split(",") if s]
     return raw

@@ -103,6 +103,9 @@ def cmd_server(args) -> int:
         metric_poll_interval=cfg.metric.poll_interval,
         diagnostics_url=cfg.diagnostics.url,
         diagnostics_interval=cfg.diagnostics.interval,
+        tls_certificate=cfg.tls.certificate,
+        tls_key=cfg.tls.key,
+        tls_skip_verify=cfg.tls.skip_verify,
     ).open()
     print(f"pilosa-tpu {__version__} serving at {server.uri} "
           f"(data: {data_dir}, node: {server.node_id})", flush=True)

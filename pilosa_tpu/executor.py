@@ -450,8 +450,26 @@ class Executor:
         ids_arg = call.uint_slice_arg("ids")
         threshold = call.uint_arg("threshold") or 0
         tanimoto = call.uint_arg("tanimotoThreshold") or 0
+        attr_name = call.string_arg("attrName")
+        attr_values = call.args.get("attrValues")
 
         candidates = self._topn_candidates(index, f, shards, ids_arg)
+        if attr_name:
+            # row-attribute candidate filter (topOptions.AttrName/AttrValues,
+            # fragment.go:1191-1208; applied fragment.go:1056-1076)
+            allowed = None
+            if attr_values is not None:
+                allowed = set(attr_values if isinstance(attr_values, list)
+                              else [attr_values])
+            kept = []
+            for rid in candidates:
+                val = f.row_attrs.attrs(rid).get(attr_name)
+                if val is None:
+                    continue
+                if allowed is not None and val not in allowed:
+                    continue
+                kept.append(rid)
+            candidates = kept
         if not candidates:
             return []
         pairs = self._exact_counts(index, f, shards, candidates, src_dense, tanimoto)

@@ -72,5 +72,18 @@ class Holder:
         idx.close()
         shutil.rmtree(idx.path, ignore_errors=True)
 
+    def flush_caches(self) -> int:
+        """Persist all TopN rank caches in place — the cache-flush ticker's
+        work (holder.monitorCacheFlush, holder.go:483-526). Returns caches
+        written."""
+        n = 0
+        # snapshot the tree: this runs on the flush ticker thread while HTTP
+        # threads may be creating indexes/fields/views concurrently
+        for idx in list(self.indexes.values()):
+            for f in list(idx.fields.values()):
+                for view in list(f.views.values()):
+                    n += view.flush_caches()
+        return n
+
     def schema(self) -> list[dict]:
         return [idx.schema_dict() for _, idx in sorted(self.indexes.items())]

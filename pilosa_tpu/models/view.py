@@ -57,11 +57,21 @@ class View:
                 self._open_fragment(shard)
         return self
 
-    def close(self) -> None:
-        for shard, frag in self.fragments.items():
+    def flush_caches(self) -> int:
+        """Persist rank caches without closing (fragment.FlushCache,
+        fragment.go:1796-1821, driven by holder.monitorCacheFlush). Returns
+        caches written."""
+        n = 0
+        for shard, frag in list(self.fragments.items()):
             cache = self.rank_caches.get(shard)
             if cache is not None:
                 cache.save(frag.path + ".cache")
+                n += 1
+        return n
+
+    def close(self) -> None:
+        self.flush_caches()
+        for frag in self.fragments.values():
             frag.close()
         self.fragments.clear()
         self.rank_caches.clear()

@@ -10,9 +10,12 @@ from __future__ import annotations
 
 import base64
 import json
+import ssl
 import urllib.error
 import urllib.request
 from typing import Optional
+
+from pilosa_tpu.utils import tracing
 
 
 class ClientError(Exception):
@@ -22,8 +25,13 @@ class ClientError(Exception):
 
 
 class InternalClient:
-    def __init__(self, timeout: float = 30.0):
+    def __init__(self, timeout: float = 30.0, tls_skip_verify: bool = False):
         self.timeout = timeout
+        self._ssl_ctx: Optional[ssl.SSLContext] = None
+        if tls_skip_verify:  # server/config.go:31 tls.skip-verify
+            self._ssl_ctx = ssl.create_default_context()
+            self._ssl_ctx.check_hostname = False
+            self._ssl_ctx.verify_mode = ssl.CERT_NONE
 
     # -- low-level ----------------------------------------------------------
 
@@ -34,10 +42,14 @@ class InternalClient:
         headers = {"Content-Type": content_type} if body is not None else {}
         if accept:
             headers["Accept"] = accept
+        trace_id = tracing.current_trace_id.get()
+        if trace_id:  # InjectHTTPHeaders (tracing/tracing.go:22)
+            headers[tracing.TRACE_HEADER] = trace_id
         req = urllib.request.Request(
             uri + path, data=body, method=method, headers=headers)
         try:
-            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+            with urllib.request.urlopen(
+                    req, timeout=self.timeout, context=self._ssl_ctx) as resp:
                 return resp.read()
         except urllib.error.HTTPError as e:
             detail = e.read().decode(errors="replace")

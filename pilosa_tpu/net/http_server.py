@@ -18,6 +18,7 @@ from pilosa_tpu.api import API, ApiError
 from pilosa_tpu.encoding.protobuf import CONTENT_TYPE as PROTO_CONTENT_TYPE
 from pilosa_tpu.encoding.protobuf import Serializer
 from pilosa_tpu.models.field import FieldOptions
+from pilosa_tpu.utils import tracing
 
 # (method, regex) -> handler name; ordered
 ROUTES: list[tuple[str, re.Pattern, str]] = [
@@ -74,19 +75,27 @@ class Handler:
                  headers=None):
         """-> (status, content_type, payload bytes)."""
         self._local.headers = headers
-        for m, rx, name in ROUTES:
-            if m != method:
-                continue
-            match = rx.match(path)
-            if match is None:
-                continue
-            handler = getattr(self, name)
-            try:
-                return handler(match.groupdict(), query, body)
-            except ApiError as e:
-                return self._error(e.status, str(e))
-            except Exception as e:  # noqa: BLE001 — surface as 500
-                return self._error(500, str(e))
+        # extractTracing middleware (http/handler.go:226-234): adopt the
+        # caller's trace id for every span opened while serving this request
+        incoming_trace = (headers or {}).get(tracing.TRACE_HEADER) if headers else None
+        token = tracing.current_trace_id.set(incoming_trace) if incoming_trace else None
+        try:
+            for m, rx, name in ROUTES:
+                if m != method:
+                    continue
+                match = rx.match(path)
+                if match is None:
+                    continue
+                handler = getattr(self, name)
+                try:
+                    return handler(match.groupdict(), query, body)
+                except ApiError as e:
+                    return self._error(e.status, str(e))
+                except Exception as e:  # noqa: BLE001 — surface as 500
+                    return self._error(500, str(e))
+        finally:
+            if token is not None:
+                tracing.current_trace_id.reset(token)
         if any(rx.match(path) for _, rx, _ in ROUTES):
             return 405, "application/json", b'{"error": "method not allowed"}'
         return 404, "application/json", b'{"error": "not found"}'
@@ -395,9 +404,17 @@ class HTTPServer:
     """Threaded HTTP server wrapper with lifecycle (Handler.Serve,
     http/handler.go:150)."""
 
-    def __init__(self, handler: Handler, host: str = "localhost", port: int = 0):
+    def __init__(self, handler: Handler, host: str = "localhost", port: int = 0,
+                 tls_certificate: str = "", tls_key: str = ""):
         cls = type("BoundHandler", (_RequestHandler,), {"handler": handler})
         self._srv = ThreadingHTTPServer((host, port), cls)
+        self._scheme = "http"
+        if tls_certificate and tls_key:  # getListener (server/server.go:375-393)
+            import ssl
+            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            ctx.load_cert_chain(tls_certificate, tls_key)
+            self._srv.socket = ctx.wrap_socket(self._srv.socket, server_side=True)
+            self._scheme = "https"
         self._thread: Optional[threading.Thread] = None
 
     @property
@@ -407,7 +424,7 @@ class HTTPServer:
     @property
     def uri(self) -> str:
         host = self._srv.server_address[0]
-        return f"http://{host}:{self.port}"
+        return f"{self._scheme}://{host}:{self.port}"
 
     def serve_background(self) -> None:
         self._thread = threading.Thread(target=self._srv.serve_forever, daemon=True)

@@ -82,6 +82,29 @@ def eval_count_total(leaves: jax.Array, program) -> jax.Array:
     return jnp.sum(popcount(_eval(leaves, program)))
 
 
+@jax.jit
+def count_pair_stream(rows: jax.Array, ii: jax.Array, jj: jax.Array,
+                      carry: jax.Array) -> jax.Array:
+    """Serve a stream of K Count(Intersect(Row(i), Row(j))) queries against a
+    resident row slab in ONE dispatch: rows[R, S, W], ii/jj int32[K] row
+    indices -> summed count folded into carry (uint32).
+
+    This is the batched form of the executor's hottest query — each scan step
+    is an independent query (dynamic row gather straight from HBM into the
+    fused and+popcount reduce, no intermediates), the scan amortizes dispatch
+    overhead over the batch the way the reference's goroutine fan-out
+    amortizes scheduling (executor.go:2183,2283). The carry chains dispatches
+    for benchmarking without touching the slab."""
+    def body(c, ij):
+        i, j = ij
+        a = jax.lax.dynamic_index_in_dim(rows, i, axis=0, keepdims=False)
+        b = jax.lax.dynamic_index_in_dim(rows, j, axis=0, keepdims=False)
+        cnt = jnp.sum(popcount(jnp.bitwise_and(a, b)))
+        return c + cnt.astype(jnp.uint32), None
+    tot, _ = jax.lax.scan(body, carry, (ii, jj))
+    return tot
+
+
 class DeviceRunner:
     """Executes shard-slab programs, optionally over a mesh.
 

@@ -41,6 +41,7 @@ class Server:
                  cluster_hosts: Optional[list[str]] = None,
                  replica_n: int = 1,
                  anti_entropy_interval: float = 0.0,
+                 cache_flush_interval: float = 60.0,
                  membership_interval: float = 5.0,
                  join: bool = False,
                  resize_timeout: float = 120.0,
@@ -50,7 +51,10 @@ class Server:
                  metric_host: str = "127.0.0.1:8125",
                  metric_poll_interval: float = 0.0,
                  diagnostics_url: str = "",
-                 diagnostics_interval: float = 0.0):
+                 diagnostics_interval: float = 0.0,
+                 tls_certificate: str = "",
+                 tls_key: str = "",
+                 tls_skip_verify: bool = False):
         self.data_dir = data_dir
         self.holder = Holder(data_dir)
         self.node_id = node_id or self._load_or_create_id()
@@ -60,7 +64,7 @@ class Server:
             topology_path=os.path.join(data_dir, ".topology"))
         self.translate = TranslateStore(os.path.join(data_dir, ".keys"))
         self.runner = DeviceRunner(mesh)
-        self.client = InternalClient()
+        self.client = InternalClient(tls_skip_verify=tls_skip_verify)
         from pilosa_tpu.utils.logger import Logger
         from pilosa_tpu.utils.stats import new_stats_client
         from pilosa_tpu.utils.tracing import Tracer
@@ -89,10 +93,13 @@ class Server:
                        translate_store=self.cluster_translate)
         self.handler = Handler(self.api, cluster_message_fn=self.receive_message,
                                stats=self.stats)
-        self.http = HTTPServer(self.handler, host=host, port=port)
+        self.http = HTTPServer(self.handler, host=host, port=port,
+                               tls_certificate=tls_certificate, tls_key=tls_key)
         self.cluster_hosts = cluster_hosts or []
         self.long_query_time = long_query_time
         self.anti_entropy_interval = anti_entropy_interval
+        self.cache_flush_interval = cache_flush_interval
+        self._cache_flush_timer: Optional[threading.Timer] = None
         self.membership_interval = membership_interval
         # join=True: this node is being added to an existing cluster —
         # cluster_hosts are seed URIs (the gossip-seeds analog). It announces
@@ -180,6 +187,8 @@ class Server:
         self.api.logger = self.logger
         if self.anti_entropy_interval > 0:
             self._schedule_anti_entropy()
+        if self.cache_flush_interval > 0:
+            self._schedule_cache_flush()
         self.runtime_monitor.start()
         self.diagnostics.start()
         return self
@@ -252,6 +261,8 @@ class Server:
         self.closed = True
         if self._ae_timer is not None:
             self._ae_timer.cancel()
+        if self._cache_flush_timer is not None:
+            self._cache_flush_timer.cancel()
         if self._member_timer is not None:
             self._member_timer.cancel()
         if self._resize_watchdog is not None:
@@ -744,6 +755,24 @@ class Server:
             self.sync_holder()
         finally:
             self._schedule_anti_entropy()
+
+    def _schedule_cache_flush(self) -> None:
+        if self.closed:
+            return
+        self._cache_flush_timer = threading.Timer(self.cache_flush_interval,
+                                                  self._cache_flush_tick)
+        self._cache_flush_timer.daemon = True
+        self._cache_flush_timer.start()
+
+    def _cache_flush_tick(self) -> None:
+        """Periodic rank-cache persistence (holder.monitorCacheFlush,
+        holder.go:483-526)."""
+        try:
+            self.holder.flush_caches()
+        except Exception as e:  # noqa: BLE001 — a failed flush must not kill the ticker
+            self.logger.printf("cache flush: %s", e)
+        finally:
+            self._schedule_cache_flush()
 
     def sync_holder(self) -> int:
         """One full anti-entropy pass: index column attrs, field row attrs,

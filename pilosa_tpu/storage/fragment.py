@@ -78,6 +78,10 @@ class Fragment:
                 data = f.read()
         if data:
             self.storage = Bitmap.from_bytes(data)
+            # op-log replay can leave stale encodings (array grown past
+            # ARRAY_MAX_SIZE etc.) — normalize like Containers.Repair
+            # (roaring/roaring.go:106, 2093-2113)
+            self.storage.repair()
             self.op_n = self.storage.op_n
         else:
             # Seed an empty snapshot header so the WAL has something to
@@ -261,7 +265,9 @@ class Fragment:
         if clear:
             self.storage = self.storage.difference(other)
         else:
-            self.storage = self.storage.union(other)
+            # k-way in-place merge — the import hot path (fragment.go:1670
+            # unions the incoming bitmap straight into storage)
+            self.storage.union_in_place(other)
         self.storage.op_writer = self._op_file
         self.generation += 1
         self._row_gen.clear()  # all rows considered dirty

@@ -481,6 +481,57 @@ class Bitmap:
             total += self.containers[key].op_count(other.containers[key], "and")
         return total
 
+    def union_in_place(self, *others: "Bitmap") -> None:
+        """K-way bulk union into self (UnionInPlace, roaring/roaring.go:417-690).
+
+        The reference walks all operands' container iterators key-by-key and
+        picks a merge strategy from summary stats; here each key's operand
+        containers are merged in one pass — word-wise OR when any operand is
+        bitmap-encoded, sorted-value union otherwise — without materializing
+        intermediate per-pair results (the import hot path)."""
+        keys: set[int] = set()
+        for o in others:
+            keys.update(k for k, c in o.containers.items() if c.n)
+        for key in keys:
+            ops = [o.containers[key] for o in others
+                   if key in o.containers and o.containers[key].n]
+            mine = self.containers.get(key)
+            if mine is not None and mine.n:
+                ops.append(mine)
+            if not ops:
+                continue
+            if len(ops) == 1:
+                c = ops[0]
+                self._store(key, Container(c.kind, c.data.copy()))
+                continue
+            if any(c.kind == "bitmap" for c in ops) or \
+                    sum(c.n for c in ops) > ARRAY_MAX_SIZE:
+                words = ops[0].words().copy()
+                for c in ops[1:]:
+                    np.bitwise_or(words, c.words(), out=words)
+                self._store(key, Container("bitmap", words)._normalize())
+            else:
+                vals = np.unique(np.concatenate([c.values() for c in ops]))
+                self._store(key, Container.from_values(vals))
+
+    def repair(self) -> int:
+        """Drop empty containers and re-pick stale encodings (Container.Repair
+        + Containers.Repair, roaring/roaring.go:2093-2113,106; cardinality is
+        derived here, so popcount drift cannot occur). Returns containers
+        changed."""
+        changed = 0
+        for key in list(self.containers):
+            c = self.containers[key]
+            if c.n == 0:
+                del self.containers[key]
+                changed += 1
+                continue
+            fixed = c._normalize()
+            if fixed is not c:
+                self.containers[key] = fixed
+                changed += 1
+        return changed
+
     # -- serialization ------------------------------------------------------
 
     def write_to(self, w) -> int:

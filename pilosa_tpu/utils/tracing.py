@@ -9,12 +9,20 @@ InjectHTTPHeaders/extractTracing (tracing/tracing.go:22-26).
 
 from __future__ import annotations
 
+import contextvars
 import threading
 import time
 import uuid
 from typing import Optional
 
 TRACE_HEADER = "X-Pilosa-Trace-Id"
+
+# trace id of the request being served, for cross-node propagation: the HTTP
+# handler sets it from the incoming header, the InternalClient injects it
+# into outgoing internal requests (InjectHTTPHeaders / extractTracing,
+# tracing/tracing.go:22-26, http/handler.go:226-234)
+current_trace_id: contextvars.ContextVar[Optional[str]] = contextvars.ContextVar(
+    "pilosa_trace_id", default=None)
 
 
 class Span:
@@ -54,7 +62,8 @@ class Tracer:
         self.spans: list[Span] = []
 
     def start_span(self, name: str, trace_id: Optional[str] = None) -> Span:
-        return Span(self, name, trace_id or uuid.uuid4().hex[:16])
+        return Span(self, name,
+                    trace_id or current_trace_id.get() or uuid.uuid4().hex[:16])
 
     def _record(self, span: Span) -> None:
         with self._lock:

@@ -101,3 +101,19 @@ def test_range_ops(start, end):
     assert set(ops.columns_from_dense(np.asarray(set_range(base, mask))).tolist()) == sbase | expect
     assert set(ops.columns_from_dense(np.asarray(zero_range(base, mask))).tolist()) == sbase - expect
     assert set(ops.columns_from_dense(np.asarray(xor_range(base, mask))).tolist()) == sbase ^ expect
+
+
+def test_count_pair_stream_matches_numpy():
+    """The batched query-stream kernel (one dispatch, K queries) agrees with
+    per-query numpy counts and chains its carry."""
+    import jax.numpy as jnp
+    from pilosa_tpu.parallel.mesh import count_pair_stream
+
+    rng = np.random.default_rng(11)
+    rows = rng.integers(0, 2**32, size=(4, 3, WORDS_PER_SHARD), dtype=np.uint32)
+    ii = jnp.array([0, 1, 3], dtype=jnp.int32)
+    jj = jnp.array([2, 3, 3], dtype=jnp.int32)
+    expect = sum(int(np.bitwise_count(rows[i] & rows[j]).sum())
+                 for i, j in [(0, 2), (1, 3), (3, 3)])
+    got = int(count_pair_stream(jnp.asarray(rows), ii, jj, jnp.uint32(5)))
+    assert got == expect + 5

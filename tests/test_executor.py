@@ -251,3 +251,20 @@ def test_bool_field_query(ex):
     assert cols(r) == [1, 3]
     (r,) = ex.execute("i", "Row(b=false)")
     assert cols(r) == [2]
+
+
+def test_topn_attr_filter(ex):
+    """TopN(f, attrName=, attrValues=) keeps only candidate rows whose row
+    attrs match (topOptions.AttrName/AttrValues, fragment.go:1056-1076)."""
+    idx = ex.holder.create_index("i")
+    f = idx.create_field("f")
+    f.import_bits([1] * 3, [1, 2, 3])
+    f.import_bits([2] * 2, [1, 2])
+    f.import_bits([3] * 1, [1])
+    ex.execute("i", 'SetRowAttrs(f, 1, category="x")')
+    ex.execute("i", 'SetRowAttrs(f, 2, category="y")')
+    # row 3 has no attrs -> always excluded when attrName given
+    top = ex.execute("i", 'TopN(f, n=10, attrName="category", attrValues=["x"])')[0]
+    assert list(top) == [(1, 3)]
+    top = ex.execute("i", 'TopN(f, n=10, attrName="category")')[0]
+    assert list(top) == [(1, 3), (2, 2)]

@@ -168,3 +168,33 @@ def test_check():
     b.containers[0] = Container("array", np.array([5, 4], dtype=np.uint16))
     with pytest.raises(ValueError):
         b.check()
+
+
+def test_union_in_place_kway():
+    rng = np.random.default_rng(3)
+    parts = [np.unique(rng.integers(0, 1 << 22, size=n).astype(np.uint64))
+             for n in (5000, 300, 9000, 1)]
+    dst = Bitmap(parts[0])
+    dst.union_in_place(*(Bitmap(p) for p in parts[1:]))
+    expect = np.unique(np.concatenate(parts))
+    assert dst.count() == expect.size
+    assert np.array_equal(dst.slice(), expect)
+    # k=0 is a no-op
+    before = dst.count()
+    dst.union_in_place()
+    assert dst.count() == before
+
+
+def test_repair():
+    b = Bitmap(np.arange(5000, dtype=np.uint64))
+    # simulate external mutation leaving a stale encoding + an empty container
+    big = b.containers[0]
+    assert big.kind == "bitmap"
+    big.data[:] = 0
+    big.data[0] = 3  # now only 2 bits: should re-encode to array
+    b.containers[7] = Container("array", np.empty(0, dtype=np.uint16))
+    changed = b.repair()
+    assert changed == 2
+    assert b.containers[0].kind == "array"
+    assert 7 not in b.containers
+    b.check()

@@ -590,3 +590,61 @@ def test_attr_diff_endpoint(server):
               for b, c in server.holder.index("i").column_attrs.blocks()]
     _, out = jpost(u, "/internal/index/i/attr/diff", {"blocks": blocks})
     assert out["attrs"] == {}
+
+
+def test_tls_server_roundtrip(tmp_path):
+    """HTTPS serving (getListener, server/server.go:375-393) + skip-verify
+    internal client (server/config.go:31)."""
+    import ssl
+    import subprocess
+
+    crt, key = str(tmp_path / "crt.pem"), str(tmp_path / "key.pem")
+    subprocess.run(
+        ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+         "-subj", "/CN=localhost", "-keyout", key, "-out", crt, "-days", "1"],
+        check=True, capture_output=True)
+    s = Server(str(tmp_path / "node"), port=0,
+               tls_certificate=crt, tls_key=key, tls_skip_verify=True).open()
+    try:
+        assert s.uri.startswith("https://")
+        ctx = ssl.create_default_context()
+        ctx.check_hostname = False
+        ctx.verify_mode = ssl.CERT_NONE
+        req = urllib.request.Request(s.uri + "/version")
+        with urllib.request.urlopen(req, timeout=10, context=ctx) as resp:
+            assert json.loads(resp.read())["version"]
+        # the internal client with skip_verify reaches it too
+        assert s.client.status(s.uri)["state"]
+    finally:
+        s.close()
+
+
+def test_cache_flush(tmp_path):
+    """holder.flush_caches persists rank caches in place
+    (holder.monitorCacheFlush, holder.go:483-526)."""
+    import os
+    s = Server(str(tmp_path / "node"), port=0).open()
+    try:
+        jpost(s.uri, "/index/i", {})
+        jpost(s.uri, "/index/i/field/f", {})
+        jpost(s.uri, "/index/i/query", raw=b"Set(5, f=1)")
+        assert s.holder.flush_caches() >= 1
+        frag = s.holder.index("i").field("f").view().fragment(0)
+        assert os.path.exists(frag.path + ".cache")
+    finally:
+        s.close()
+
+
+def test_trace_header_propagates_into_spans(server):
+    """X-Pilosa-Trace-Id on an incoming query is adopted by executor spans
+    (extractTracing, http/handler.go:226-234)."""
+    from pilosa_tpu.utils.tracing import TRACE_HEADER
+    jpost(server.uri, "/index/i", {})
+    jpost(server.uri, "/index/i/field/f", {})
+    req = urllib.request.Request(server.uri + "/index/i/query",
+                                 data=b"Count(Row(f=1))", method="POST",
+                                 headers={TRACE_HEADER: "cafef00d"})
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        assert resp.status == 200
+    spans = server.tracer.finished()
+    assert any(sp.trace_id == "cafef00d" for sp in spans)

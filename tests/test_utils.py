@@ -209,3 +209,63 @@ def test_long_query_logging(tmp_path):
         assert "SLOW QUERY i Count(Row(f=1))" in buf.getvalue()
     finally:
         s.close()
+
+
+def test_duration_strings():
+    from pilosa_tpu.utils.duration import parse_duration
+    assert parse_duration(5) == 5.0
+    assert parse_duration("2.5") == 2.5
+    assert parse_duration("250ms") == 0.25
+    assert parse_duration("10s") == 10.0
+    assert parse_duration("1h30m") == 5400.0
+    assert parse_duration("") == 0.0
+    with pytest.raises(ValueError):
+        parse_duration("10 parsecs")
+    with pytest.raises(ValueError):
+        parse_duration("s10")
+
+
+def test_uri_parse():
+    from pilosa_tpu.net.uri import URI, URIError
+    assert URI.parse("").normalize() == "http://localhost:10101"
+    assert URI.parse("example.com").normalize() == "http://example.com:10101"
+    assert URI.parse(":8080") == URI("http", "localhost", 8080)
+    assert URI.parse("https://db1:444").normalize() == "https://db1:444"
+    assert URI.parse("10.0.0.1:10101").host_port == "10.0.0.1:10101"
+    with pytest.raises(URIError):
+        URI.parse("ftp://x:1")
+    with pytest.raises(URIError):
+        URI.parse("http://host:99999")
+
+
+def test_trace_id_propagation_context():
+    """Incoming trace ids flow into spans opened while serving
+    (extractTracing middleware + GlobalTracer), and onto outgoing internal
+    requests (InjectHTTPHeaders)."""
+    from pilosa_tpu.utils import tracing
+
+    t = Tracer()
+    token = tracing.current_trace_id.set("deadbeef")
+    try:
+        with t.start_span("executor.Execute") as span:
+            assert span.trace_id == "deadbeef"
+    finally:
+        tracing.current_trace_id.reset(token)
+    # outside the request context ids are fresh
+    assert t.start_span("x").trace_id != "deadbeef"
+
+
+def test_config_durations_and_tls(tmp_path):
+    from pilosa_tpu.cli.config import load_config
+    p = tmp_path / "c.toml"
+    p.write_text(
+        '[anti-entropy]\ninterval = "10m"\n'
+        '[tls]\ncertificate = "crt.pem"\nkey = "key.pem"\nskip-verify = true\n')
+    cfg = load_config(str(p))
+    assert cfg.anti_entropy.interval == 600.0
+    assert cfg.tls.enabled and cfg.tls.skip_verify
+    cfg2 = load_config(None, environ={"PILOSA_TPU_ANTI_ENTROPY_INTERVAL": "90s",
+                                      "PILOSA_TPU_TLS_CERTIFICATE": "x"})
+    assert cfg2.anti_entropy.interval == 90.0
+    assert cfg2.tls.certificate == "x" and not cfg2.tls.enabled
+    assert "[tls]" in cfg2.to_toml()
