@@ -198,6 +198,7 @@ class API:
             self.holder.delete_index(name)
         except KeyError as e:
             raise NotFoundError(str(e))
+        self.executor.clear_caches()
         self._broadcast({"type": "delete-index", "index": name})
 
     def create_field(self, index_name: str, field_name: str,
@@ -227,6 +228,7 @@ class API:
             index.delete_field(field_name)
         except KeyError as e:
             raise NotFoundError(str(e))
+        self.executor.clear_caches()
         self._broadcast({"type": "delete-field", "index": index_name,
                          "field": field_name})
 

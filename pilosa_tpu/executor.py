@@ -97,10 +97,23 @@ class Executor:
         from pilosa_tpu.utils import tracing
         self.stats = NopStatsClient()
         self.tracer = tracing.global_tracer
-        # device slab cache: (index, field, view, shard, row, generation) ->
-        # host dense row; slabs assembled per query then device_put (the
-        # HBM residency layer; see DeviceRunner.put_slab)
+        # host row cache: (index, field, view, shard, row, generation) ->
+        # dense numpy row (the reference's fragment rowCache analog,
+        # fragment.go:112)
         self._row_cache: dict[tuple, np.ndarray] = {}
+        # HBM residency manager: query leaves cached as device arrays keyed
+        # by content generation; repeat queries run without host->HBM
+        # transfers (parallel/residency.py)
+        from pilosa_tpu.parallel.residency import DeviceResidency
+        self.residency = DeviceResidency(self.runner)
+
+    def clear_caches(self) -> None:
+        """Drop the host row cache and all HBM-resident leaves. Called on
+        index/field deletion: a recreated schema object restarts its
+        generation counters, so version-keyed entries from the deleted one
+        could otherwise collide and serve the old data."""
+        self._row_cache.clear()
+        self.residency.clear()
 
     # ------------------------------------------------------------------ API
 
@@ -165,19 +178,98 @@ class Executor:
 
     # ----------------------------------------------------- bitmap programs
 
-    def _compile(self, index: Index, call: Call, shards: list[int]):
-        """Walk the call tree -> (program, leaves[L, S, W] numpy slab)."""
-        leaves: list[np.ndarray] = []
+    def _leaf_gens(self, index: Index, field_name: str, view_name: str,
+                   shards, row_id: int) -> tuple:
+        """Per-shard content generations of one row — the version component
+        of a residency key (a write bumps the generation, changing the key)."""
+        f = index.field(field_name)
+        view = f.view(view_name) if f else None
+        if view is None:
+            return ()
+        out = []
+        for s in shards:
+            frag = view.fragment(s)
+            out.append(0 if frag is None else frag.row_generation(row_id))
+        return tuple(out)
 
-        def leaf(slab_rows: np.ndarray):
-            leaves.append(slab_rows)
+    def _compile(self, index: Index, call: Call, shards: list[int]):
+        """Walk the call tree -> (program, leaves) where leaves are
+        HBM-resident device arrays [S, W] from the residency manager."""
+        leaves: list = []
+        shards_t = tuple(shards)
+
+        def leaf(key: tuple, make):
+            leaves.append(self.residency.leaf(key, make))
             return ("leaf", len(leaves) - 1)
+
+        def row_leaf(c: Call):
+            field_name = c.field_arg()
+            row_val = c.args[field_name]
+            f = index.field(field_name)
+            if f is None:
+                raise ExecutionError(f"field not found: {field_name}")
+            row_id = self._translate_row(index, f, row_val, create=False)
+            if row_id is None:  # unknown key: empty row, no id minting
+                return leaf(("zeros", len(shards)),
+                            lambda: np.zeros((len(shards), WORDS), dtype=np.uint32))
+            if f.options.type == FieldType.BOOL and isinstance(row_val, bool):
+                row_id = 1 if row_val else 0
+            gens = self._leaf_gens(index, field_name, VIEW_STANDARD, shards, row_id)
+            key = ("row", index.name, field_name, VIEW_STANDARD, row_id,
+                   shards_t, gens)
+            return leaf(key, lambda: np.stack([
+                self._cached_row(index, field_name, VIEW_STANDARD, s, row_id)
+                for s in shards]))
+
+        def range_leaf(c: Call):
+            if "_start" in c.args or "_end" in c.args:
+                field_name = c.field_arg()
+                f = index.field(field_name)
+                if f is None:
+                    raise ExecutionError(f"field not found: {field_name}")
+                row_id = self._translate_row(index, f, c.args[field_name])
+                start, end = c.args.get("_start"), c.args.get("_end")
+                if not isinstance(start, datetime) or not isinstance(end, datetime):
+                    raise ExecutionError("Range() requires start and end timestamps")
+                views = tuple(timequantum.views_by_time_range(
+                    VIEW_STANDARD, start, end, f.options.time_quantum))
+                gens = tuple(self._leaf_gens(index, field_name, v, shards, row_id)
+                             for v in views)
+                key = ("timerange", index.name, field_name, row_id, views,
+                       shards_t, gens)
+                return leaf(key, lambda: self._materialize_range_call(index, c, shards))
+            # BSI condition: the comparison result row is itself a leaf
+            cond_field, cond = None, None
+            for k, v in c.args.items():
+                if isinstance(v, Condition):
+                    cond_field, cond = k, v
+            if cond is None:
+                raise ExecutionError("Range() requires a condition or time bounds")
+            f = self._bsi_field(index, cond_field)
+            depth = f.bit_depth
+            gens = tuple(self._leaf_gens(index, cond_field, f.bsi_view_name,
+                                         shards, r) for r in range(depth + 1))
+            val = cond.value if not isinstance(cond.value, list) else tuple(cond.value)
+            key = ("bsicmp", index.name, cond_field, cond.op, val, depth,
+                   shards_t, gens)
+            return leaf(key, lambda: self._bsi_compare(index, cond_field, cond, shards))
+
+        def existence_leaf():
+            from pilosa_tpu.constants import EXISTENCE_FIELD_NAME
+            if index.existence_field() is None:
+                raise ExecutionError(
+                    f"index {index.name} does not support existence tracking")
+            gens = self._leaf_gens(index, EXISTENCE_FIELD_NAME, VIEW_STANDARD,
+                                   shards, 0)
+            key = ("row", index.name, EXISTENCE_FIELD_NAME, VIEW_STANDARD, 0,
+                   shards_t, gens)
+            return leaf(key, lambda: self._materialize_existence(index, shards))
 
         def walk(c: Call):
             if c.name == "Row":
-                return leaf(self._materialize_row_call(index, c, shards))
+                return row_leaf(c)
             if c.name == "Range":
-                return leaf(self._materialize_range_call(index, c, shards))
+                return range_leaf(c)
             if c.name == "Union":
                 return ("or", *[walk(ch) for ch in c.children])
             if c.name == "Intersect":
@@ -192,21 +284,21 @@ class Executor:
                 if len(c.children) != 1:
                     raise ExecutionError("Not() takes exactly one argument")
                 # Not = existence &~ child (executor.go:1478-1520)
-                ex = leaf(self._materialize_existence(index, shards))
+                ex = existence_leaf()
                 return ("andnot", ex, walk(c.children[0]))
             raise ExecutionError(f"expected bitmap call, got {c.name}")
 
         program = walk(call)
-        if leaves:
-            slab = np.stack(leaves, axis=0)
-        else:
-            slab = np.zeros((1, len(shards), WORDS), dtype=np.uint32)
-        return program, slab
+        if not leaves:
+            leaves.append(self.residency.leaf(
+                ("zeros", len(shards)),
+                lambda: np.zeros((len(shards), WORDS), dtype=np.uint32)))
+        return program, leaves
 
     def _execute_bitmap_call(self, index: Index, call: Call, shards) -> Row:
         shards = self._query_shards(index, shards)
-        program, slab = self._compile(index, call, shards)
-        dense = self.runner.row(slab, program)
+        program, leaves = self._compile(index, call, shards)
+        dense = self.runner.row_leaves(leaves, program, len(shards))
         out = Row()
         for i, shard in enumerate(shards):
             cols = columns_from_dense(dense[i])
@@ -230,8 +322,8 @@ class Executor:
         if len(call.children) != 1:
             raise ExecutionError("Count() takes exactly one argument")
         shards = self._query_shards(index, shards)
-        program, slab = self._compile(index, call.children[0], shards)
-        return self.runner.count_total(slab, program)
+        program, leaves = self._compile(index, call.children[0], shards)
+        return self.runner.count_total_leaves(leaves, program)
 
     # ------------------------------------------------- leaf materialization
 
@@ -378,8 +470,8 @@ class Executor:
         """Optional filter child for Sum/Min/Max."""
         if not call.children:
             return None
-        program, slab = self._compile(index, call.children[0], shards)
-        return self.runner.row(slab, program)
+        program, leaves = self._compile(index, call.children[0], shards)
+        return self.runner.row_leaves(leaves, program, len(shards))
 
     def _execute_sum(self, index: Index, call: Call, shards) -> ValCount:
         field_name = call.args.get("field")
@@ -444,8 +536,8 @@ class Executor:
 
         src_dense = None
         if call.children:
-            program, slab = self._compile(index, call.children[0], shards)
-            src_dense = self.runner.row(slab, program)
+            program, leaves = self._compile(index, call.children[0], shards)
+            src_dense = self.runner.row_leaves(leaves, program, len(shards))
 
         ids_arg = call.uint_slice_arg("ids")
         threshold = call.uint_arg("threshold") or 0
@@ -573,8 +665,8 @@ class Executor:
         filter_dense = None
         filter_call = filt_calls[0] if filt_calls else None
         if filter_call is not None:
-            program, slab = self._compile(index, filter_call, shards)
-            filter_dense = self.runner.row(slab, program)
+            program, leaves = self._compile(index, filter_call, shards)
+            filter_dense = self.runner.row_leaves(leaves, program, len(shards))
 
         # per Rows call: list of (field, row_id, dense[S, W])
         axes = []

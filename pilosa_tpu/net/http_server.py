@@ -42,6 +42,7 @@ ROUTES: list[tuple[str, re.Pattern, str]] = [
     ("GET", re.compile(r"^/status$"), "get_status"),
     ("GET", re.compile(r"^/version$"), "get_version"),
     ("GET", re.compile(r"^/debug/vars$"), "get_debug_vars"),
+    ("GET", re.compile(r"^/debug/pprof/?(?P<profile>[^/]*)$"), "get_debug_pprof"),
     # internal
     ("POST", re.compile(r"^/internal/cluster/message$"), "post_cluster_message"),
     ("GET", re.compile(r"^/internal/fragment/block/data$"), "get_fragment_block_data"),
@@ -268,6 +269,47 @@ class Handler:
     def get_debug_vars(self, params, query, body):
         snap = self.stats.snapshot() if self.stats is not None else {}
         return self._json(snap)
+
+    def get_debug_pprof(self, params, query, body):
+        """Runtime profiling surface (/debug/pprof, http/handler.go:242).
+
+        Go exposes pprof profiles; the analogs here: `goroutine` → live
+        thread stacks (sys._current_frames), `profile` → cProfile stats
+        sampled for ?seconds= (default 2), index → the profile list."""
+        import sys
+        import traceback
+        profile = params.get("profile") or ""
+        if profile in ("", "index"):
+            return self._json({"profiles": ["goroutine", "profile"]})
+        if profile == "goroutine":
+            frames = sys._current_frames()
+            stacks = {
+                str(tid): traceback.format_stack(frame)
+                for tid, frame in frames.items()
+            }
+            return self._json({"threads": len(stacks), "stacks": stacks})
+        if profile == "profile":
+            # sampling profiler: poll all threads' frames for ?seconds=,
+            # report hottest (file:line function) sites by sample count
+            import time as _time
+            from collections import Counter
+            seconds = min(float(self._arg(query, "seconds", 2)), 30.0)
+            hits: Counter = Counter()
+            me = __import__("threading").get_ident()
+            deadline = _time.monotonic() + seconds
+            samples = 0
+            while _time.monotonic() < deadline:
+                for tid, frame in sys._current_frames().items():
+                    if tid == me:
+                        continue
+                    code = frame.f_code
+                    hits[f"{code.co_filename}:{frame.f_lineno} {code.co_name}"] += 1
+                samples += 1
+                _time.sleep(0.005)
+            top = [{"site": site, "samples": n}
+                   for site, n in hits.most_common(50)]
+            return self._json({"samples": samples, "top": top})
+        return self._error(404, f"unknown profile: {profile}")
 
     def post_recalculate_caches(self, params, query, body):
         self.api.recalculate_caches()

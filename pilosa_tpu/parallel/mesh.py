@@ -137,6 +137,19 @@ class DeviceRunner:
         sharding = NamedSharding(self.mesh, P(None, SHARD_AXIS, None))
         return jax.device_put(slab, sharding)
 
+    def put_leaf(self, rows: np.ndarray) -> jax.Array:
+        """Place one leaf [S, W] on device(s), sharded over the shard axis —
+        the unit cached by the HBM residency manager (parallel/residency.py)."""
+        s = rows.shape[0]
+        pad = (-s) % self.n_devices
+        if pad:
+            rows = np.pad(rows, ((0, pad), (0, 0)))
+        rows = np.ascontiguousarray(rows)
+        if self.mesh is None:
+            return jax.device_put(rows)
+        return jax.device_put(
+            rows, NamedSharding(self.mesh, P(SHARD_AXIS, None)))
+
     def row(self, slab, program) -> np.ndarray:
         """Dense [S, W] result (S = real shard count)."""
         s = slab.shape[1] if isinstance(slab, np.ndarray) else None
@@ -154,3 +167,18 @@ class DeviceRunner:
     def count_total(self, slab, program) -> int:
         dev = self.put_slab(slab) if isinstance(slab, np.ndarray) else slab
         return int(eval_count_total(dev, program))
+
+    # -- leaf-list evaluation (HBM-resident leaves, no per-query restack) ---
+    # `leaves` is a Python list of [S, W] device arrays (a jit pytree arg):
+    # cached leaves stay in HBM and only the compiled program runs per query.
+
+    def row_leaves(self, leaves: list, program, n_shards: int) -> np.ndarray:
+        out = np.asarray(eval_row(tuple(leaves), program))
+        return out[:n_shards]
+
+    def count_total_leaves(self, leaves: list, program) -> int:
+        # pad shards are all-zero so they contribute nothing to the count —
+        # EXCEPT under "not", which complements pad shards to all-ones; the
+        # executor always masks Not() through the existence row (itself a
+        # leaf with zero pad shards), keeping pad contributions at zero.
+        return int(eval_count_total(tuple(leaves), program))

@@ -1,0 +1,79 @@
+"""HBM residency manager: device-side LRU cache of query leaves.
+
+The reference keeps a per-fragment `rowCache` of materialized rows
+(fragment.go:112,347-378) because row materialization is its hot allocation.
+Here the expensive step is the host->HBM transfer of dense row slabs, so the
+cache holds *device arrays*: each bitmap-call leaf (a row, a time-range
+union, a BSI comparison result) stays resident in HBM keyed by its content
+version, and repeat queries run entirely from HBM. Authoritative storage
+stays host-side (SURVEY.md §7 "Mutation on device"): writes bump fragment
+row generations, which change the leaf key — the device copy is a cache
+with natural invalidation, never a source of truth.
+
+Eviction is LRU by byte budget, the analog of the reference's bounded row
+cache (lru/ + fragment.go rowCache); freed jax.Arrays release their HBM when
+the last reference drops.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Callable, Optional
+
+import jax
+import numpy as np
+
+DEFAULT_BUDGET_BYTES = 4 << 30  # half a v5e chip's HBM
+
+
+class DeviceResidency:
+    def __init__(self, runner, budget_bytes: int = DEFAULT_BUDGET_BYTES):
+        self.runner = runner
+        self.budget = budget_bytes
+        self._lru: "OrderedDict[tuple, jax.Array]" = OrderedDict()
+        self._lock = threading.Lock()
+        self.bytes = 0
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+
+    def leaf(self, key: tuple, make: Callable[[], np.ndarray]) -> jax.Array:
+        """Return the device array for `key`, uploading via `make()` on miss.
+
+        `key` must encode content versions (fragment row generations), so a
+        write to any underlying row produces a new key and the stale entry
+        ages out by LRU."""
+        with self._lock:
+            arr = self._lru.get(key)
+            if arr is not None:
+                self._lru.move_to_end(key)
+                self.hits += 1
+                return arr
+        host = make()
+        arr = self.runner.put_leaf(host)
+        with self._lock:
+            self.misses += 1
+            # concurrent HTTP threads can race the same miss: account for
+            # the entry this insert displaces or bytes drift upward forever
+            displaced = self._lru.pop(key, None)
+            if displaced is not None:
+                self.bytes -= displaced.nbytes
+            self._lru[key] = arr
+            self.bytes += arr.nbytes
+            while self.bytes > self.budget and len(self._lru) > 1:
+                _, old = self._lru.popitem(last=False)
+                self.bytes -= old.nbytes
+                self.evictions += 1
+        return arr
+
+    def clear(self) -> None:
+        with self._lock:
+            self._lru.clear()
+            self.bytes = 0
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {"entries": len(self._lru), "bytes": self.bytes,
+                    "hits": self.hits, "misses": self.misses,
+                    "evictions": self.evictions}

@@ -288,6 +288,7 @@ class Server:
         elif mtype == "delete-index":
             if self.holder.index(msg["index"]) is not None:
                 self.holder.delete_index(msg["index"])
+                self.executor.clear_caches()
         elif mtype == "create-field":
             idx = self.holder.index(msg["index"])
             if idx is not None and idx.field(msg["field"]) is None:
@@ -296,6 +297,7 @@ class Server:
             idx = self.holder.index(msg["index"])
             if idx is not None and idx.field(msg["field"]) is not None:
                 idx.delete_field(msg["field"])
+                self.executor.clear_caches()
         elif mtype == "create-shard":
             idx = self.holder.index(msg["index"])
             f = idx.field(msg["field"]) if idx else None

@@ -64,6 +64,11 @@ class Fragment:
         # of the reference's rowCache invalidation (fragment.go:435).
         self.generation = 0
         self._row_gen: dict[int, int] = {}
+        # Floor for per-row generations: bulk mutations (roaring import,
+        # resize tar restore) dirty every row at once; resetting per-row
+        # generations to 0 would collide with the untouched-row key and
+        # serve stale device-cache leaves, so they raise this floor instead.
+        self._bulk_gen = 0
         # Cached block checksums, invalidated per-block on writes
         # (fragment.go:1226-1305).
         self._block_checksums: dict[int, bytes] = {}
@@ -110,7 +115,7 @@ class Fragment:
         self._block_checksums.pop(row_id // HASH_BLOCK_SIZE, None)
 
     def row_generation(self, row_id: int) -> int:
-        return self._row_gen.get(row_id, 0)
+        return max(self._row_gen.get(row_id, 0), self._bulk_gen)
 
     def set_bit(self, row_id: int, column: int) -> bool:
         """Set one bit; appends to the WAL and snapshots at MAX_OP_N
@@ -271,6 +276,7 @@ class Fragment:
         self.storage.op_writer = self._op_file
         self.generation += 1
         self._row_gen.clear()  # all rows considered dirty
+        self._bulk_gen = self.generation
         self._block_checksums.clear()
         self.snapshot()
 
@@ -361,6 +367,7 @@ class Fragment:
         self.storage.op_writer = self._op_file
         self.generation += 1
         self._row_gen.clear()
+        self._bulk_gen = self.generation
         self._block_checksums.clear()
         self.snapshot()
 

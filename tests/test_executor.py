@@ -268,3 +268,81 @@ def test_topn_attr_filter(ex):
     assert list(top) == [(1, 3)]
     top = ex.execute("i", 'TopN(f, n=10, attrName="category")')[0]
     assert list(top) == [(1, 3), (2, 2)]
+
+
+def test_residency_cache_hits_and_invalidation(ex):
+    """Repeat queries hit HBM-resident leaves; a write bumps the fragment row
+    generation and forces re-upload (the rowCache invalidation analog,
+    fragment.go:435-440)."""
+    idx = ex.holder.create_index("i")
+    f = idx.create_field("f")
+    f.import_bits([1] * 3, [1, 2, 3])
+    assert ex.execute("i", "Count(Row(f=1))")[0] == 3
+    m0 = ex.residency.snapshot()
+    assert m0["misses"] >= 1 and m0["entries"] >= 1
+    assert ex.execute("i", "Count(Row(f=1))")[0] == 3
+    m1 = ex.residency.snapshot()
+    assert m1["hits"] > m0["hits"]
+    assert m1["misses"] == m0["misses"]
+    # write -> new generation -> miss, correct new count
+    ex.execute("i", "Set(9, f=1)")
+    assert ex.execute("i", "Count(Row(f=1))")[0] == 4
+    m2 = ex.residency.snapshot()
+    assert m2["misses"] > m1["misses"]
+
+
+def test_residency_eviction():
+    from pilosa_tpu.parallel.mesh import DeviceRunner
+    from pilosa_tpu.parallel.residency import DeviceResidency
+
+    r = DeviceResidency(DeviceRunner(), budget_bytes=4 * 128 * 1024)
+    mk = lambda: np.zeros((1, SHARD_WIDTH // 32), dtype=np.uint32)  # 128KiB
+    for i in range(8):
+        r.leaf(("k", i), mk)
+    snap = r.snapshot()
+    assert snap["evictions"] >= 4
+    assert snap["bytes"] <= 4 * 128 * 1024
+    # most-recent keys still resident
+    r.leaf(("k", 7), mk)
+    assert r.snapshot()["hits"] == 1
+
+
+def test_residency_bulk_import_invalidates(ex):
+    """import_roaring resets per-row generations; the bulk-generation floor
+    must still invalidate cached device leaves."""
+    from pilosa_tpu.storage.roaring import Bitmap
+
+    idx = ex.holder.create_index("i")
+    f = idx.create_field("f")
+    f.import_bits([1], [5])
+    assert ex.execute("i", "Count(Row(f=1))")[0] == 1
+    # bulk roaring import adds column 9 to row 1 (absolute bit positions)
+    b = Bitmap(np.array([1 * SHARD_WIDTH + 9], dtype=np.uint64))
+    frag = f.view().fragment(0)
+    frag.import_roaring(b.to_bytes())
+    assert ex.execute("i", "Count(Row(f=1))")[0] == 2
+
+
+def test_residency_delete_recreate_invalidates(tmp_path):
+    """Deleting and recreating an index restarts generation counters; the
+    delete must drop cached leaves or the old data would be served."""
+    from pilosa_tpu.api import API
+    from pilosa_tpu.models import Holder
+    from pilosa_tpu.parallel.cluster import Cluster, Node
+
+    h = Holder(str(tmp_path / "d")).open()
+    cluster = Cluster("n1")
+    cluster.set_static([Node(id="n1", uri="http://localhost:0")])
+    api = API(h, cluster)
+    api.create_index("i")
+    from pilosa_tpu.models.field import FieldOptions
+    api.create_field("i", "f", FieldOptions())
+    api.query_results("i", "Set(5, f=1)")
+    assert api.query_results("i", "Count(Row(f=1))")[0] == 1
+    api.delete_index("i")
+    api.create_index("i")
+    api.create_field("i", "f", FieldOptions())
+    api.query_results("i", "Set(9, f=1)")
+    row = api.query_results("i", "Row(f=1)")[0]
+    assert row.columns().tolist() == [9]
+    h.close()

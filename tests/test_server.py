@@ -648,3 +648,17 @@ def test_trace_header_propagates_into_spans(server):
         assert resp.status == 200
     spans = server.tracer.finished()
     assert any(sp.trace_id == "cafef00d" for sp in spans)
+
+
+def test_debug_pprof(server):
+    """/debug/pprof analog (http/handler.go:242): index, thread stacks, and
+    a short sampling profile."""
+    status, out = http("GET", server.uri, "/debug/pprof")
+    assert status == 200 and "goroutine" in json.loads(out)["profiles"]
+    status, out = http("GET", server.uri, "/debug/pprof/goroutine")
+    body = json.loads(out)
+    assert status == 200 and body["threads"] >= 1
+    status, out = http("GET", server.uri, "/debug/pprof/profile?seconds=0.05")
+    assert status == 200 and "samples" in json.loads(out)
+    status, _ = http("GET", server.uri, "/debug/pprof/heapz")
+    assert status == 404
