@@ -192,6 +192,18 @@ class Executor:
             out.append(0 if frag is None else frag.row_generation(row_id))
         return tuple(out)
 
+    def _row_leaf_dev(self, index: Index, field_name: str, view_name: str,
+                      shards, row_id: int):
+        """HBM-resident [S(padded), W] device array for one row via the
+        residency manager — shared by bitmap programs, BSI planes and TopN
+        recounts."""
+        gens = self._leaf_gens(index, field_name, view_name, shards, row_id)
+        key = ("row", index.name, field_name, view_name, row_id,
+               tuple(shards), gens)
+        return self.residency.leaf(key, lambda: np.stack([
+            self._cached_row(index, field_name, view_name, s, row_id)
+            for s in shards]))
+
     def _compile(self, index: Index, call: Call, shards: list[int]):
         """Walk the call tree -> (program, leaves) where leaves are
         HBM-resident device arrays [S, W] from the residency manager."""
@@ -200,6 +212,10 @@ class Executor:
 
         def leaf(key: tuple, make):
             leaves.append(self.residency.leaf(key, make))
+            return ("leaf", len(leaves) - 1)
+
+        def leaf_arr(arr):
+            leaves.append(arr)
             return ("leaf", len(leaves) - 1)
 
         def row_leaf(c: Call):
@@ -214,12 +230,8 @@ class Executor:
                             lambda: np.zeros((len(shards), WORDS), dtype=np.uint32))
             if f.options.type == FieldType.BOOL and isinstance(row_val, bool):
                 row_id = 1 if row_val else 0
-            gens = self._leaf_gens(index, field_name, VIEW_STANDARD, shards, row_id)
-            key = ("row", index.name, field_name, VIEW_STANDARD, row_id,
-                   shards_t, gens)
-            return leaf(key, lambda: np.stack([
-                self._cached_row(index, field_name, VIEW_STANDARD, s, row_id)
-                for s in shards]))
+            return leaf_arr(self._row_leaf_dev(
+                index, field_name, VIEW_STANDARD, shards, row_id))
 
         def range_leaf(c: Call):
             if "_start" in c.args or "_end" in c.args:
@@ -259,11 +271,8 @@ class Executor:
             if index.existence_field() is None:
                 raise ExecutionError(
                     f"index {index.name} does not support existence tracking")
-            gens = self._leaf_gens(index, EXISTENCE_FIELD_NAME, VIEW_STANDARD,
-                                   shards, 0)
-            key = ("row", index.name, EXISTENCE_FIELD_NAME, VIEW_STANDARD, 0,
-                   shards_t, gens)
-            return leaf(key, lambda: self._materialize_existence(index, shards))
+            return leaf_arr(self._row_leaf_dev(
+                index, EXISTENCE_FIELD_NAME, VIEW_STANDARD, shards, 0))
 
         def walk(c: Call):
             if c.name == "Row":
@@ -342,32 +351,6 @@ class Executor:
             self._row_cache[key] = cached
         return cached
 
-    def _materialize_row_call(self, index: Index, c: Call, shards) -> np.ndarray:
-        field_name = c.field_arg()
-        row_val = c.args[field_name]
-        f = index.field(field_name)
-        if f is None:
-            raise ExecutionError(f"field not found: {field_name}")
-        row_id = self._translate_row(index, f, row_val, create=False)
-        if row_id is None:  # unknown key: empty row, no id minting
-            return np.zeros((len(shards), WORDS), dtype=np.uint32)
-        if f.options.type == FieldType.BOOL and isinstance(row_val, bool):
-            row_id = 1 if row_val else 0
-        # Row(f=r, from/to) time bounds are handled by Range in v1.2
-        return np.stack([
-            self._cached_row(index, field_name, VIEW_STANDARD, s, row_id)
-            for s in shards
-        ])
-
-    def _materialize_existence(self, index: Index, shards) -> np.ndarray:
-        from pilosa_tpu.constants import EXISTENCE_FIELD_NAME
-        if index.existence_field() is None:
-            raise ExecutionError(f"index {index.name} does not support existence tracking")
-        return np.stack([
-            self._cached_row(index, EXISTENCE_FIELD_NAME, VIEW_STANDARD, s, 0)
-            for s in shards
-        ])
-
     def _materialize_range_call(self, index: Index, c: Call, shards) -> np.ndarray:
         # time range: Range(f=row, start, end) (executor.go executeRange)
         if "_start" in c.args or "_end" in c.args:
@@ -405,16 +388,19 @@ class Executor:
             raise ExecutionError(f"field {field_name} is not an int field")
         return f
 
-    def _bsi_planes(self, index: Index, f, shards) -> tuple[np.ndarray, np.ndarray]:
-        """(planes[depth, S, W], exists[S, W]) dense slabs for an int field."""
+    def _bsi_planes(self, index: Index, f, shards):
+        """(planes[depth, S', W], exists[S', W]) device arrays for an int
+        field, assembled by stacking HBM-resident plane leaves on device
+        (S' = S padded to the mesh size; pad shards are all-zero so every
+        BSI kernel sees them as empty). Repeat aggregations touch no host
+        memory."""
+        import jax.numpy as jnp
         depth = f.bit_depth
         vname = f.bsi_view_name
-        planes = np.stack([
-            np.stack([self._cached_row(index, f.name, vname, s, i) for s in shards])
-            for i in range(depth)
-        ])
-        exists = np.stack([
-            self._cached_row(index, f.name, vname, s, depth) for s in shards])
+        exists = self._row_leaf_dev(index, f.name, vname, shards, depth)
+        planes = jnp.stack([
+            self._row_leaf_dev(index, f.name, vname, shards, i)
+            for i in range(depth)])
         return planes, exists
 
     def _bsi_compare(self, index: Index, field_name: str, cond: Condition,
@@ -423,24 +409,31 @@ class Executor:
         planes, exists = self._bsi_planes(index, f, shards)
         depth = f.bit_depth
         op = cond.op
+        s = len(shards)
+
+        def fetch(dev) -> np.ndarray:  # device [S', W] -> host [S, W]
+            return np.asarray(dev)[:s]
+
+        def empty() -> np.ndarray:
+            return np.zeros((s, WORDS), dtype=np.uint32)
 
         # != null -> not-null row (executor.go:1344)
         if op == NEQ and cond.value is None:
-            return exists
+            return fetch(exists)
 
         import jax
         if op == BETWEEN:
             lo, hi = cond.int_slice_value()
             # clamp to field range (baseValueBetween, field.go:1410)
             if hi < f.options.min or lo > f.options.max:
-                return np.zeros_like(exists)
+                return empty()
             if lo <= f.options.min and hi >= f.options.max:
-                return exists
+                return fetch(exists)
             blo = max(lo - f.base, 0)
             bhi = min(hi, f.options.max) - f.base
             dlo = bsi_ops.compare(planes, exists, bsi_ops.value_to_bits(blo, depth), bsi_ops.GTE)
             dhi = bsi_ops.compare(planes, exists, bsi_ops.value_to_bits(bhi, depth), bsi_ops.LTE)
-            return np.asarray(jax.numpy.bitwise_and(dlo, dhi))
+            return fetch(jax.numpy.bitwise_and(dlo, dhi))
 
         value = cond.value
         if isinstance(value, bool) or not isinstance(value, int):
@@ -451,29 +444,31 @@ class Executor:
             raise ExecutionError(f"unsupported condition op: {op}")
         # out-of-range clamps (baseValue, field.go:1385)
         if op in (GT, GTE) and value > f.options.max:
-            return np.zeros_like(exists)
+            return empty()
         if op in (LT, LTE) and value < f.options.min:
-            return np.zeros_like(exists)
+            return empty()
         if op in (EQ,) and (value < f.options.min or value > f.options.max):
-            return np.zeros_like(exists)
+            return empty()
         if op == NEQ and (value < f.options.min or value > f.options.max):
-            return exists
+            return fetch(exists)
         if (op == LT and value > f.options.max) or (op == LTE and value >= f.options.max):
-            return exists
+            return fetch(exists)
         if (op == GT and value < f.options.min) or (op == GTE and value <= f.options.min):
-            return exists
+            return fetch(exists)
         base_value = min(max(value - f.base, 0), f.options.max - f.base)
         pred = bsi_ops.value_to_bits(base_value, depth)
-        return np.asarray(bsi_ops.compare(planes, exists, pred, op_map[op]))
+        return fetch(bsi_ops.compare(planes, exists, pred, op_map[op]))
 
-    def _bsi_filter(self, index: Index, call: Call, shards) -> Optional[np.ndarray]:
-        """Optional filter child for Sum/Min/Max."""
+    def _bsi_filter(self, index: Index, call: Call, shards):
+        """Optional filter child for Sum/Min/Max — a device array [S', W]
+        composed in HBM (no host round trip)."""
         if not call.children:
             return None
         program, leaves = self._compile(index, call.children[0], shards)
-        return self.runner.row_leaves(leaves, program, len(shards))
+        return self.runner.row_leaves_dev(leaves, program)
 
     def _execute_sum(self, index: Index, call: Call, shards) -> ValCount:
+        import jax.numpy as jnp
         field_name = call.args.get("field")
         if field_name is None:
             raise ExecutionError("Sum(): field required")
@@ -482,8 +477,8 @@ class Executor:
         planes, exists = self._bsi_planes(index, f, shards)
         filt = self._bsi_filter(index, call, shards)
         if filt is not None:
-            exists = exists & filt
-        counts = np.asarray(bsi_ops.plane_counts(planes, exists))  # [depth, S]
+            exists = jnp.bitwise_and(exists, filt)
+        counts = np.asarray(bsi_ops.plane_counts(planes, exists))  # [depth, S']
         from pilosa_tpu.ops.bitvector import popcount
         n = int(np.asarray(popcount(exists)).sum())
         raw_sum = bsi_ops.counts_to_sum(counts.sum(axis=1))
@@ -500,14 +495,15 @@ class Executor:
         field_name = call.args.get("field")
         if field_name is None:
             raise ExecutionError(f"{'Min' if is_min else 'Max'}(): field required")
+        import jax.numpy as jnp
         f = self._bsi_field(index, field_name)
         shards = self._query_shards(index, shards)
         planes, exists = self._bsi_planes(index, f, shards)
         filt = self._bsi_filter(index, call, shards)
         if filt is not None:
-            exists = exists & filt
+            exists = jnp.bitwise_and(exists, filt)
         fn = bsi_ops.bsi_min if is_min else bsi_ops.bsi_max
-        bits, cnt = fn(planes, exists)  # [depth, S], [S]
+        bits, cnt = fn(planes, exists)  # [depth, S'], [S']
         bits, cnt = np.asarray(bits), np.asarray(cnt)
         best_val, best_cnt = None, 0
         for i in range(len(shards)):
@@ -537,7 +533,7 @@ class Executor:
         src_dense = None
         if call.children:
             program, leaves = self._compile(index, call.children[0], shards)
-            src_dense = self.runner.row_leaves(leaves, program, len(shards))
+            src_dense = self.runner.row_leaves_dev(leaves, program)  # [S', W] in HBM
 
         ids_arg = call.uint_slice_arg("ids")
         threshold = call.uint_arg("threshold") or 0
@@ -592,9 +588,10 @@ class Executor:
         return sorted(out)
 
     def _exact_counts(self, index: Index, f, shards, row_ids: list[int],
-                      src_dense: Optional[np.ndarray], tanimoto: int):
-        """Batched device recount: rows x shards slab -> exact counts."""
-        from pilosa_tpu.ops.topn import tanimoto_counts, tanimoto_mask
+                      src_dense, tanimoto: int):
+        """Batched device recount: HBM-resident row leaves stacked on device
+        in chunks -> exact counts; only int32 count vectors leave the chip
+        (src_dense, if given, is already a device array [S', W])."""
         from pilosa_tpu.ops.bitvector import popcount, intersect_count
         import jax.numpy as jnp
 
@@ -602,13 +599,12 @@ class Executor:
         CHUNK = 256  # bound slab memory: 256 rows x S x 128KiB
         for start in range(0, len(row_ids), CHUNK):
             chunk = row_ids[start : start + CHUNK]
-            slab = np.stack([
-                np.stack([self._cached_row(index, f.name, VIEW_STANDARD, s, rid)
-                          for s in shards])
+            slab = jnp.stack([
+                self._row_leaf_dev(index, f.name, VIEW_STANDARD, shards, rid)
                 for rid in chunk
-            ])  # [R, S, W]
+            ])  # [R, S', W] on device
             if src_dense is not None:
-                inter = np.asarray(intersect_count(slab, src_dense[None]))  # [R, S]
+                inter = np.asarray(intersect_count(slab, src_dense[None]))  # [R, S']
                 counts = inter.sum(axis=1)
                 if tanimoto:
                     rcounts = np.asarray(popcount(slab)).sum(axis=1)

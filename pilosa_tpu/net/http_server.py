@@ -42,7 +42,7 @@ ROUTES: list[tuple[str, re.Pattern, str]] = [
     ("GET", re.compile(r"^/status$"), "get_status"),
     ("GET", re.compile(r"^/version$"), "get_version"),
     ("GET", re.compile(r"^/debug/vars$"), "get_debug_vars"),
-    ("GET", re.compile(r"^/debug/pprof/?(?P<profile>[^/]*)$"), "get_debug_pprof"),
+    ("GET", re.compile(r"^/debug/pprof(?:/(?P<profile>[^/]*))?$"), "get_debug_pprof"),
     # internal
     ("POST", re.compile(r"^/internal/cluster/message$"), "post_cluster_message"),
     ("GET", re.compile(r"^/internal/fragment/block/data$"), "get_fragment_block_data"),

@@ -70,12 +70,6 @@ def eval_row(leaves: jax.Array, program) -> jax.Array:
 
 
 @functools.partial(jax.jit, static_argnames=("program",))
-def eval_count(leaves: jax.Array, program) -> jax.Array:
-    """[L, S, W] -> [S] per-shard popcounts (fused with the bitwise program)."""
-    return popcount(_eval(leaves, program))
-
-
-@functools.partial(jax.jit, static_argnames=("program",))
 def eval_count_total(leaves: jax.Array, program) -> jax.Array:
     """[L, S, W] -> scalar total count. Under a sharded input GSPMD lowers the
     sum to an ICI all-reduce — the Count() reduce (executor.go:1521,2209)."""
@@ -121,25 +115,10 @@ class DeviceRunner:
     def n_devices(self) -> int:
         return 1 if self.mesh is None else self.mesh.size
 
-    def _pad(self, slab: np.ndarray) -> tuple[np.ndarray, int]:
-        s = slab.shape[1]
-        n = self.n_devices
-        pad = (-s) % n
-        if pad:
-            slab = np.pad(slab, ((0, 0), (0, pad), (0, 0)))
-        return slab, s
-
-    def put_slab(self, slab: np.ndarray) -> jax.Array:
-        """Place [L, S, W] on device(s), sharded over the shard axis."""
-        slab, _ = self._pad(np.ascontiguousarray(slab))
-        if self.mesh is None:
-            return jax.device_put(slab)
-        sharding = NamedSharding(self.mesh, P(None, SHARD_AXIS, None))
-        return jax.device_put(slab, sharding)
-
     def put_leaf(self, rows: np.ndarray) -> jax.Array:
-        """Place one leaf [S, W] on device(s), sharded over the shard axis —
-        the unit cached by the HBM residency manager (parallel/residency.py)."""
+        """Place one leaf [S, W] on device(s), padded to a multiple of the
+        mesh size and sharded over the shard axis — the unit cached by the
+        HBM residency manager (parallel/residency.py)."""
         s = rows.shape[0]
         pad = (-s) % self.n_devices
         if pad:
@@ -150,24 +129,6 @@ class DeviceRunner:
         return jax.device_put(
             rows, NamedSharding(self.mesh, P(SHARD_AXIS, None)))
 
-    def row(self, slab, program) -> np.ndarray:
-        """Dense [S, W] result (S = real shard count)."""
-        s = slab.shape[1] if isinstance(slab, np.ndarray) else None
-        dev = self.put_slab(slab) if isinstance(slab, np.ndarray) else slab
-        out = np.asarray(eval_row(dev, program))
-        return out[:s] if s is not None else out
-
-    def counts(self, slab, program) -> np.ndarray:
-        """Per-shard int32 counts [S]."""
-        s = slab.shape[1] if isinstance(slab, np.ndarray) else None
-        dev = self.put_slab(slab) if isinstance(slab, np.ndarray) else slab
-        out = np.asarray(eval_count(dev, program))
-        return out[:s] if s is not None else out
-
-    def count_total(self, slab, program) -> int:
-        dev = self.put_slab(slab) if isinstance(slab, np.ndarray) else slab
-        return int(eval_count_total(dev, program))
-
     # -- leaf-list evaluation (HBM-resident leaves, no per-query restack) ---
     # `leaves` is a Python list of [S, W] device arrays (a jit pytree arg):
     # cached leaves stay in HBM and only the compiled program runs per query.
@@ -175,6 +136,11 @@ class DeviceRunner:
     def row_leaves(self, leaves: list, program, n_shards: int) -> np.ndarray:
         out = np.asarray(eval_row(tuple(leaves), program))
         return out[:n_shards]
+
+    def row_leaves_dev(self, leaves: list, program) -> jax.Array:
+        """Dense result as a device array [S(padded), W] — stays in HBM for
+        further device-side composition (BSI filters, TopN sources)."""
+        return eval_row(tuple(leaves), program)
 
     def count_total_leaves(self, leaves: list, program) -> int:
         # pad shards are all-zero so they contribute nothing to the count —
