@@ -96,6 +96,7 @@ class API:
         # slow-query logging (cluster.longQueryTime, api.go:1038; server
         # option server.go:121). 0 disables.
         self.long_query_time = 0.0
+        self.max_writes_per_request = 5000  # server/config.go:47 default
         self.logger = None
 
     def _broadcast(self, msg: dict) -> None:
@@ -125,10 +126,29 @@ class API:
         index = self.holder.index(index_name)
         if index is None:
             raise NotFoundError(f"index not found: {index_name}")
+        query = pql
+        if isinstance(pql, str):
+            from pilosa_tpu.pql import parse_string
+            try:
+                query = parse_string(pql)
+            except ValueError as e:
+                raise ApiError(str(e))
+        if self.max_writes_per_request > 0:
+            # reject oversized write batches up front (MaxWritesPerRequest,
+            # api.go / http handler validation; server/config.go:47);
+            # Options() wraps a single call — unwrap so wrapped writes count
+            writes = sum(
+                1 for c in query.calls
+                if (c.children[0] if c.name == "Options" and c.children
+                    else c).name in self.executor.WRITE_CALLS)
+            if writes > self.max_writes_per_request:
+                raise ApiError(
+                    f"too many writes in a single request: {writes} > "
+                    f"{self.max_writes_per_request}")
         import time as _time
         start = _time.perf_counter()
         try:
-            return self.executor.execute(index_name, pql, shards=shards,
+            return self.executor.execute(index_name, query, shards=shards,
                                          remote=remote)
         except (ExecutionError, ValueError) as e:
             raise ApiError(str(e))

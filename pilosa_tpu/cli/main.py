@@ -98,6 +98,7 @@ def cmd_server(args) -> int:
         anti_entropy_interval=cfg.anti_entropy.interval,
         join=getattr(args, "join", False),
         long_query_time=cfg.cluster.long_query_time,
+        max_writes_per_request=cfg.max_writes_per_request,
         metric_service=cfg.metric.service,
         metric_host=cfg.metric.host,
         metric_poll_interval=cfg.metric.poll_interval,

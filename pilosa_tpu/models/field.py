@@ -270,10 +270,14 @@ class Field:
                 g = groups.setdefault(key, ([], []))
                 g[0].append(r)
                 g[1].append(c % SHARD_WIDTH)
+        mutex = self.options.type in (FieldType.MUTEX, FieldType.BOOL)
         for (vname, shard), (grows, gcols) in groups.items():
             view = self.create_view_if_not_exists(vname)
             frag = view.create_fragment_if_not_exists(shard)
-            frag.bulk_import(grows, gcols)
+            if mutex:
+                frag.bulk_import_mutex(grows, gcols)
+            else:
+                frag.bulk_import(grows, gcols)
             view.refresh_rank_cache(shard)
             self.add_available_shard(shard)
 

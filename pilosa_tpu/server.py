@@ -47,6 +47,7 @@ class Server:
                  resize_timeout: float = 120.0,
                  mesh=None,
                  long_query_time: float = 0.0,
+                 max_writes_per_request: int = 5000,
                  metric_service: str = "expvar",
                  metric_host: str = "127.0.0.1:8125",
                  metric_poll_interval: float = 0.0,
@@ -97,6 +98,7 @@ class Server:
                                tls_certificate=tls_certificate, tls_key=tls_key)
         self.cluster_hosts = cluster_hosts or []
         self.long_query_time = long_query_time
+        self.max_writes_per_request = max_writes_per_request
         self.anti_entropy_interval = anti_entropy_interval
         self.cache_flush_interval = cache_flush_interval
         self._cache_flush_timer: Optional[threading.Timer] = None
@@ -184,6 +186,7 @@ class Server:
             self.client.import_roaring(uri, index, field, shard, views,
                                        clear=clear, remote=True))
         self.api.long_query_time = self.long_query_time
+        self.api.max_writes_per_request = self.max_writes_per_request
         self.api.logger = self.logger
         if self.anti_entropy_interval > 0:
             self._schedule_anti_entropy()

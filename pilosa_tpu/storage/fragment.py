@@ -241,6 +241,32 @@ class Fragment:
             self._touch(int(rid))
         self.snapshot()
 
+    def bulk_import_mutex(self, row_ids: Iterable[int], columns: Iterable[int]) -> None:
+        """Mutex bulk set path: last write wins per column, and every other
+        row's bit for a written column is cleared — preserving the
+        one-row-per-column invariant under bulk load (bulkImportMutex,
+        fragment.go:1535-1622)."""
+        target: dict[int, int] = {}
+        for r, c in zip(row_ids, columns):
+            target[int(c) % SHARD_WIDTH] = int(r)
+        if not target:
+            return
+        cols = np.fromiter(target.keys(), dtype=np.uint64)
+        for rid in self.row_ids():
+            # probe just the written columns in this row — O(batch), not
+            # O(row cardinality)
+            cands = np.uint64(rid) * np.uint64(SHARD_WIDTH) + cols
+            mask = self.storage.contains_many(cands)
+            if mask.any():
+                self.storage.remove_many(cands[mask])
+                self._touch(rid)
+        positions = np.array(
+            [r * SHARD_WIDTH + c for c, r in target.items()], dtype=np.uint64)
+        self.storage.add_many(positions)
+        for rid in set(target.values()):
+            self._touch(rid)
+        self.snapshot()
+
     def bulk_import_values(self, columns: Iterable[int], values: Iterable[int],
                            bit_depth: int) -> None:
         """BSI bulk import (importValue, fragment.go:1624-1658)."""

@@ -333,6 +333,31 @@ class Bitmap:
 
     # -- queries ------------------------------------------------------------
 
+    def contains_many(self, values: np.ndarray) -> np.ndarray:
+        """Vectorized membership: bool mask per value, grouped by container
+        (the batch analog of the per-container probe in contains())."""
+        values = np.asarray(values, dtype=np.uint64)
+        out = np.zeros(values.size, dtype=bool)
+        keys = (values >> np.uint64(16)).astype(np.int64)
+        lows = (values & np.uint64(0xFFFF)).astype(np.uint16)
+        for key in np.unique(keys):
+            c = self.containers.get(int(key))
+            if c is None or c.n == 0:
+                continue
+            m = keys == key
+            lo = lows[m]
+            if c.kind == "array":
+                idx = np.searchsorted(c.data, lo)
+                idx_c = np.minimum(idx, c.data.size - 1)
+                ok = (idx < c.data.size) & (c.data[idx_c] == lo)
+            else:
+                li = lo.astype(np.int64)
+                w = c.data[li >> 6]
+                ok = ((w >> (li.astype(np.uint64) & np.uint64(63)))
+                      & np.uint64(1)).astype(bool)
+            out[m] = ok
+        return out
+
     def contains(self, value: int) -> bool:
         c = self.containers.get(value >> 16)
         return c is not None and c.contains(value & 0xFFFF)

@@ -241,3 +241,21 @@ def test_field_cache_type_options(tmp_path):
 
     with _pytest.raises(ValueError):
         FieldOptions(cache_type="bogus").validate()
+
+
+def test_mutex_bulk_import_one_row_per_column(tmp_path):
+    """Bulk import on a mutex field keeps the one-row-per-column invariant:
+    last write wins and prior rows' bits are cleared (bulkImportMutex,
+    fragment.go:1535-1622)."""
+    h = Holder(str(tmp_path / "d")).open()
+    idx = h.create_index("i")
+    f = idx.create_field("m", FieldOptions(type=FieldType.MUTEX))
+    f.set_bit(1, 5)
+    f.set_bit(2, 6)
+    # bulk: col 5 -> row 3 (must clear row 1's bit), col 6 -> row 2 twice,
+    # col 7 -> row 1 then row 2 in the same batch (last wins)
+    f.import_bits([3, 2, 1, 2], [5, 6, 7, 7])
+    assert f.row(1).columns().tolist() == []
+    assert f.row(2).columns().tolist() == [6, 7]
+    assert f.row(3).columns().tolist() == [5]
+    h.close()

@@ -198,3 +198,13 @@ def test_repair():
     assert b.containers[0].kind == "array"
     assert 7 not in b.containers
     b.check()
+
+
+def test_contains_many():
+    rng = np.random.default_rng(5)
+    vals = np.unique(rng.integers(0, 1 << 21, size=6000).astype(np.uint64))
+    b = Bitmap(vals)
+    probe = np.concatenate([vals[:100], vals[:100] + np.uint64(1 << 40)])
+    mask = b.contains_many(probe)
+    assert mask[:100].all() and not mask[100:].any()
+    assert not b.contains_many(np.array([], dtype=np.uint64)).any()

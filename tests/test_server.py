@@ -662,3 +662,75 @@ def test_debug_pprof(server):
     assert status == 200 and "samples" in json.loads(out)
     status, _ = http("GET", server.uri, "/debug/pprof/heapz")
     assert status == 404
+
+
+def test_cluster_node_pause_and_convergence(cluster3):
+    """The clustertests fault-injection scenario (internal/clustertests
+    TestClusterStuff: pumba-paused node misses writes mid-stream, anti-entropy
+    converges it after it returns). Pause = handler returns 503."""
+    s0, s1, s2 = cluster3
+    jpost(s0.uri, "/index/i", {})
+    jpost(s0.uri, "/index/i/field/f", {})
+    jpost(s0.uri, "/index/i/query", raw=b"Set(1, f=1)")
+
+    # find a non-coordinator owner of shard 0 to pause
+    owners = [s for s in cluster3
+              if s.cluster.owns_shard(s.node_id, "i", 0)]
+    victim = next(s for s in owners if not s.cluster.is_coordinator())
+    healthy = next(s for s in owners if s is not victim)
+
+    real_dispatch = victim.handler.dispatch
+    victim.handler.dispatch = lambda *a, **k: (
+        503, "application/json", b'{"error": "paused"}')
+    try:
+        # a write needing the paused replica fails cleanly, not silently
+        status, out = jpost(healthy.uri, "/index/i/query", raw=b"Set(2, f=1)")
+        assert status >= 400
+        assert "error" in out
+        # write the bit into the healthy owner only (the divergence the
+        # paused node accumulates while down)
+        healthy.holder.index("i").field("f").set_bit(1, 2)
+    finally:
+        victim.handler.dispatch = real_dispatch
+
+    # victim is back: anti-entropy pass on the healthy node pushes the delta
+    assert healthy.sync_holder() >= 1
+    vfrag = victim.holder.index("i").field("f").view().fragment(0)
+    assert vfrag.contains(1, 2)
+    # and queries agree everywhere
+    for s in cluster3:
+        _, out = jpost(s.uri, "/index/i/query", raw=b"Count(Row(f=1))")
+        assert out["results"] == [2], s.uri
+
+
+def test_max_writes_per_request(tmp_path):
+    """Oversized write batches are rejected up front (MaxWritesPerRequest,
+    server/config.go:47)."""
+    s = Server(str(tmp_path / "node"), port=0, max_writes_per_request=2).open()
+    try:
+        jpost(s.uri, "/index/i", {})
+        jpost(s.uri, "/index/i/field/f", {})
+        status, out = jpost(s.uri, "/index/i/query",
+                            raw=b"Set(1, f=1) Set(2, f=1) Set(3, f=1)")
+        assert status == 400 and "too many writes" in out["error"]
+        # reads aren't counted
+        status, _ = jpost(s.uri, "/index/i/query",
+                          raw=b"Count(Row(f=1)) Count(Row(f=2)) Count(Row(f=3))")
+        assert status == 200
+        status, _ = jpost(s.uri, "/index/i/query", raw=b"Set(1, f=1) Set(2, f=1)")
+        assert status == 200
+    finally:
+        s.close()
+
+
+def test_max_writes_counts_options_wrapped(tmp_path):
+    s = Server(str(tmp_path / "node"), port=0, max_writes_per_request=2).open()
+    try:
+        jpost(s.uri, "/index/i", {})
+        jpost(s.uri, "/index/i/field/f", {})
+        status, out = jpost(
+            s.uri, "/index/i/query",
+            raw=b"Options(Set(1, f=1)) Options(Set(2, f=1)) Options(Set(3, f=1))")
+        assert status == 400 and "too many writes" in out["error"]
+    finally:
+        s.close()
