@@ -116,7 +116,9 @@ class API:
 
     def query_results(self, index_name: str, pql: str,
                       shards: Optional[list[int]] = None,
-                      remote: bool = False) -> list:
+                      remote: bool = False,
+                      exclude_row_attrs: bool = False,
+                      exclude_columns: bool = False) -> list:
         """Execute PQL and return raw result objects (Row/Pairs/ValCount/...).
 
         Both wire writers consume this: query() renders JSON, the protobuf
@@ -148,8 +150,19 @@ class API:
         import time as _time
         start = _time.perf_counter()
         try:
-            return self.executor.execute(index_name, query, shards=shards,
-                                         remote=remote)
+            results = self.executor.execute(index_name, query, shards=shards,
+                                            remote=remote)
+            if exclude_row_attrs or exclude_columns:
+                # request-level flags apply to every Row result
+                # (QueryRequest.ExcludeRowAttrs/ExcludeColumns,
+                # internal/public.proto; handler exec options)
+                for r in results:
+                    if isinstance(r, Row):
+                        if exclude_columns:
+                            r.segments = {}
+                        if exclude_row_attrs:
+                            r.attrs = {}
+            return results
         except (ExecutionError, ValueError) as e:
             raise ApiError(str(e))
         finally:
@@ -160,12 +173,44 @@ class API:
                                    elapsed, index_name, pql)
 
     def query(self, index_name: str, pql: str,
-              shards: Optional[list[int]] = None, remote: bool = False) -> dict:
+              shards: Optional[list[int]] = None, remote: bool = False,
+              column_attrs: bool = False,
+              exclude_row_attrs: bool = False,
+              exclude_columns: bool = False) -> dict:
         """POST /index/{index}/query (api.Query, api.go:102)."""
         results = self.query_results(index_name, pql, shards=shards,
-                                     remote=remote)
+                                     remote=remote,
+                                     exclude_row_attrs=exclude_row_attrs,
+                                     exclude_columns=exclude_columns)
         index = self.holder.index(index_name)
-        return {"results": [self._result_to_json(index, r) for r in results]}
+        out = {"results": [self._result_to_json(index, r) for r in results]}
+        if column_attrs:
+            out["columnAttrSets"] = self.column_attr_sets(index_name, results)
+        return out
+
+    def column_attr_sets(self, index_name: str, results: list) -> list[dict]:
+        """Attrs for every column appearing in Row results — the
+        QueryRequest.ColumnAttrs option (executor/handler attach
+        ColumnAttrSets to the response, internal/public.proto:70)."""
+        index = self.holder.index(index_name)
+        if index is None:
+            return []
+        cols: set[int] = set()
+        for r in results:
+            if isinstance(r, Row):
+                cols.update(int(c) for c in r.columns())
+        out = []
+        for c in sorted(cols):
+            attrs = index.column_attrs.attrs(c)
+            if attrs:
+                entry = {"id": c, "attrs": attrs}
+                if index.keys:
+                    key = self.translate.translate_column_to_string(
+                        index.name, c)
+                    if key is not None:
+                        entry["key"] = key
+                out.append(entry)
+        return out
 
     def _result_to_json(self, index, result):
         if isinstance(result, Row):

@@ -160,7 +160,7 @@ class Serializer:
         m = pb.QueryResponse(Err=err)
         m.Results.extend(_encode_result(r) for r in results)
         for cas in column_attr_sets or []:
-            c = pb.ColumnAttrSet(ID=int(cas["id"]))
+            c = pb.ColumnAttrSet(ID=int(cas["id"]), Key=cas.get("key", ""))
             c.Attrs.extend(_encode_attrs(cas.get("attrs", {})))
             m.ColumnAttrSets.append(c)
         return m.SerializeToString()
@@ -171,7 +171,8 @@ class Serializer:
         return {"err": m.Err,
                 "results": [decode_result(r) for r in m.Results],
                 "columnAttrSets": [
-                    {"id": c.ID, "attrs": _decode_attrs(c.Attrs)}
+                    {"id": c.ID, "attrs": _decode_attrs(c.Attrs),
+                     **({"key": c.Key} if c.Key else {})}
                     for c in m.ColumnAttrSets]}
 
     # -- imports -------------------------------------------------------------

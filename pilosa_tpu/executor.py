@@ -1003,6 +1003,10 @@ class Executor:
         if call.args.get("shards") is not None:
             shards = [int(s) for s in call.uint_slice_arg("shards")]
         result = self._execute_call(index, call.children[0], shards)
+        # the two flags are independent: excludeColumns clears only segments,
+        # excludeRowAttrs clears only attrs (executor.go Options handling)
         if call.bool_arg("excludeColumns") and isinstance(result, Row):
-            result = Row()
+            result.segments = {}
+        if call.bool_arg("excludeRowAttrs") and isinstance(result, Row):
+            result.attrs = {}
         return result

@@ -59,6 +59,25 @@ ROUTES: list[tuple[str, re.Pattern, str]] = [
     ("POST", re.compile(r"^/internal/translate/keys$"), "post_translate_keys"),
 ]
 
+# Per-endpoint allowed URL query arguments (queryValidationSpec,
+# http/handler.go:171-224): unknown arguments on a LISTED endpoint are a 400,
+# catching typos like ?shard= on an endpoint that reads ?shards=. Endpoints
+# not listed here are left open (matching the reference: validation only
+# applies to routes in the spec).
+ALLOWED_QUERY_ARGS: dict[str, frozenset] = {
+    "post_query": frozenset({"shards", "remote", "columnAttrs",
+                             "excludeRowAttrs", "excludeColumns"}),
+    "get_export": frozenset({"index", "field", "shard"}),
+    "get_fragment_blocks": frozenset({"index", "field", "view", "shard"}),
+    "get_fragment_block_data": frozenset({"index", "field", "view", "shard",
+                                          "block"}),
+    "get_fragment_data": frozenset({"index", "field", "view", "shard"}),
+    "get_fragment_views": frozenset({"index", "field", "shard"}),
+    "get_fragment_nodes": frozenset({"index", "shard"}),
+    "get_translate_data": frozenset({"offset"}),
+    "get_debug_pprof": frozenset({"seconds"}),
+}
+
 
 class Handler:
     """Route dispatch against an API instance."""
@@ -87,6 +106,10 @@ class Handler:
                 match = rx.match(path)
                 if match is None:
                     continue
+                allowed = ALLOWED_QUERY_ARGS.get(name)
+                if allowed is not None and (unknown := set(query) - allowed):
+                    return self._error(
+                        400, f"invalid query argument(s): {', '.join(sorted(unknown))}")
                 handler = getattr(self, name)
                 try:
                     return handler(match.groupdict(), query, body)
@@ -155,18 +178,32 @@ class Handler:
         if self._sends_proto():
             req = self.serializer.decode_query_request(body)
             pql, shard_list, remote = req["query"], req["shards"], req["remote"]
+            column_attrs = bool(req.get("columnAttrs"))
+            ex_attrs = bool(req.get("excludeRowAttrs"))
+            ex_cols = bool(req.get("excludeColumns"))
         else:
             shards = self._arg(query, "shards")
             shard_list = [int(s) for s in shards.split(",")] if shards else None
             remote = self._arg(query, "remote") in ("1", "true")
+            column_attrs = self._arg(query, "columnAttrs") in ("1", "true")
+            ex_attrs = self._arg(query, "excludeRowAttrs") in ("1", "true")
+            ex_cols = self._arg(query, "excludeColumns") in ("1", "true")
             pql = body.decode()
         if self._wants_proto():
             results = self.api.query_results(params["index"], pql,
-                                             shards=shard_list, remote=remote)
-            payload = self.serializer.encode_query_response(results)
+                                             shards=shard_list, remote=remote,
+                                             exclude_row_attrs=ex_attrs,
+                                             exclude_columns=ex_cols)
+            cas = (self.api.column_attr_sets(params["index"], results)
+                   if column_attrs else None)
+            payload = self.serializer.encode_query_response(
+                results, column_attr_sets=cas)
             return 200, PROTO_CONTENT_TYPE, payload
         return self._json(self.api.query(params["index"], pql,
-                                         shards=shard_list, remote=remote))
+                                         shards=shard_list, remote=remote,
+                                         column_attrs=column_attrs,
+                                         exclude_row_attrs=ex_attrs,
+                                         exclude_columns=ex_cols))
 
     def get_indexes(self, params, query, body):
         return self._json(self.api.schema())

@@ -163,3 +163,15 @@ def test_protobuf_error_response(server, ser):
         assert e.headers.get("Content-Type") == CONTENT_TYPE
         resp = ser.decode_query_response(e.read())
         assert resp["err"]
+
+
+def test_column_attr_sets_roundtrip_with_keys():
+    ser = Serializer()
+    cas = [{"id": 5, "attrs": {"city": "ankh"}, "key": "alice"},
+           {"id": 6, "attrs": {"n": 2}}]
+    blob = ser.encode_query_response([], column_attr_sets=cas)
+    dec = ser.decode_query_response(blob)
+    assert dec["columnAttrSets"] == [
+        {"id": 5, "attrs": {"city": "ankh"}, "key": "alice"},
+        {"id": 6, "attrs": {"n": 2}},
+    ]

@@ -734,3 +734,49 @@ def test_max_writes_counts_options_wrapped(tmp_path):
         assert status == 400 and "too many writes" in out["error"]
     finally:
         s.close()
+
+
+def test_unknown_query_args_rejected(server):
+    """Per-endpoint query-arg validation (queryValidationSpec,
+    http/handler.go:171-224)."""
+    jpost(server.uri, "/index/i", {})
+    jpost(server.uri, "/index/i/field/f", {})
+    status, out = jpost(server.uri, "/index/i/query?shard=0", raw=b"Count(Row(f=1))")
+    assert status == 400 and "invalid query argument" in out["error"]
+    status, _ = jpost(server.uri, "/index/i/query?shards=0", raw=b"Count(Row(f=1))")
+    assert status == 200
+    status, out = http("GET", server.uri, "/internal/translate/data?offst=3")
+    assert status == 400
+
+
+def test_column_attrs_in_query_response(server):
+    """QueryRequest.ColumnAttrs attaches attrs of result columns
+    (internal/public.proto:70 ColumnAttrSets)."""
+    jpost(server.uri, "/index/i", {})
+    jpost(server.uri, "/index/i/field/f", {})
+    jpost(server.uri, "/index/i/query", raw=b"Set(5, f=1) Set(6, f=1)")
+    jpost(server.uri, "/index/i/query", raw=b'SetColumnAttrs(5, city="ankh")')
+    _, out = jpost(server.uri, "/index/i/query?columnAttrs=true", raw=b"Row(f=1)")
+    assert out["columnAttrSets"] == [{"id": 5, "attrs": {"city": "ankh"}}]
+    # excludeRowAttrs strips attrs, excludeColumns strips columns
+    jpost(server.uri, "/index/i/query", raw=b'SetRowAttrs(f, 1, name="row1")')
+    _, out = jpost(server.uri, "/index/i/query",
+                   raw=b"Options(Row(f=1), excludeRowAttrs=true)")
+    assert out["results"][0]["attrs"] == {}
+    _, out = jpost(server.uri, "/index/i/query",
+                   raw=b"Options(Row(f=1), excludeColumns=true)")
+    assert out["results"][0]["columns"] == []
+
+
+def test_request_level_exclude_flags_and_open_endpoints(server):
+    jpost(server.uri, "/index/i", {})
+    jpost(server.uri, "/index/i/field/f", {})
+    jpost(server.uri, "/index/i/query", raw=b"Set(5, f=1)")
+    jpost(server.uri, "/index/i/query", raw=b'SetRowAttrs(f, 1, name="n")')
+    _, out = jpost(server.uri, "/index/i/query?excludeRowAttrs=true", raw=b"Row(f=1)")
+    assert out["results"][0] == {"columns": [5], "attrs": {}}
+    _, out = jpost(server.uri, "/index/i/query?excludeColumns=true", raw=b"Row(f=1)")
+    assert out["results"][0] == {"columns": [], "attrs": {"name": "n"}}
+    # unlisted endpoints stay open to stray args (cache busters etc.)
+    status, _ = http("GET", server.uri, "/version?cb=123")
+    assert status == 200
