@@ -822,7 +822,9 @@ class Executor:
                 shards = [int(s) for s in call.uint_slice_arg("shards")]
             result = self._execute_distributed(index, call.children[0], shards)
             if call.bool_arg("excludeColumns") and isinstance(result, Row):
-                result = Row()
+                result.segments = {}
+            if call.bool_arg("excludeRowAttrs") and isinstance(result, Row):
+                result.attrs = {}
             return result
         if call.name in self.WRITE_CALLS:
             return self._execute_write_distributed(index, call, shards)
@@ -834,10 +836,22 @@ class Executor:
                             call.children)
         qshards = self._query_shards(index, shards)
         groups = self.cluster.shards_by_node(index.name, qshards)
-        partials = []
-        for node_id, node_shards in groups.items():
-            partials.extend(
-                self._map_node(index, fan_call, node_id, node_shards, set()))
+        if len(groups) <= 1:
+            partials = []
+            for node_id, node_shards in groups.items():
+                partials.extend(
+                    self._map_node(index, fan_call, node_id, node_shards, set()))
+            return self._reduce(call, partials, index, shards)
+        # concurrent per-node fan-out — the goroutine-per-node mapper
+        # (executor.go:2256); reduce as responses land
+        from concurrent.futures import ThreadPoolExecutor
+        with ThreadPoolExecutor(max_workers=len(groups)) as pool:
+            futures = [
+                pool.submit(self._map_node, index, fan_call, node_id,
+                            node_shards, set())
+                for node_id, node_shards in groups.items()
+            ]
+            partials = [p for fut in futures for p in fut.result()]
         return self._reduce(call, partials, index, shards)
 
     def _map_node(self, index: Index, call: Call, node_id: str,

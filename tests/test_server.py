@@ -780,3 +780,17 @@ def test_request_level_exclude_flags_and_open_endpoints(server):
     # unlisted endpoints stay open to stray args (cache busters etc.)
     status, _ = http("GET", server.uri, "/version?cb=123")
     assert status == 200
+
+
+def test_cluster_options_exclude_flags(cluster3):
+    s0 = cluster3[0]
+    jpost(s0.uri, "/index/i", {})
+    jpost(s0.uri, "/index/i/field/f", {})
+    jpost(s0.uri, "/index/i/query", raw=b"Set(5, f=1)")
+    jpost(s0.uri, "/index/i/query", raw=b'SetRowAttrs(f, 1, name="n")')
+    _, out = jpost(cluster3[1].uri, "/index/i/query",
+                   raw=b"Options(Row(f=1), excludeColumns=true)")
+    assert out["results"][0] == {"columns": [], "attrs": {"name": "n"}}
+    _, out = jpost(cluster3[2].uri, "/index/i/query",
+                   raw=b"Options(Row(f=1), excludeRowAttrs=true)")
+    assert out["results"][0] == {"columns": [5], "attrs": {}}
