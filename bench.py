@@ -10,6 +10,12 @@ same dense kernel in numpy (vectorized AND + popcount — an upper bound on the
 Go implementation's single-node throughput for dense data, and the same
 algorithmic work per query).
 
+Resilience: the TPU tunnel's backend init can hang indefinitely or fail
+transiently, so the measurement runs in a worker SUBPROCESS under a hard
+deadline with retry/backoff; the parent ALWAYS emits the one JSON line — on
+total failure it carries the measured CPU baseline plus the error class
+instead of silently crashing (round-1 failure mode: rc=1, no artifact).
+
 Methodology notes (the axon tunnel makes naive timing lie in both
 directions):
 - Queries are chained: each dispatch's carry feeds the next, so device
@@ -29,32 +35,102 @@ Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 """
 
 import json
+import os
+import subprocess
+import sys
 import time
 
 import numpy as np
 
-N_SHARDS = 1024      # 1024 shards x 2^20 cols = 1.07B columns per row
+from pilosa_tpu.constants import WORDS_PER_SHARD
+
+N_SHARDS = int(os.environ.get("PILOSA_BENCH_SHARDS", "1024"))
+#   1024 shards x 2^20 cols = 1.07B columns per row
 N_ROWS = 16          # resident rows: 16 x 134MB = 2.1GB HBM
 K_BATCH = 32         # distinct queries per dispatch
 N_DISPATCH = 6       # chained dispatches measured
 
+METRIC = ("intersect_count_qps_1Bcol" if N_SHARDS == 1024
+          else f"intersect_count_qps_{N_SHARDS}shards")
+DEADLINE_S = float(os.environ.get("PILOSA_BENCH_DEADLINE_S", "600"))
+PROBE_TIMEOUT_S = 120.0
+# Force a platform (e.g. "cpu" for CI smoke tests). The axon site wrapper
+# overrides the JAX_PLATFORMS env var, so this must go via jax.config.update.
+PLATFORM = os.environ.get("PILOSA_BENCH_PLATFORM", "")
 
-def main() -> None:
+
+def _apply_platform() -> None:
+    if PLATFORM:
+        import jax
+
+        jax.config.update("jax_platforms", PLATFORM)
+
+
+def _make_rows(words_per_shard: int) -> np.ndarray:
+    rng = np.random.default_rng(7)
+    return rng.integers(
+        0, 2**32, size=(N_ROWS, N_SHARDS, words_per_shard), dtype=np.uint32)
+
+
+def _pairs():
+    return [((p * 5 + 1) % N_ROWS, (p * 11 + 3) % N_ROWS)
+            for p in range(K_BATCH)]
+
+
+def _cpu_baseline(rows_np: np.ndarray, iters: int = 3) -> float:
+    """Seconds per query for the same dense AND+popcount kernel in numpy."""
+    pairs = _pairs()
+    i, j = pairs[0]
+    np.bitwise_count(rows_np[i] & rows_np[j]).sum()  # warm (page-in)
+    t0 = time.perf_counter()
+    for it in range(iters):
+        i, j = pairs[it % len(pairs)]
+        np.bitwise_count(rows_np[i] & rows_np[j]).sum()
+    return (time.perf_counter() - t0) / iters
+
+
+def _init_backend_with_retry(deadline: float):
+    """jax.devices() with bounded retry/backoff on transient init errors.
+
+    A *hang* here is handled by the parent's subprocess timeout, not by us.
+    """
+    import jax
+
+    _apply_platform()
+    backoff = 10.0
+    while True:
+        try:
+            return jax.devices()
+        except RuntimeError as e:
+            if time.monotonic() + backoff >= deadline:
+                raise
+            print(f"backend init failed ({e}); retrying in {backoff:.0f}s",
+                  file=sys.stderr)
+            time.sleep(backoff)
+            backoff = min(backoff * 2, 60.0)
+
+
+def worker() -> None:
+    """Full measurement (runs in a subprocess; may hang — parent enforces
+    the deadline). Prints the final JSON line on success."""
+    deadline = time.monotonic() + DEADLINE_S * 0.9
+
     import jax
     import jax.numpy as jnp
-    from pilosa_tpu.constants import WORDS_PER_SHARD
     from pilosa_tpu.parallel.mesh import count_pair_stream, eval_count_total
 
-    rng = np.random.default_rng(7)
-    rows_np = rng.integers(
-        0, 2**32, size=(N_ROWS, N_SHARDS, WORDS_PER_SHARD), dtype=np.uint32)
-    # distinct (i, j) pairs cycling through the resident rows
-    pairs = [((p * 5 + 1) % N_ROWS, (p * 11 + 3) % N_ROWS)
-             for p in range(K_BATCH)]
+    devices = _init_backend_with_retry(deadline)
+
+    pairs = _pairs()
     ii = jnp.array([p[0] for p in pairs], dtype=jnp.int32)
     jj = jnp.array([p[1] for p in pairs], dtype=jnp.int32)
 
-    rows = jax.device_put(rows_np)
+    # generate the slab ON DEVICE — device_put of GBs through the axon
+    # tunnel takes minutes (round-1 finding; .claude/skills/verify/SKILL.md)
+    rows = jax.random.bits(
+        jax.random.key(7), (N_ROWS, N_SHARDS, WORDS_PER_SHARD),
+        dtype=jnp.uint32)
+    int(rows[0, 0, 0])  # force materialization before timing
 
     int(count_pair_stream(rows, ii, jj, jnp.uint32(0)))  # compile + warm
     t0 = time.perf_counter()
@@ -64,31 +140,28 @@ def main() -> None:
     int(carry)  # forces the whole chain
     tpu_s = (time.perf_counter() - t0) / (N_DISPATCH * K_BATCH)
 
-    # --- CPU baseline: same kernel in numpy, same query stream ---
-    i0, j0 = pairs[0]
-    cpu_iters = 3
-    t0 = time.perf_counter()
-    for it in range(cpu_iters):
-        i, j = pairs[it % len(pairs)]
-        np.bitwise_count(rows_np[i] & rows_np[j]).sum()
-    cpu_s = (time.perf_counter() - t0) / cpu_iters
+    # CPU baseline on host-generated data: same shapes, same kernel work
+    # (values differ from the device slab; throughput is data-independent)
+    cpu_s = _cpu_baseline(_make_rows(WORDS_PER_SHARD))
 
-    # correctness cross-check on one pair: numpy vs the engine's executor
-    # kernel (eval_count_total, the single-query path) vs the stream kernel
-    expect = int(np.bitwise_count(rows_np[i0] & rows_np[j0]).sum())
+    # correctness cross-check on a small slice (full-row fetches through the
+    # tunnel are slow): numpy vs the engine's executor kernel
+    # (eval_count_total, the single-query path) vs the stream kernel
+    i0, j0 = pairs[0]
+    small = rows[:, :4, :]
+    a = np.asarray(small[i0])
+    b = np.asarray(small[j0])
+    expect = int(np.bitwise_count(a & b).sum())
     got = int(eval_count_total(
-        jnp.stack([rows[i0], rows[j0]]), ("and", ("leaf", 0), ("leaf", 1))))
-    got_stream = int(count_pair_stream(
-        rows, ii[:1], jj[:1], jnp.uint32(0)))
-    expect_stream = int(np.bitwise_count(
-        rows_np[pairs[0][0]] & rows_np[pairs[0][1]]).sum())
+        jnp.stack([small[i0], small[j0]]), ("and", ("leaf", 0), ("leaf", 1))))
+    got_stream = int(count_pair_stream(small, ii[:1], jj[:1], jnp.uint32(0)))
     assert got == expect, (got, expect)
-    assert got_stream == expect_stream, (got_stream, expect_stream)
+    assert got_stream == expect, (got_stream, expect)
 
     cols = N_SHARDS * (WORDS_PER_SHARD * 32)
     qps = 1.0 / tpu_s
     result = {
-        "metric": "intersect_count_qps_1Bcol",
+        "metric": METRIC,
         "value": round(qps, 2),
         "unit": "queries/s/chip",
         "vs_baseline": round(cpu_s / tpu_s, 2),
@@ -100,10 +173,110 @@ def main() -> None:
             "queries_per_dispatch": K_BATCH,
             "tpu_gcols_per_s": round(cols / tpu_s / 1e9, 2),
             "hbm_gb_per_s": round(2 * cols / 8 / tpu_s / 1e9, 1),
-            "device": str(jax.devices()[0]),
+            "device": str(devices[0]),
         },
     }
     print(json.dumps(result))
+
+
+def _probe_backend(timeout_s: float):
+    """(ok, error_string): can jax.devices() return, within timeout_s? Cheap
+    subprocess — avoids burning the full worker (2.1GB data gen) on a dead
+    tunnel. Distinguishes a hang (timeout) from a fast crash (rc != 0)."""
+    code = (
+        "import jax\n"
+        + (f"jax.config.update('jax_platforms', {PLATFORM!r})\n" if PLATFORM
+           else "")
+        + "d = jax.devices(); print(d[0].platform)")
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", code], timeout=timeout_s,
+            capture_output=True, text=True)
+    except subprocess.TimeoutExpired:
+        return False, "BackendInitTimeout: jax.devices() did not return"
+    if proc.returncode == 0:
+        return True, ""
+    tail = (proc.stderr or "").strip().splitlines()
+    return False, "BackendInitError: " + (tail[-1][:300] if tail else
+                                          f"rc={proc.returncode}")
+
+
+def _emit_failure(error: str) -> None:
+    detail = {"error": error}
+    try:
+        # the baseline still gets measured so the artifact carries a real
+        # number — but on a SMALL slab (the full 2.1GB gen + 3 passes can
+        # blow the last seconds of the deadline and lose the JSON line);
+        # the kernel is linear in bytes, so scale the estimate up.
+        small_shards = min(64, N_SHARDS)
+        rng = np.random.default_rng(7)
+        rows = rng.integers(
+            0, 2**32, size=(2, small_shards, WORDS_PER_SHARD),
+            dtype=np.uint32)
+        np.bitwise_count(rows[0] & rows[1]).sum()  # warm
+        t0 = time.perf_counter()
+        np.bitwise_count(rows[0] & rows[1]).sum()
+        cpu_s = (time.perf_counter() - t0) * (N_SHARDS / small_shards)
+        detail["cpu_numpy_ms_per_query_est"] = round(cpu_s * 1e3, 4)
+        detail["baseline_shards_measured"] = small_shards
+    except Exception as e:  # pragma: no cover
+        detail["baseline_error"] = f"{type(e).__name__}: {e}"
+    print(json.dumps({
+        "metric": METRIC, "value": 0.0, "unit": "queries/s/chip",
+        "vs_baseline": 0.0, "detail": detail,
+    }))
+
+
+def main() -> None:
+    if "--worker" in sys.argv:
+        worker()
+        return
+
+    t_end = time.monotonic() + DEADLINE_S
+    last_err = "unknown"
+    attempt = 0
+    same_err_count = 0
+    while time.monotonic() < t_end - 45:
+        attempt += 1
+        probe_budget = min(PROBE_TIMEOUT_S, t_end - time.monotonic() - 50)
+        if probe_budget <= 5:
+            break
+        ok, err = _probe_backend(probe_budget)
+        if not ok:
+            same_err_count = same_err_count + 1 if err == last_err else 1
+            last_err = err
+            print(f"[bench] probe attempt {attempt} failed ({err}); "
+                  "backing off", file=sys.stderr)
+            if same_err_count >= 3 and err.startswith("BackendInitError"):
+                break  # deterministic crash — retrying won't help
+            time.sleep(min(15, max(0, t_end - time.monotonic() - 45)))
+            continue
+        budget = t_end - time.monotonic() - 45
+        if budget <= 30:
+            break
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), "--worker"],
+                timeout=budget, capture_output=True, text=True,
+                cwd=os.path.dirname(os.path.abspath(__file__)))
+            same_err_count = 0
+        except subprocess.TimeoutExpired:
+            last_err = f"WorkerTimeout: measurement exceeded {budget:.0f}s"
+            continue
+        lines = [l for l in proc.stdout.strip().splitlines() if l.strip()]
+        if proc.returncode == 0 and lines:
+            try:
+                json.loads(lines[-1])
+            except ValueError:
+                last_err = f"WorkerBadOutput: {lines[-1][:200]}"
+                continue
+            sys.stderr.write(proc.stderr[-2000:])
+            print(lines[-1])
+            return
+        tail = (proc.stderr or proc.stdout or "").strip().splitlines()
+        last_err = f"WorkerFailed(rc={proc.returncode}): " + \
+            (tail[-1][:300] if tail else "no output")
+    _emit_failure(last_err)
 
 
 if __name__ == "__main__":
