@@ -542,22 +542,15 @@ class Executor:
         attr_values = call.args.get("attrValues")
 
         candidates = self._topn_candidates(index, f, shards, ids_arg)
-        if attr_name:
+        if attr_name and attr_values is not None:
             # row-attribute candidate filter (topOptions.AttrName/AttrValues,
-            # fragment.go:1191-1208; applied fragment.go:1056-1076)
-            allowed = None
-            if attr_values is not None:
-                allowed = set(attr_values if isinstance(attr_values, list)
-                              else [attr_values])
-            kept = []
-            for rid in candidates:
-                val = f.row_attrs.attrs(rid).get(attr_name)
-                if val is None:
-                    continue
-                if allowed is not None and val not in allowed:
-                    continue
-                kept.append(rid)
-            candidates = kept
+            # fragment.go:1191-1208; applied fragment.go:1056-1076). The
+            # filter exists only when BOTH name and values are given
+            # (fragment.go:1029) — attrName alone is a no-op.
+            allowed = set(attr_values if isinstance(attr_values, list)
+                          else [attr_values])
+            candidates = [rid for rid in candidates
+                          if f.row_attrs.attrs(rid).get(attr_name) in allowed]
         if not candidates:
             return []
         pairs = self._exact_counts(index, f, shards, candidates, src_dense, tanimoto)
@@ -843,12 +836,17 @@ class Executor:
                     self._map_node(index, fan_call, node_id, node_shards, set()))
             return self._reduce(call, partials, index, shards)
         # concurrent per-node fan-out — the goroutine-per-node mapper
-        # (executor.go:2256); reduce as responses land
+        # (executor.go:2256); reduce as responses land. Each submit runs in
+        # a fresh context copy: pool threads don't inherit contextvars, so
+        # tracing.current_trace_id would read None and drop the
+        # X-Pilosa-Trace-Id header on remote calls (Context.run is also
+        # non-reentrant, hence one copy per future).
+        import contextvars
         from concurrent.futures import ThreadPoolExecutor
         with ThreadPoolExecutor(max_workers=len(groups)) as pool:
             futures = [
-                pool.submit(self._map_node, index, fan_call, node_id,
-                            node_shards, set())
+                pool.submit(contextvars.copy_context().run, self._map_node,
+                            index, fan_call, node_id, node_shards, set())
                 for node_id, node_shards in groups.items()
             ]
             partials = [p for fut in futures for p in fut.result()]

@@ -37,6 +37,7 @@ class DeviceResidency:
         self.hits = 0
         self.misses = 0
         self.evictions = 0
+        self.epoch = 0  # bumped by clear(); fences in-flight misses
 
     def leaf(self, key: tuple, make: Callable[[], np.ndarray]) -> jax.Array:
         """Return the device array for `key`, uploading via `make()` on miss.
@@ -50,10 +51,17 @@ class DeviceResidency:
                 self._lru.move_to_end(key)
                 self.hits += 1
                 return arr
+            epoch = self.epoch
         host = make()
         arr = self.runner.put_leaf(host)
         with self._lock:
             self.misses += 1
+            if self.epoch != epoch:
+                # clear() ran while make() was in flight (field/index
+                # deleted): the data may be stale — serve it to this caller
+                # but never cache it, or a recreated field reaching an
+                # identical generation tuple could read deleted data
+                return arr
             # concurrent HTTP threads can race the same miss: account for
             # the entry this insert displaces or bytes drift upward forever
             displaced = self._lru.pop(key, None)
@@ -71,6 +79,7 @@ class DeviceResidency:
         with self._lock:
             self._lru.clear()
             self.bytes = 0
+            self.epoch += 1
 
     def snapshot(self) -> dict:
         with self._lock:

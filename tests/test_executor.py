@@ -263,11 +263,12 @@ def test_topn_attr_filter(ex):
     f.import_bits([3] * 1, [1])
     ex.execute("i", 'SetRowAttrs(f, 1, category="x")')
     ex.execute("i", 'SetRowAttrs(f, 2, category="y")')
-    # row 3 has no attrs -> always excluded when attrName given
     top = ex.execute("i", 'TopN(f, n=10, attrName="category", attrValues=["x"])')[0]
     assert list(top) == [(1, 3)]
+    # attrName WITHOUT attrValues is a no-op (fragment.go:1029 builds the
+    # filter only when both are present) — row 3 (no attrs) stays in
     top = ex.execute("i", 'TopN(f, n=10, attrName="category")')[0]
-    assert list(top) == [(1, 3), (2, 2)]
+    assert list(top) == [(1, 3), (2, 2), (3, 1)]
 
 
 def test_residency_cache_hits_and_invalidation(ex):
@@ -305,6 +306,28 @@ def test_residency_eviction():
     # most-recent keys still resident
     r.leaf(("k", 7), mk)
     assert r.snapshot()["hits"] == 1
+
+
+def test_residency_inflight_miss_vs_clear():
+    """A miss whose make() completes after clear() must not re-insert the
+    stale entry: a recreated field reaching an identical generation tuple
+    would otherwise be served deleted data (the collision clear() prevents)."""
+    from pilosa_tpu.parallel.mesh import DeviceRunner
+    from pilosa_tpu.parallel.residency import DeviceResidency
+
+    r = DeviceResidency(DeviceRunner())
+    arr = np.ones((1, SHARD_WIDTH // 32), dtype=np.uint32)
+
+    def make_and_race():
+        r.clear()  # clear() lands while this miss is in flight
+        return arr
+
+    out = r.leaf(("i", "f", 0, 0), make_and_race)
+    assert out is not None  # caller still gets the data...
+    assert r.snapshot()["entries"] == 0  # ...but it was not cached
+    # a normal miss after the clear caches fine
+    r.leaf(("i", "f", 0, 0), lambda: arr)
+    assert r.snapshot()["entries"] == 1
 
 
 def test_residency_bulk_import_invalidates(ex):

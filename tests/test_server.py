@@ -650,6 +650,30 @@ def test_trace_header_propagates_into_spans(server):
     assert any(sp.trace_id == "cafef00d" for sp in spans)
 
 
+def test_trace_propagates_across_concurrent_fanout(cluster3):
+    """The trace id must reach REMOTE nodes through the concurrent per-node
+    fan-out: pool threads don't inherit contextvars, so the executor copies
+    the context per submit (InjectHTTPHeaders analog, tracing.go:22-26)."""
+    from pilosa_tpu.utils.tracing import TRACE_HEADER
+
+    s0 = cluster3[0]
+    jpost(s0.uri, "/index/i", {})
+    jpost(s0.uri, "/index/i/field/f", {})
+    # bits across enough shards that >1 node group participates
+    for c in [5, SHARD_WIDTH + 9, 2 * SHARD_WIDTH + 3, 3 * SHARD_WIDTH + 1]:
+        jpost(s0.uri, "/index/i/query", raw=f"Set({c}, f=1)".encode())
+    req = urllib.request.Request(s0.uri + "/index/i/query",
+                                 data=b"Count(Row(f=1))", method="POST",
+                                 headers={TRACE_HEADER: "feedc0de"})
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        assert resp.status == 200
+    remote_hits = [
+        s for s in cluster3[1:]
+        if any(sp.trace_id == "feedc0de" for sp in s.tracer.finished())
+    ]
+    assert remote_hits, "trace id never reached any remote node"
+
+
 def test_debug_pprof(server):
     """/debug/pprof analog (http/handler.go:242): index, thread stacks, and
     a short sampling profile."""
