@@ -101,6 +101,7 @@ class Executor:
         # dense numpy row (the reference's fragment rowCache analog,
         # fragment.go:112)
         self._row_cache: dict[tuple, np.ndarray] = {}
+        self._row_cache_epoch = 0  # bumped by clear_caches(); fences misses
         # HBM residency manager: query leaves cached as device arrays keyed
         # by content generation; repeat queries run without host->HBM
         # transfers (parallel/residency.py)
@@ -112,6 +113,7 @@ class Executor:
         index/field deletion: a recreated schema object restarts its
         generation counters, so version-keyed entries from the deleted one
         could otherwise collide and serve the old data."""
+        self._row_cache_epoch += 1
         self._row_cache.clear()
         self.residency.clear()
 
@@ -347,8 +349,14 @@ class Executor:
                frag.row_generation(row_id))
         cached = self._row_cache.get(key)
         if cached is None:
+            epoch = self._row_cache_epoch
             cached = frag.row_dense(row_id)
-            self._row_cache[key] = cached
+            if self._row_cache_epoch == epoch:
+                # same fence as DeviceResidency: a clear_caches() that lands
+                # while row_dense() is in flight means this row may belong
+                # to a deleted field whose recreation could reach an
+                # identical generation tuple — serve it, don't cache it
+                self._row_cache[key] = cached
         return cached
 
     def _materialize_range_call(self, index: Index, c: Call, shards) -> np.ndarray:
