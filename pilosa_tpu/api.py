@@ -499,7 +499,9 @@ class API:
 
     def info(self) -> dict:
         import os
+        runner = getattr(self.executor, "runner", None)
         return {"shardWidth": SHARD_WIDTH, "cpuPhysicalCores": os.cpu_count(),
+                "meshDevices": runner.n_devices if runner else 1,
                 "version": __version__}
 
     def version(self) -> str:

@@ -62,6 +62,24 @@ class TracingConfig:
 
 
 @dataclass
+class MeshConfig:
+    """Device-mesh section — the TPU analog of the reference's intra-node
+    shard concurrency (executor.go:2283): slabs shard over a 1-D GSPMD mesh
+    of the node's local chips instead of goroutine-per-shard.
+
+    devices: "auto" = use all local devices when >1, "none" = single-device
+    runner, or an integer count (use the first N local devices).
+    platform: force a jax platform before backend init ("cpu" for CI /
+    virtual meshes; empty = default, i.e. the TPU plugin).
+    host_devices: when >0, force N virtual CPU host devices via XLA_FLAGS —
+    the 8-device test-mesh recipe, exposed as config for CI parity.
+    """
+    devices: str = "auto"
+    platform: str = ""
+    host_devices: int = 0
+
+
+@dataclass
 class Config:
     data_dir: str = "~/.pilosa-tpu"
     bind: str = "localhost:10101"
@@ -74,6 +92,7 @@ class Config:
     metric: MetricConfig = field(default_factory=MetricConfig)
     diagnostics: DiagnosticsConfig = field(default_factory=DiagnosticsConfig)
     tracing: TracingConfig = field(default_factory=TracingConfig)
+    mesh: MeshConfig = field(default_factory=MeshConfig)
 
     @property
     def host(self) -> str:
@@ -94,7 +113,7 @@ class Config:
     def _apply_dict(self, data: dict) -> None:
         for key, value in data.items():
             attr = key.replace("-", "_")
-            if attr in ("tls", "cluster", "anti_entropy", "metric", "diagnostics", "tracing") and isinstance(value, dict):
+            if attr in ("tls", "cluster", "anti_entropy", "metric", "diagnostics", "tracing", "mesh") and isinstance(value, dict):
                 sub = getattr(self, attr)
                 for k, v in value.items():
                     sk = k.replace("-", "_")
@@ -116,7 +135,7 @@ class Config:
 
     def _set_path(self, parts: list[str], raw: str) -> None:
         # try sub-config first (cluster_replicas -> cluster.replicas)
-        for sub_name in ("tls", "cluster", "anti_entropy", "metric", "diagnostics", "tracing"):
+        for sub_name in ("tls", "cluster", "anti_entropy", "metric", "diagnostics", "tracing", "mesh"):
             sub_parts = sub_name.split("_")
             if parts[: len(sub_parts)] == sub_parts and len(parts) > len(sub_parts):
                 sub = getattr(self, sub_name)
@@ -161,6 +180,11 @@ class Config:
             f'sampler-type = "{self.tracing.sampler_type}"',
             f"sampler-param = {self.tracing.sampler_param}",
             f'agent-host-port = "{self.tracing.agent_host_port}"',
+            "",
+            "[mesh]",
+            f'devices = "{self.mesh.devices}"',
+            f'platform = "{self.mesh.platform}"',
+            f"host-devices = {self.mesh.host_devices}",
         ]
         return "\n".join(lines) + "\n"
 

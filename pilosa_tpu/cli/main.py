@@ -35,6 +35,9 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--join", action="store_true",
                     help="join an existing cluster via --cluster-hosts seeds "
                          "(triggers a coordinator resize)")
+    sp.add_argument("--mesh-devices",
+                    help="device mesh: auto (all local devices when >1), "
+                         "none, or an integer count")
     sp.add_argument("--verbose", action="store_true")
 
     ip = sub.add_parser("import", help="bulk-import CSV (row,col or col,value)")
@@ -87,12 +90,24 @@ def cmd_server(args) -> int:
         cfg.cluster.replicas = args.cluster_replicas
     if args.anti_entropy_interval is not None:
         cfg.anti_entropy.interval = args.anti_entropy_interval
+    if getattr(args, "mesh_devices", None):
+        cfg.mesh.devices = args.mesh_devices
 
     import os
+    from pilosa_tpu.parallel.mesh import mesh_from_config
     from pilosa_tpu.server import Server
     data_dir = os.path.expanduser(cfg.data_dir)
+    # build the device mesh BEFORE anything else touches the backend —
+    # platform forcing / virtual-device flags only apply at backend init
+    # (SURVEY §2.9 strategy 2: shard slabs partition over local chips)
+    try:
+        mesh = mesh_from_config(devices=cfg.mesh.devices,
+                                platform=cfg.mesh.platform,
+                                host_devices=cfg.mesh.host_devices)
+    except ValueError as e:
+        raise SystemExit(f"error: building device mesh: {e}")
     server = Server(
-        data_dir, host=cfg.host, port=cfg.port,
+        data_dir, host=cfg.host, port=cfg.port, mesh=mesh,
         cluster_hosts=cfg.cluster.hosts if not cfg.cluster.disabled else None,
         replica_n=cfg.cluster.replicas,
         anti_entropy_interval=cfg.anti_entropy.interval,
@@ -108,8 +123,10 @@ def cmd_server(args) -> int:
         tls_key=cfg.tls.key,
         tls_skip_verify=cfg.tls.skip_verify,
     ).open()
+    mesh_desc = f"{mesh.size}-device mesh" if mesh is not None else "1 device"
     print(f"pilosa-tpu {__version__} serving at {server.uri} "
-          f"(data: {data_dir}, node: {server.node_id})", flush=True)
+          f"(data: {data_dir}, node: {server.node_id}, {mesh_desc})",
+          flush=True)
 
     stop = threading.Event()
 

@@ -16,6 +16,8 @@ channel reduce (executor.go:2183-2321), with XLA collectives replacing HTTP.
 from __future__ import annotations
 
 import functools
+import os
+import re
 from typing import Optional, Sequence
 
 import jax
@@ -33,6 +35,66 @@ def make_mesh(devices: Optional[Sequence] = None, axis: str = SHARD_AXIS) -> Mes
     the reference's node ring (cluster.go:857)."""
     devices = list(devices) if devices is not None else jax.devices()
     return Mesh(np.array(devices), (axis,))
+
+
+def force_platform(platform: str, host_devices: int = 0,
+                   reset: bool = False) -> None:
+    """Force the jax platform BEFORE backend init — the one shared recipe
+    (used by tests/conftest.py, __graft_entry__, and mesh_from_config).
+
+    The TPU plugin overrides the JAX_PLATFORMS env var, so the forcing must
+    go through jax.config; host_devices > 0 additionally requests N virtual
+    CPU host devices via XLA_FLAGS. reset=True drops already-initialized
+    backends so the new flags take effect mid-process.
+    """
+    if host_devices > 0:
+        flags = os.environ.get("XLA_FLAGS", "")
+        flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+                       flags)
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={host_devices}"
+        ).strip()
+    if platform:
+        os.environ["JAX_PLATFORMS"] = platform
+        jax.config.update("jax_platforms", platform)
+    if reset:
+        try:
+            jax.extend.backend.clear_backends()
+        except Exception:
+            pass
+
+
+def mesh_from_config(devices: str = "auto", platform: str = "",
+                     host_devices: int = 0) -> Optional[Mesh]:
+    """Build the production server's mesh from [mesh] config (cli/config.py).
+
+    Must run before any other backend use in the process: platform forcing
+    and the virtual-host-device flag only take effect at backend init.
+    Returns None (single-device DeviceRunner) when the resolved device list
+    has fewer than 2 entries — a 1-device mesh adds tracing overhead for
+    nothing.
+    """
+    if host_devices > 0 and not platform:
+        platform = "cpu"
+    force_platform(platform, host_devices)
+
+    if devices == "none":
+        return None
+    avail = jax.devices()
+    if devices != "auto":
+        try:
+            n = int(devices)
+        except ValueError:
+            raise ValueError(
+                f"[mesh] devices must be 'auto', 'none', or an integer "
+                f"count, got {devices!r}")
+        if n <= 0 or n > len(avail):
+            raise ValueError(
+                f"[mesh] devices = {n} out of range: {len(avail)} available")
+        avail = avail[:n]
+    if len(avail) < 2:
+        return None
+    return make_mesh(avail)
 
 
 # -- program evaluation ------------------------------------------------------

@@ -6,21 +6,15 @@ harness, test/pilosa.go:297-352 MustRunCluster).
 
 Note: this environment exports JAX_PLATFORMS=axon and the axon plugin wins
 over env-var overrides, so the platform is forced via jax.config.update
-(must happen before any backend use; conftest imports run first).
+(must happen before any backend use; conftest imports run first). The
+recipe lives in pilosa_tpu.parallel.mesh.force_platform.
 """
 
-import os
+from pilosa_tpu.parallel.mesh import force_platform
 
-import re
-
-_flags = os.environ.get("XLA_FLAGS", "")
-_flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "", _flags)
-os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
-os.environ["JAX_PLATFORMS"] = "cpu"
+force_platform("cpu", host_devices=8)
 
 import jax  # noqa: E402
-
-jax.config.update("jax_platforms", "cpu")
 
 
 def pytest_sessionstart(session):

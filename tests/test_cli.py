@@ -71,28 +71,13 @@ def test_inspect_and_check(tmp_path, capsys):
 
 @pytest.fixture
 def live_server(tmp_path):
-    """Spawn `pilosa-tpu server` as a real subprocess on a random port."""
-    import socket
-    with socket.socket() as s:
-        s.bind(("localhost", 0))
-        port = s.getsockname()[1]
-    proc = subprocess.Popen(
-        [sys.executable, "-m", "pilosa_tpu.cli", "server",
-         "--data-dir", str(tmp_path / "data"), "--bind", f"localhost:{port}"],
-        stdout=subprocess.PIPE, stderr=subprocess.PIPE)
-    uri = f"http://localhost:{port}"
-    for _ in range(100):
-        try:
-            urllib.request.urlopen(uri + "/version", timeout=1)
-            break
-        except OSError:
-            if proc.poll() is not None:
-                raise RuntimeError(
-                    f"server died: {proc.stderr.read().decode()}")
-            time.sleep(0.2)
-    else:
-        proc.kill()
-        raise RuntimeError("server did not come up")
+    """Spawn `pilosa-tpu server` as a real subprocess on a random port.
+
+    [mesh] platform=cpu via env: the server initializes the backend at
+    startup (mesh auto-detect), and subprocesses can't reach the CPU
+    platform through JAX_PLATFORMS alone (the TPU plugin overrides it)."""
+    proc, uri = _spawn_server(
+        tmp_path, env_extra={"PILOSA_TPU_MESH_PLATFORM": "cpu"})
     yield uri
     proc.terminate()
     proc.wait(timeout=10)
@@ -109,3 +94,121 @@ def test_server_import_export_cli(live_server, tmp_path, capsys):
                  "--field", "f", "-o", str(out_file)]) == 0
     assert sorted(out_file.read_text().strip().splitlines()) == [
         "1,10", "1,20", "2,30"]
+
+
+def _spawn_server(tmp_path, extra_args=(), env_extra=None, wait=True):
+    import os
+    import socket
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        port = s.getsockname()[1]
+    env = dict(os.environ)
+    env.update(env_extra or {})
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "pilosa_tpu.cli", "server",
+         "--data-dir", str(tmp_path / f"data{port}"),
+         "--bind", f"localhost:{port}", *extra_args],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, env=env)
+    uri = f"http://localhost:{port}"
+    if wait:
+        _wait_up(proc, uri)
+    return proc, uri
+
+
+def _wait_up(proc, uri):
+    for _ in range(150):
+        try:
+            urllib.request.urlopen(uri + "/version", timeout=1)
+            return
+        except OSError:
+            if proc.poll() is not None:
+                raise RuntimeError(
+                    f"server died: {proc.stderr.read().decode()}")
+            time.sleep(0.2)
+    proc.kill()
+    raise RuntimeError("server did not come up")
+
+
+def _post_query(uri, index, pql):
+    req = urllib.request.Request(f"{uri}/index/{index}/query",
+                                 data=pql.encode(), method="POST")
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        return json.loads(resp.read())
+
+
+def test_server_mesh_e2e(tmp_path):
+    """The stock binary shards slabs over a GSPMD mesh (VERDICT round-1 #3:
+    cmd_server previously always ran DeviceRunner(mesh=None)). Drives the
+    real HTTP server over the 8-device virtual CPU mesh and asserts sharded
+    execution + result parity against a meshless server."""
+    from pilosa_tpu.constants import SHARD_WIDTH
+
+    env = {"PILOSA_TPU_MESH_HOST_DEVICES": "8"}  # implies platform=cpu
+    # launch both, then poll both: overlaps the two backend cold-starts
+    proc, uri = _spawn_server(tmp_path, ["--mesh-devices", "auto"], env,
+                              wait=False)
+    proc2, uri2 = _spawn_server(tmp_path, ["--mesh-devices", "none"], env,
+                                wait=False)
+    try:
+        _wait_up(proc, uri)
+        _wait_up(proc2, uri2)
+        with urllib.request.urlopen(uri + "/info", timeout=5) as resp:
+            info = json.loads(resp.read())
+        assert info["meshDevices"] == 8, info
+        with urllib.request.urlopen(uri2 + "/info", timeout=5) as resp:
+            assert json.loads(resp.read())["meshDevices"] == 1
+
+        for u in (uri, uri2):
+            for path in ("/index/i", "/index/i/field/f"):
+                req = urllib.request.Request(u + path, data=b"{}",
+                                             method="POST")
+                urllib.request.urlopen(req, timeout=10)
+            # bits across 10 shards so the slab genuinely partitions
+            # (8-device mesh pads 10 -> 16 shard slots)
+            for s in range(10):
+                _post_query(u, "i", f"Set({s * SHARD_WIDTH + s}, f=1)")
+                _post_query(u, "i", f"Set({s * SHARD_WIDTH + 7}, f=2)")
+        for q in ("Count(Row(f=1))",
+                  "Count(Intersect(Row(f=1), Row(f=2)))",
+                  "Count(Union(Row(f=1), Row(f=2)))",
+                  "TopN(f, n=3)"):
+            meshed = _post_query(uri, "i", q)
+            single = _post_query(uri2, "i", q)
+            assert meshed == single, (q, meshed, single)
+        assert meshed["results"]  # sanity: last query returned data
+    finally:
+        proc.terminate()
+        proc2.terminate()
+        proc.wait(timeout=10)
+        proc2.wait(timeout=10)
+
+
+def test_mesh_config_sources(tmp_path, monkeypatch):
+    cfg = Config()
+    assert cfg.mesh.devices == "auto" and cfg.mesh.host_devices == 0
+    toml = tmp_path / "c.toml"
+    toml.write_text('[mesh]\ndevices = "4"\nplatform = "cpu"\n'
+                    "host-devices = 8\n")
+    cfg = load_config(str(toml), environ={})
+    assert (cfg.mesh.devices, cfg.mesh.platform, cfg.mesh.host_devices) == \
+        ("4", "cpu", 8)
+    cfg = load_config(str(toml),
+                      environ={"PILOSA_TPU_MESH_DEVICES": "none",
+                               "PILOSA_TPU_MESH_HOST_DEVICES": "2"})
+    assert cfg.mesh.devices == "none" and cfg.mesh.host_devices == 2
+    # round-trips through generate-config
+    assert "[mesh]" in cfg.to_toml()
+
+
+def test_mesh_from_config_variants():
+    from pilosa_tpu.parallel.mesh import mesh_from_config
+
+    assert mesh_from_config(devices="none") is None
+    m = mesh_from_config(devices="auto")  # conftest: 8 virtual cpu devices
+    assert m is not None and m.size == 8
+    m = mesh_from_config(devices="4")
+    assert m is not None and m.size == 4
+    with pytest.raises(ValueError, match="integer"):
+        mesh_from_config(devices="bogus")
+    with pytest.raises(ValueError, match="available"):
+        mesh_from_config(devices="999")
