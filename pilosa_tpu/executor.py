@@ -541,7 +541,9 @@ class Executor:
         f = index.field(field_name)
         if f is None:
             raise ExecutionError(f"field not found: {field_name}")
-        n = call.uint_arg("n")
+        # explicit n=0 means unlimited, same as omitting it (the reference's
+        # opt.N zero value, executor.go:694)
+        n = call.uint_arg("n") or None
         shards = self._query_shards(index, shards)
 
         src_dense = None

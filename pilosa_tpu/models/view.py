@@ -48,7 +48,8 @@ class View:
         frag_dir = os.path.join(self.path, "fragments")
         if os.path.isdir(frag_dir):
             for fname in os.listdir(frag_dir):
-                if fname.endswith(".cache") or fname.endswith(".snapshotting") or fname.endswith(".tmp"):
+                if fname.endswith((".cache", ".snapshotting", ".tmp",
+                                   ".lock")):
                     continue
                 try:
                     shard = int(fname)
@@ -113,7 +114,8 @@ class View:
         if frag is None:
             return
         frag.close()
-        for p in (frag.path, frag.path + ".cache", frag.path + ".snapshotting"):
+        for p in (frag.path, frag.path + ".cache", frag.path + ".snapshotting",
+                  frag.path + ".lock"):
             if os.path.exists(p):
                 os.remove(p)
         self.rank_caches.pop(shard, None)

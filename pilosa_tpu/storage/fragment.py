@@ -21,8 +21,10 @@ Row r of the shard occupies absolute bit positions [r*2^20, (r+1)*2^20)
 
 from __future__ import annotations
 
+import fcntl
 import hashlib
 import io
+import mmap
 import os
 import struct
 import tarfile
@@ -39,6 +41,7 @@ from pilosa_tpu.storage.roaring import Bitmap
 
 SNAPSHOT_EXT = ".snapshotting"
 CACHE_EXT = ".cache"
+LOCK_EXT = ".lock"
 
 
 def pos(row_id: int, column: int) -> int:
@@ -58,6 +61,8 @@ class Fragment:
         self.storage = Bitmap()
         self.op_n = 0
         self._op_file = None
+        self._lock_file = None
+        self._mmap = None
         self.closed = True
         # Row generations: bumped on any mutation touching the row; the
         # device cache keys on (fragment key, row, generation) — the analog
@@ -76,35 +81,80 @@ class Fragment:
     # -- lifecycle ----------------------------------------------------------
 
     def open(self) -> "Fragment":
+        """Open: flock + mmap + lazy parse (openStorage, fragment.go:190-247:
+        mmap(PROT_READ) + flock + MADV_RANDOM + zero-copy unmarshal).
+
+        The exclusive lock lives on a sidecar `<path>.lock` file that is
+        never replaced — snapshot() os.replace()s the data file's inode, and
+        locking the data file itself would open a window where two processes
+        hold "the" lock on different inodes. A second opener fails fast
+        instead of silently corrupting the data-dir. Container payloads stay
+        in the mmap until first access (LazyContainer), so holder open cost
+        is proportional to container *metadata*, not data bytes.
+        """
         os.makedirs(os.path.dirname(self.path), exist_ok=True)
-        data = b""
-        if os.path.exists(self.path):
-            with open(self.path, "rb") as f:
-                data = f.read()
-        if data:
-            self.storage = Bitmap.from_bytes(data)
+        self._lock_file = open(self.path + LOCK_EXT, "ab")
+        try:
+            fcntl.flock(self._lock_file.fileno(),
+                        fcntl.LOCK_EX | fcntl.LOCK_NB)
+        except OSError:
+            self._lock_file.close()
+            self._lock_file = None
+            raise RuntimeError(
+                f"fragment file locked by another process: {self.path}")
+        try:
+            self._op_file = open(self.path, "ab")
+            if os.path.getsize(self.path) == 0:
+                # Seed an empty snapshot header so the WAL has something to
+                # append to (openStorage marshals the empty bitmap into a
+                # fresh file, fragment.go:190-247).
+                self.storage.write_to(self._op_file)
+                self._op_file.flush()
+            self._map()
+        except Exception:
+            # don't leak the lock/handles on a corrupt file — and don't
+            # mask the parse error with a bogus "locked" on retry
+            if self._op_file is not None:
+                self._op_file.close()
+                self._op_file = None
+            self._lock_file.close()
+            self._lock_file = None
+            raise
+        self.op_n = self.storage.op_n
+        if self.op_n:
             # op-log replay can leave stale encodings (array grown past
             # ARRAY_MAX_SIZE etc.) — normalize like Containers.Repair
-            # (roaring/roaring.go:106, 2093-2113)
+            # (roaring/roaring.go:106, 2093-2113); replay only touches the
+            # mutated containers, so laziness survives
             self.storage.repair()
-            self.op_n = self.storage.op_n
-        else:
-            # Seed an empty snapshot header so the WAL has something to
-            # append to (openStorage marshals the empty bitmap into a fresh
-            # file, fragment.go:190-247).
-            with open(self.path, "wb") as f:
-                self.storage.write_to(f)
-        self._op_file = open(self.path, "ab")
         self.storage.op_writer = self._op_file
         self.closed = False
         return self
 
+    def _map(self) -> None:
+        """(Re)map the file and lazy-parse it into self.storage."""
+        with open(self.path, "rb") as f:
+            mm = mmap.mmap(f.fileno(), 0, prot=mmap.PROT_READ)
+        if hasattr(mm, "madvise"):
+            mm.madvise(mmap.MADV_RANDOM)  # fragment.go:2391 madvise
+        self.storage = Bitmap.from_bytes(mm, lazy=True)
+        self._mmap = mm
+
     def close(self) -> None:
         if self._op_file is not None:
             self._op_file.flush()
-            self._op_file.close()
+            self._op_file.close()  # releases the flock
             self._op_file = None
         self.storage.op_writer = None
+        # close the mapping WITHOUT materializing: shutdown must not read
+        # the whole file; later access to a still-lazy container of a
+        # closed fragment raises loudly ("mmap closed"), never corrupts
+        if self._mmap is not None:
+            self._mmap.close()
+            self._mmap = None
+        if self._lock_file is not None:
+            self._lock_file.close()  # releases the flock
+            self._lock_file = None
         self.closed = True
 
     # -- mutation -----------------------------------------------------------
@@ -316,6 +366,9 @@ class Fragment:
             self._op_file.close()
             self._op_file = None
         with open(tmp, "wb") as f:
+            # still-lazy containers pass their raw payloads straight from
+            # the old mmap (LazyContainer.best_encoding) — unread data is
+            # never parsed, only copied
             self.storage.write_to(f)
             f.flush()
             os.fsync(f.fileno())
@@ -323,8 +376,29 @@ class Fragment:
         self.op_n = 0
         self.storage.op_n = 0
         if not self.closed:
+            # the sidecar lock is held throughout — no ownership window
             self._op_file = open(self.path, "ab")
+            self._remap_after_snapshot()
             self.storage.op_writer = self._op_file
+
+    def _remap_after_snapshot(self) -> None:
+        """Swap storage onto the freshly-written file (the reference remaps
+        after snapshot, fragment.go:1737-1781): lazy entries re-point at the
+        new mmap; already-materialized containers carry over as-is (their
+        content was just written). The old mapping closes immediately —
+        nothing references it afterwards."""
+        from pilosa_tpu.storage.roaring import LazyContainer
+
+        old_mm = self._mmap
+        old = self.storage
+        self._map()  # fresh lazy parse of the new file
+        for key, c in old.containers.items():
+            if not isinstance(c, LazyContainer):
+                self.storage.containers[key] = c
+            elif c.materialized:
+                self.storage.containers[key] = c._real
+        if old_mm is not None:
+            old_mm.close()
 
     # -- anti-entropy block checksums (fragment.go:1226-1443) ---------------
 

@@ -248,6 +248,102 @@ class Container:
         raise ValueError(f"unknown container type {type_code}")
 
 
+class LazyContainer:
+    """A container whose payload still lives in the mmapped snapshot.
+
+    The mmap storage lifecycle (fragment.go:190-247: mmap + MADV_RANDOM +
+    zero-copy UnmarshalBinary) means holder open must be O(#containers
+    metadata), not O(payload bytes): this handle records (type, cardinality,
+    buffer window) from the descriptive header and parses the payload only
+    on first data access. Cardinality reads (`n`) never materialize — full
+    container-aligned row counts (rank-cache build, count_range) stay lazy.
+
+    Mutation paths replace the entry with a real Container via the normal
+    _store() flow; `best_encoding` passes the raw payload through untouched
+    so snapshots of unread containers never parse them either.
+    """
+
+    __slots__ = ("code", "card", "buf", "offset", "size", "_real")
+
+    def __init__(self, code: int, card: int, buf, offset: int, size: int):
+        self.code = code
+        self.card = card
+        self.buf = buf
+        self.offset = offset
+        self.size = size
+        self._real: Optional[Container] = None
+
+    def _ensure(self) -> Container:
+        if self._real is None:
+            mv = memoryview(self.buf)[self.offset : self.offset + self.size]
+            self._real, _ = Container.from_payload(self.code, self.card, mv)
+        return self._real
+
+    @property
+    def materialized(self) -> bool:
+        return self._real is not None
+
+    @property
+    def n(self) -> int:
+        return self.card if self._real is None else self._real.n
+
+    @property
+    def kind(self) -> str:
+        return self._ensure().kind
+
+    @property
+    def data(self) -> np.ndarray:
+        return self._ensure().data
+
+    def values(self) -> np.ndarray:
+        return self._ensure().values()
+
+    def words(self) -> np.ndarray:
+        return self._ensure().words()
+
+    def contains(self, v: int) -> bool:
+        return self._ensure().contains(v)
+
+    def _normalize(self):
+        # snapshot encodings were normalized at write time; don't parse
+        return self
+
+    def _runs(self) -> np.ndarray:
+        return self._ensure()._runs()
+
+    def add_many(self, vals: np.ndarray) -> Container:
+        return self._ensure().add_many(vals)
+
+    def remove_many(self, vals: np.ndarray) -> Container:
+        return self._ensure().remove_many(vals)
+
+    def op(self, other, kind: str) -> Container:
+        return self._ensure().op(other, kind)
+
+    def op_count(self, other, kind: str) -> int:
+        return self._ensure().op_count(other, kind)
+
+    def best_encoding(self):
+        if self._real is not None:
+            return self._real.best_encoding()
+        return self.code, bytes(
+            memoryview(self.buf)[self.offset : self.offset + self.size])
+
+
+def _payload_size(code: int, card: int, buf, offset: int) -> int:
+    """Byte length of a container payload without parsing it."""
+    if code == TYPE_ARRAY:
+        return 2 * card
+    if code == TYPE_BITMAP:
+        return 8 * BITMAP_WORDS
+    if code == TYPE_RUN:
+        if offset + 2 > len(buf):
+            raise ValueError("run container header out of bounds")
+        (nruns,) = struct.unpack_from("<H", buf, offset)
+        return 2 + 4 * nruns
+    raise ValueError(f"unknown container type {code}")
+
+
 class Bitmap:
     """64-bit roaring bitmap: {key = position >> 16} -> Container.
 
@@ -585,15 +681,20 @@ class Bitmap:
         return buf.getvalue()
 
     @classmethod
-    def from_bytes(cls, data: bytes) -> "Bitmap":
+    def from_bytes(cls, data, lazy: bool = False) -> "Bitmap":
         """Parse either Pilosa format (magic 12348, + trailing op-log replay,
         roaring/roaring.go:886-975) or the official RoaringFormatSpec
-        (cookies 12346/12347, roaring/roaring.go:3825-3985)."""
+        (cookies 12346/12347, roaring/roaring.go:3825-3985).
+
+        lazy=True (Pilosa format only — `data` should be an mmap) defers
+        container payload parsing to first access via LazyContainer: the
+        zero-copy UnmarshalBinary analog (fragment.go:224)."""
         if len(data) < HEADER_BASE_SIZE:
             raise ValueError("data too small")
         (magic,) = struct.unpack_from("<H", data, 0)
         if magic != MAGIC_NUMBER:
-            return cls._from_official_bytes(data)
+            return cls._from_official_bytes(
+                data if isinstance(data, bytes) else bytes(data))
         _, version, key_n = struct.unpack_from("<HHI", data, 0)
         if version != STORAGE_VERSION:
             raise ValueError(f"wrong roaring version, file is v{version}")
@@ -611,8 +712,18 @@ class Bitmap:
             (offset,) = struct.unpack_from("<I", data, off_off + i * 4)
             if offset >= len(data):
                 raise ValueError(f"offset out of bounds: off={offset}, len={len(data)}")
-            c, consumed = Container.from_payload(code, n_minus_1 + 1, mv[offset:])
-            b._store(int(key), c)
+            if lazy:
+                size = _payload_size(code, n_minus_1 + 1, data, offset)
+                if offset + size > len(data):
+                    raise ValueError(
+                        f"container payload out of bounds: off={offset}, "
+                        f"size={size}, len={len(data)}")
+                b._store(int(key),
+                         LazyContainer(code, n_minus_1 + 1, data, offset, size))
+                consumed = size
+            else:
+                c, consumed = Container.from_payload(code, n_minus_1 + 1, mv[offset:])
+                b._store(int(key), c)
             ops_offset = offset + consumed
         # Trailing op-log replay — batched native parse when available
         # (order-preserving runs applied via the bulk paths).

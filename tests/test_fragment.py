@@ -185,3 +185,106 @@ def test_snapshot_atomic_file(frag):
     g = reopen(frag)
     assert g.contains(0, 1) and g.op_n == 0
     g.close()
+
+
+# ---------------------------------------------------------------------------
+# mmap + flock storage lifecycle (fragment.go:190-247; VERDICT r1 item 5)
+# ---------------------------------------------------------------------------
+
+
+def _lazy_stats(frag):
+    from pilosa_tpu.storage.roaring import LazyContainer
+    lazy = mat = eager = 0
+    for c in frag.storage.containers.values():
+        if isinstance(c, LazyContainer):
+            if c.materialized:
+                mat += 1
+            else:
+                lazy += 1
+        else:
+            eager += 1
+    return lazy, mat, eager
+
+
+def test_open_is_lazy_and_rank_build_stays_lazy(tmp_path):
+    """Holder-open cost is O(container metadata): after open + rank-cache
+    style row counting, no container payload has been parsed."""
+    frag = Fragment(str(tmp_path / "f"), "i", "f", "standard", 0).open()
+    rows = np.repeat(np.arange(50), 3000)
+    cols = np.tile(np.arange(3000) * 17 % SHARD_WIDTH, 50)
+    frag.bulk_import(rows.tolist(), cols.tolist())
+    frag.close()
+
+    frag = Fragment(str(tmp_path / "f"), "i", "f", "standard", 0).open()
+    lazy, mat, eager = _lazy_stats(frag)
+    assert lazy > 0 and mat == 0 and eager == 0, (lazy, mat, eager)
+    # rank-cache build pattern: row_ids + row_count — container-aligned
+    # count_range uses descriptor cardinality, no payload parse
+    for rid in frag.row_ids():
+        assert frag.row_count(rid) == 3000
+    lazy2, mat2, _ = _lazy_stats(frag)
+    assert mat2 == 0, "row counting materialized containers"
+    # a real read materializes only that row's containers
+    got = np.flatnonzero(
+        np.unpackbits(frag.row_dense(7).view(np.uint8), bitorder="little"))
+    assert got.size == 3000
+    _, mat3, _ = _lazy_stats(frag)
+    assert 0 < mat3 <= SHARD_WIDTH // (1 << 16)
+    frag.close()
+
+
+def test_flock_second_opener_refused(tmp_path):
+    frag = Fragment(str(tmp_path / "f"), "i", "f", "standard", 0).open()
+    frag.set_bit(1, 5)
+    with pytest.raises(RuntimeError, match="locked"):
+        Fragment(str(tmp_path / "f"), "i", "f", "standard", 0).open()
+    frag.close()
+    # released on close
+    frag2 = Fragment(str(tmp_path / "f"), "i", "f", "standard", 0).open()
+    assert frag2.contains(1, 5)
+    frag2.close()
+
+
+def test_flock_second_process_refused(tmp_path):
+    import subprocess
+    import sys
+
+    frag = Fragment(str(tmp_path / "f"), "i", "f", "standard", 0).open()
+    frag.set_bit(1, 5)
+    code = (
+        "from pilosa_tpu.storage.fragment import Fragment\n"
+        "try:\n"
+        f"    Fragment({str(tmp_path / 'f')!r}, 'i', 'f', 'standard', 0).open()\n"
+        "    print('OPENED')\n"
+        "except RuntimeError as e:\n"
+        "    print('REFUSED:', e)\n"
+    )
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                         text=True, cwd=repo_root)
+    assert "REFUSED" in out.stdout, (out.stdout, out.stderr)
+    frag.close()
+
+
+def test_snapshot_remaps_and_preserves_laziness(tmp_path):
+    """After a WAL-compaction snapshot, unread containers re-point at the
+    new mapping without ever being parsed; data stays correct."""
+    frag = Fragment(str(tmp_path / "f"), "i", "f", "standard", 0).open()
+    rows = np.repeat(np.arange(20), 5000)
+    cols = np.tile((np.arange(5000) * 13) % SHARD_WIDTH, 20)
+    frag.bulk_import(rows.tolist(), cols.tolist())
+    frag.close()
+
+    frag = Fragment(str(tmp_path / "f"), "i", "f", "standard", 0).open()
+    frag.set_bit(0, 1)  # touch row 0 only
+    frag.snapshot()
+    lazy, mat, eager = _lazy_stats(frag)
+    # row 0's containers were materialized by the write and carried over;
+    # everything else re-lazied onto the new mmap
+    assert lazy > 0 and (mat + eager) <= SHARD_WIDTH // (1 << 16) + 1
+    assert frag.contains(0, 1)
+    assert frag.row_count(5) == 5000
+    frag.close()
+    frag = Fragment(str(tmp_path / "f"), "i", "f", "standard", 0).open()
+    assert frag.contains(0, 1) and frag.row_count(5) == 5000
+    frag.close()

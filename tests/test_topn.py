@@ -207,3 +207,18 @@ def test_topn_src_tie_breaks_by_id(tmp_path):
     top = ex.execute("i", "TopN(f, Row(g=7), n=1)")[0]
     assert list(top) == [(2, 3)]
     ex.holder.close()
+
+
+def test_topn_n_zero_means_all(tmp_path):
+    """Explicit n=0 is the reference's zero value: unlimited results, with
+    and without a Src bitmap (executor.go:694)."""
+    ex = _make_executor(tmp_path)
+    idx = ex.holder.create_index("i")
+    f = idx.create_field("f")
+    g = idx.create_field("g")
+    f.import_bits([1, 1, 2], [1, 2, 1])
+    g.import_bits([7, 7], [1, 2])
+    assert list(ex.execute("i", "TopN(f, n=0)")[0]) == [(1, 2), (2, 1)]
+    assert list(ex.execute("i", "TopN(f, Row(g=7), n=0)")[0]) == \
+        [(1, 2), (2, 1)]
+    ex.holder.close()
