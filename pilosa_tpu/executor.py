@@ -1066,7 +1066,9 @@ class Executor:
             return best or ValCount(0, 0)
         if call.name == "TopN":
             merged = merge_pairs(partials)
-            n = call.uint_arg("n")
+            # n=0 is the reference zero value: unlimited (same mapping as
+            # the single-node path, _execute_topn)
+            n = call.uint_arg("n") or None
             if n is not None and call.uint_slice_arg("ids") is None and index is not None:
                 # phase 2: exact recount of winning ids on the query's shards
                 # (executor.go:694-761)

@@ -365,11 +365,16 @@ class Fragment:
             self._op_file.flush()
             self._op_file.close()
             self._op_file = None
+        # re-pick in-memory encodings (introduces run containers where
+        # smallest — roaring.go:1594 Optimize before write); lazy entries
+        # keep their already-optimal on-disk encoding
+        self.storage.optimize()
         with open(tmp, "wb") as f:
             # still-lazy containers pass their raw payloads straight from
-            # the old mmap (LazyContainer.best_encoding) — unread data is
-            # never parsed, only copied
-            self.storage.write_to(f)
+            # the old mmap — unread data is never parsed, only copied; the
+            # optimize() above already picked encodings, so write skips a
+            # second selection scan
+            self.storage.write_to(f, optimized=True)
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, self.path)

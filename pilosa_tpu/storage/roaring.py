@@ -73,13 +73,35 @@ def _words_to_array(words: np.ndarray) -> np.ndarray:
     return native.bits_to_array(words)
 
 
+def _runs_to_words(iv: np.ndarray) -> np.ndarray:
+    """[nruns, 2] (start, last) -> uint64[1024] dense words."""
+    bits = np.zeros(CONTAINER_BITS, dtype=np.uint8)
+    for s, last in iv.astype(np.int64):
+        bits[s : last + 1] = 1
+    packed = np.packbits(bits, bitorder="little")
+    return np.frombuffer(packed.tobytes(), dtype="<u8").copy()
+
+
+def _runs_to_values(iv: np.ndarray) -> np.ndarray:
+    """[nruns, 2] (start, last) -> sorted uint16 members."""
+    if iv.shape[0] == 0:
+        return np.empty(0, dtype=np.uint16)
+    return np.concatenate([
+        np.arange(s, last + 1, dtype=np.uint16)
+        for s, last in iv.astype(np.int64)
+    ])
+
+
 class Container:
-    """One 2^16-bit container: sorted uint16 array or uint64[1024] bitmap."""
+    """One 2^16-bit container: sorted uint16 array, uint64[1024] bitmap, or
+    [nruns, 2] (start, last) run intervals — all three in-memory, matching
+    the reference (roaring/roaring.go:56-62): a fully-set time-view
+    container costs 4 bytes as one run, not 8 KiB as a bitmap."""
 
     __slots__ = ("kind", "data")
 
     def __init__(self, kind: str, data: np.ndarray):
-        self.kind = kind  # "array" | "bitmap"
+        self.kind = kind  # "array" | "bitmap" | "run"
         self.data = data
 
     # -- constructors -------------------------------------------------------
@@ -102,33 +124,67 @@ class Container:
     def n(self) -> int:
         if self.kind == "array":
             return int(self.data.size)
+        if self.kind == "run":
+            iv = self.data.astype(np.int64)
+            return int(np.sum(iv[:, 1] - iv[:, 0] + 1)) if iv.size else 0
         return int(np.sum(np.bitwise_count(self.data)))
 
     def values(self) -> np.ndarray:
         """Sorted uint16 members."""
         if self.kind == "array":
             return self.data
+        if self.kind == "run":
+            return _runs_to_values(self.data)
         return _words_to_array(self.data)
 
     def words(self) -> np.ndarray:
         """uint64[1024] little-endian dense form."""
         if self.kind == "bitmap":
             return self.data
+        if self.kind == "run":
+            return _runs_to_words(self.data)
         return _array_to_words(self.data)
 
     def contains(self, v: int) -> bool:
         if self.kind == "array":
             i = np.searchsorted(self.data, v)
             return bool(i < self.data.size and self.data[i] == v)
+        if self.kind == "run":
+            starts = self.data[:, 0]
+            i = int(np.searchsorted(starts, v, side="right")) - 1
+            return bool(i >= 0 and v <= int(self.data[i, 1]))
         return bool((int(self.data[v >> 6]) >> (v & 63)) & 1)
 
     def _normalize(self) -> "Container":
-        """Re-pick encoding after mutation (optimize(), roaring/roaring.go:1594)."""
+        """Re-pick array-vs-bitmap after mutation. Run selection is NOT done
+        here (it needs a full interval scan): optimize() handles it at
+        snapshot time, like the reference (roaring/roaring.go:1594)."""
+        if self.kind == "run":
+            return self
         if self.kind == "bitmap" and self.n <= ARRAY_MAX_SIZE:
             return Container("array", _words_to_array(self.data))
         if self.kind == "array" and self.data.size > ARRAY_MAX_SIZE:
             return Container("bitmap", _array_to_words(self.data))
         return self
+
+    def optimize(self) -> "Container":
+        """Pick the smallest of the three encodings (optimize()/countRuns
+        heuristic, roaring/roaring.go:1594,1776-1950); called on snapshot."""
+        runs = self._runs()
+        n = self.n
+        sizes = {
+            "array": 2 * n,
+            "bitmap": 8 * BITMAP_WORDS,
+            "run": 2 + 4 * runs.shape[0],
+        }
+        best = min(sizes, key=lambda k: (sizes[k], k))
+        if best == self.kind:
+            return self
+        if best == "run":
+            return Container("run", runs)
+        if best == "array":
+            return Container("array", self.values())  # fresh: kind != array
+        return Container("bitmap", self.words())
 
     # -- mutation (returns possibly re-encoded container) -------------------
 
@@ -137,7 +193,8 @@ class Container:
         if self.kind == "array":
             merged = np.union1d(self.data, vals)
             return Container.from_values(merged)
-        words = self.data.copy()
+        # run: words() is already a fresh buffer; bitmap: copy before mutate
+        words = self.data.copy() if self.kind == "bitmap" else self.words()
         idx = vals.astype(np.int64)
         np.bitwise_or.at(words, idx >> 6, np.uint64(1) << (idx & 63).astype(np.uint64))
         return Container("bitmap", words)._normalize()
@@ -147,7 +204,7 @@ class Container:
         if self.kind == "array":
             keep = self.data[~np.isin(self.data, vals)]
             return Container("array", keep)
-        words = self.data.copy()
+        words = self.data.copy() if self.kind == "bitmap" else self.words()
         idx = np.unique(vals).astype(np.int64)
         np.bitwise_and.at(words, idx >> 6, ~(np.uint64(1) << (idx & 63).astype(np.uint64)))
         return Container("bitmap", words)._normalize()
@@ -189,6 +246,8 @@ class Container:
 
     def _runs(self) -> np.ndarray:
         """[nruns, 2] (start, last) intervals of the sorted member array."""
+        if self.kind == "run":
+            return self.data
         vals = self.values().astype(np.int64)
         if vals.size == 0:
             return np.empty((0, 2), dtype=np.uint16)
@@ -197,23 +256,21 @@ class Container:
         ends = np.concatenate((breaks, [vals.size - 1]))
         return np.stack([vals[starts], vals[ends]], axis=1).astype(np.uint16)
 
+    def encode_current(self):
+        """(type_code, payload_bytes) in the container's CURRENT encoding —
+        no selection scan; callers that just ran optimize() use this."""
+        if self.kind == "array":
+            return TYPE_ARRAY, self.values().astype("<u2").tobytes()
+        if self.kind == "run":
+            runs = self.data
+            return TYPE_RUN, struct.pack("<H", runs.shape[0]) + \
+                runs.astype("<u2").tobytes()
+        return TYPE_BITMAP, self.words().astype("<u8").tobytes()
+
     def best_encoding(self):
-        """(type_code, payload_bytes) — smallest of array/bitmap/run."""
-        n = self.n
-        runs = self._runs()
-        sizes = {
-            TYPE_ARRAY: 2 * n,
-            TYPE_BITMAP: 8 * BITMAP_WORDS,
-            TYPE_RUN: 2 + 4 * runs.shape[0],
-        }
-        code = min(sizes, key=lambda k: (sizes[k], k))
-        if code == TYPE_ARRAY:
-            payload = self.values().astype("<u2").tobytes()
-        elif code == TYPE_BITMAP:
-            payload = self.words().astype("<u8").tobytes()
-        else:
-            payload = struct.pack("<H", runs.shape[0]) + runs.astype("<u2").tobytes()
-        return code, payload
+        """(type_code, payload_bytes) — smallest of array/bitmap/run. One
+        selection scan shared with optimize()."""
+        return self.optimize().encode_current()
 
     @classmethod
     def from_payload(cls, type_code: int, n: int, buf: memoryview) -> tuple["Container", int]:
@@ -236,15 +293,11 @@ class Container:
             need(2)
             (nruns,) = struct.unpack_from("<H", buf, 0)
             need(2 + 4 * nruns)
-            iv = np.frombuffer(buf[2 : 2 + 4 * nruns], dtype="<u2").reshape(nruns, 2)
-            total = int(np.sum(iv[:, 1].astype(np.int64) - iv[:, 0].astype(np.int64) + 1)) if nruns else 0
-            vals = np.empty(total, dtype=np.uint16)
-            pos = 0
-            for start, last in iv.astype(np.int64):
-                ln = last - start + 1
-                vals[pos : pos + ln] = np.arange(start, last + 1, dtype=np.uint16)
-                pos += ln
-            return cls.from_values(vals), 2 + 4 * nruns
+            # runs stay runs in memory (roaring/roaring.go:56-62) — a dense
+            # time-view container is 4 bytes here, not 8 KiB inflated
+            iv = np.frombuffer(buf[2 : 2 + 4 * nruns], dtype="<u2") \
+                .reshape(nruns, 2).copy()
+            return cls("run", iv), 2 + 4 * nruns
         raise ValueError(f"unknown container type {type_code}")
 
 
@@ -326,6 +379,12 @@ class LazyContainer:
     def best_encoding(self):
         if self._real is not None:
             return self._real.best_encoding()
+        return self.code, bytes(
+            memoryview(self.buf)[self.offset : self.offset + self.size])
+
+    def encode_current(self):
+        if self._real is not None:
+            return self._real.encode_current()
         return self.code, bytes(
             memoryview(self.buf)[self.offset : self.offset + self.size])
 
@@ -446,6 +505,10 @@ class Bitmap:
                 idx = np.searchsorted(c.data, lo)
                 idx_c = np.minimum(idx, c.data.size - 1)
                 ok = (idx < c.data.size) & (c.data[idx_c] == lo)
+            elif c.kind == "run":
+                i = np.searchsorted(c.data[:, 0], lo, side="right") - 1
+                i_c = np.maximum(i, 0)
+                ok = (i >= 0) & (lo <= c.data[i_c, 1])
             else:
                 li = lo.astype(np.int64)
                 w = c.data[li >> 6]
@@ -655,14 +718,19 @@ class Bitmap:
 
     # -- serialization ------------------------------------------------------
 
-    def write_to(self, w) -> int:
+    def write_to(self, w, optimized: bool = False) -> int:
         """Serialize in Pilosa roaring format (no op-log section — a fresh
-        snapshot has an empty WAL, fragment.go:1737)."""
+        snapshot has an empty WAL, fragment.go:1737).
+
+        optimized=True skips per-container encoding selection (serialize
+        each container's current kind) — for callers that just ran
+        optimize(), avoiding a second selection scan per snapshot."""
         keys = sorted(k for k, c in self.containers.items() if c.n > 0)
         encs = []
         for k in keys:
             c = self.containers[k]
-            code, payload = c.best_encoding()
+            code, payload = c.encode_current() if optimized \
+                else c.best_encoding()
             encs.append((k, code, c.n, payload))
         header = struct.pack("<HHI", MAGIC_NUMBER, STORAGE_VERSION, len(keys))
         desc = b"".join(struct.pack("<QHH", k, code, n - 1) for k, code, n, _ in encs)
@@ -818,16 +886,34 @@ class Bitmap:
                 if kind == TYPE_RUN:
                     (nruns,) = struct.unpack_from("<H", data, pos)
                     iv = np.frombuffer(mv[pos + 2 : pos + 2 + 4 * nruns], dtype="<u2").reshape(nruns, 2).astype(np.int64)
-                    vals = np.concatenate(
-                        [np.arange(s, s + ln + 1, dtype=np.uint16) for s, ln in iv]
-                    ) if nruns else np.empty(0, dtype=np.uint16)
-                    b._store(key, Container.from_values(vals))
+                    # official runs are (start, length); ours are (start, last)
+                    runs = np.stack([iv[:, 0], iv[:, 0] + iv[:, 1]],
+                                    axis=1).astype(np.uint16)
+                    b._store(key, Container("run", runs))
                     pos += 2 + 4 * nruns
                 else:
                     c, consumed = Container.from_payload(kind, card, mv[pos:])
                     b._store(key, c)
                     pos += consumed
         return b
+
+    def optimize(self) -> int:
+        """Re-pick every container's encoding, introducing run containers
+        where smallest (Bitmap.Optimize, roaring/roaring.go:1594); called at
+        snapshot time. Returns containers re-encoded. Unmaterialized lazy
+        containers keep their on-disk encoding (already optimized at write)."""
+        changed = 0
+        for key in list(self.containers):
+            c = self.containers[key]
+            if isinstance(c, LazyContainer):
+                if not c.materialized:
+                    continue
+                c = c._real
+            best = c.optimize()
+            if best is not c:
+                self.containers[key] = best
+                changed += 1
+        return changed
 
     def check(self) -> None:
         """Consistency check (Bitmap.Check, roaring/roaring.go:1015)."""
@@ -837,3 +923,11 @@ class Bitmap:
             if c.kind == "array":
                 if c.data.size and not np.all(np.diff(c.data.astype(np.int64)) > 0):
                     raise ValueError(f"unsorted/duplicate array container at key {key}")
+            elif c.kind == "run":
+                iv = c.data.astype(np.int64)
+                if iv.size:
+                    if not np.all(iv[:, 1] >= iv[:, 0]):
+                        raise ValueError(f"inverted run in container at key {key}")
+                    if not np.all(iv[1:, 0] > iv[:-1, 1] + 1):
+                        raise ValueError(
+                            f"unsorted/overlapping/adjacent runs at key {key}")

@@ -208,3 +208,86 @@ def test_contains_many():
     mask = b.contains_many(probe)
     assert mask[:100].all() and not mask[100:].any()
     assert not b.contains_many(np.array([], dtype=np.uint64)).any()
+
+
+# ---------------------------------------------------------------------------
+# in-memory run containers (roaring/roaring.go:56-62,1594; VERDICT r1 item 6)
+# ---------------------------------------------------------------------------
+
+
+def test_run_container_memory_and_roundtrip():
+    """A fully-set container costs bytes as one run, not 8 KiB inflated."""
+    from pilosa_tpu.storage.roaring import Container
+
+    b = Bitmap(np.arange(1 << 16, dtype=np.uint64))  # one full container
+    assert b.containers[0].kind == "bitmap"  # built dense
+    b.optimize()
+    c = b.containers[0]
+    assert c.kind == "run" and c.data.nbytes == 4, (c.kind, c.data.nbytes)
+    assert c.n == 1 << 16
+    # round-trips through the Pilosa format AND stays a run on read
+    b2 = Bitmap.from_bytes(b.to_bytes())
+    assert b2.containers[0].kind == "run"
+    assert b2.count() == 1 << 16
+    assert list(b2.slice(0, 10)) == list(range(10))
+    assert b2.contains(0) and b2.contains(65535)
+
+
+def test_run_container_algebra_and_mutation():
+    rng = np.random.default_rng(9)
+    b = Bitmap(np.arange(1000, 60000, dtype=np.uint64))
+    b.optimize()
+    assert b.containers[0].kind == "run"
+    other_vals = np.unique(rng.integers(0, 1 << 16, 5000)).astype(np.uint64)
+    other = Bitmap(other_vals)
+    inter = b.intersect(other)
+    sother = set(other_vals.tolist())
+    expect = {v for v in sother if 1000 <= v < 60000}
+    assert set(inter.slice().tolist()) == expect
+    assert b.intersection_count(other) == len(expect)
+    uni = b.union(other)
+    assert uni.count() == len(set(range(1000, 60000)) | sother)
+    # mutation re-encodes away from run, correctly
+    assert b.add(5) and b.contains(5)
+    assert b.remove(1000) and not b.contains(1000)
+    assert b.count() == 59000 + 1 - 1 + 1 - 1  # +5, -1000... recompute:
+    assert b.count() == len((set(range(1000, 60000)) | {5}) - {1000})
+    b.check()
+
+
+def test_run_container_contains_many_and_dense():
+    b = Bitmap(np.concatenate([
+        np.arange(10, 20, dtype=np.uint64),
+        np.arange(100, 4000, dtype=np.uint64),
+        np.arange(65000, 65536, dtype=np.uint64),
+    ]))
+    b.optimize()
+    assert b.containers[0].kind == "run"
+    probe = np.array([0, 10, 19, 20, 99, 100, 3999, 4000, 64999, 65000, 65535],
+                     dtype=np.uint64)
+    got = b.contains_many(probe)
+    expect = [b.contains(int(v)) for v in probe]
+    assert got.tolist() == expect
+    words = b.to_dense_words(0, 1 << 16)
+    bits = np.unpackbits(words.view(np.uint8), bitorder="little")
+    assert np.flatnonzero(bits).tolist() == sorted(b.slice().tolist())
+    b.check()
+
+
+def test_time_view_row_rss_kb_not_mb(tmp_path):
+    """The VERDICT scenario: a dense time-view row (all 2^20 bits of a
+    shard row set) costs KB as runs in memory, not MB inflated."""
+    from pilosa_tpu.constants import SHARD_WIDTH
+    from pilosa_tpu.storage.fragment import Fragment
+
+    frag = Fragment(str(tmp_path / "f"), "i", "f", "standard_2024", 0).open()
+    frag.bulk_import([3] * SHARD_WIDTH, list(range(SHARD_WIDTH)))
+    frag.close()
+    frag = Fragment(str(tmp_path / "f"), "i", "f", "standard_2024", 0).open()
+    # materialize the whole row; runs survive materialization
+    assert frag.row_count(3) == SHARD_WIDTH
+    dense = frag.row_dense(3)
+    assert int(np.bitwise_count(dense).sum()) == SHARD_WIDTH
+    total_bytes = sum(c.data.nbytes for c in frag.storage.containers.values())
+    assert total_bytes < 1024, total_bytes  # 16 runs x 4B, not 16 x 8KiB
+    frag.close()

@@ -222,3 +222,19 @@ def test_topn_n_zero_means_all(tmp_path):
     assert list(ex.execute("i", "TopN(f, Row(g=7), n=0)")[0]) == \
         [(1, 2), (2, 1)]
     ex.holder.close()
+
+
+def test_topn_n_zero_distributed(tmp_path):
+    """n=0 = unlimited must hold on the distributed reduce path too."""
+    from pilosa_tpu.models.cache import merge_pairs  # noqa: F401
+    from pilosa_tpu.pql import parse_string
+
+    ex = _make_executor(tmp_path)
+    idx = ex.holder.create_index("i")
+    f = idx.create_field("f")
+    f.import_bits([1, 1, 2], [1, 2, 1])
+    call = parse_string('TopN(f, n=0)').calls[0]
+    partials = [[(1, 2), (2, 1)]]
+    out = ex._reduce(call, partials, idx, [0])
+    assert list(out) == [(1, 2), (2, 1)]
+    ex.holder.close()
