@@ -41,6 +41,7 @@ from pilosa_tpu.ops.bitvector import columns_from_dense
 from pilosa_tpu.parallel.mesh import DeviceRunner
 from pilosa_tpu.pql import Call, Condition, Query, parse_string
 from pilosa_tpu.pql.ast import BETWEEN, EQ, GT, GTE, LT, LTE, NEQ
+from pilosa_tpu.utils import qctx
 
 WORDS = SHARD_WIDTH // 32
 
@@ -123,11 +124,14 @@ class Executor:
     # ------------------------------------------------------------------ API
 
     def execute(self, index_name: str, query, shards: Optional[list[int]] = None,
-                remote: bool = False):
+                remote: bool = False, timeout: Optional[float] = None):
         """Execute a PQL query; returns a list of per-call results
         (executor.Execute, executor.go:84). `remote=True` marks a fan-out
         sub-request: execute locally on exactly the given shards
-        (opt.Remote, executor.go:2147)."""
+        (opt.Remote, executor.go:2147). `timeout` (seconds) sets a query
+        deadline checked between shard batches and fanned out to remote
+        nodes (ctx cancellation, executor.go:2591-2608); an inherited
+        deadline (HTTP layer) applies when omitted."""
         if isinstance(query, str):
             query = parse_string(query)
         if not isinstance(query, Query):
@@ -138,16 +142,24 @@ class Executor:
         distributed = (not remote and self.cluster is not None
                        and self.client is not None
                        and len(self.cluster.nodes) > 1)
-        results = []
-        for call in query.calls:
-            self.stats.count(f"query/{call.name}")
-            with self.tracer.start_span(f"executor.{call.name}") as span:
-                if distributed:
-                    results.append(self._execute_distributed(index, call, shards))
-                else:
-                    results.append(self._execute_call(index, call, shards))
-                span.set_tag("index", index_name)
-        return results
+        import time as _time
+        dl_token = (qctx.deadline.set(_time.monotonic() + timeout)
+                    if timeout else None)
+        try:
+            results = []
+            for call in query.calls:
+                qctx.check()
+                self.stats.count(f"query/{call.name}")
+                with self.tracer.start_span(f"executor.{call.name}") as span:
+                    if distributed:
+                        results.append(self._execute_distributed(index, call, shards))
+                    else:
+                        results.append(self._execute_call(index, call, shards))
+                    span.set_tag("index", index_name)
+            return results
+        finally:
+            if dl_token is not None:
+                qctx.deadline.reset(dl_token)
 
     # ------------------------------------------------------------ dispatch
 
@@ -645,6 +657,7 @@ class Executor:
         out: list[tuple[int, int]] = []
         CHUNK = 256
         for start in range(0, len(pairs), CHUNK):
+            qctx.check()  # abort between walk blocks
             block = pairs[start:start + CHUNK]
             if (n is not None and len(heap) >= n
                     and block[0][1] < heap[0][0]):
@@ -694,6 +707,7 @@ class Executor:
         pairs = []
         CHUNK = 256  # bound slab memory: 256 rows x S x 128KiB
         for start in range(0, len(row_ids), CHUNK):
+            qctx.check()  # abort between recount chunks
             chunk = row_ids[start : start + CHUNK]
             slab = jnp.stack([
                 self._row_leaf_dev(index, f.name, VIEW_STANDARD, shards, rid)
@@ -780,6 +794,7 @@ class Executor:
         results = []
 
         def recurse(i: int, acc: Optional[np.ndarray], group):
+            qctx.check()  # abort between group combinations
             if limit is not None and len(results) >= limit:
                 return
             if i == len(axes):
@@ -962,6 +977,7 @@ class Executor:
         shard onto its next live replica individually (executor.go:2216-2231).
         Returns a list of partials."""
         from pilosa_tpu.net.client import ClientError
+        qctx.check()  # abort between node batches (executor.go:2591)
         if node_id == self.cluster.local_id:
             return [self._execute_call(index, call, node_shards)]
         node = self.cluster.node_by_id(node_id)

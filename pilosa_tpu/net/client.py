@@ -15,7 +15,7 @@ import urllib.error
 import urllib.request
 from typing import Optional
 
-from pilosa_tpu.utils import tracing
+from pilosa_tpu.utils import qctx, tracing
 
 
 class ClientError(Exception):
@@ -38,22 +38,35 @@ class InternalClient:
     def _request(self, method: str, uri: str, path: str,
                  body: Optional[bytes] = None,
                  content_type: str = "application/json",
-                 accept: Optional[str] = None) -> bytes:
+                 accept: Optional[str] = None,
+                 timeout: Optional[float] = None) -> bytes:
         headers = {"Content-Type": content_type} if body is not None else {}
         if accept:
             headers["Accept"] = accept
         trace_id = tracing.current_trace_id.get()
         if trace_id:  # InjectHTTPHeaders (tracing/tracing.go:22)
             headers[tracing.TRACE_HEADER] = trace_id
+        sock_timeout = timeout if timeout is not None else self.timeout
+        rem = qctx.remaining()
+        if rem is not None:
+            # deadline fan-out: remote re-applies the remaining budget as
+            # its own local deadline, and the socket timeout bounds a hung
+            # peer to the same budget (ctx cancellation over HTTP)
+            if rem <= 0:
+                raise qctx.QueryTimeoutError("query deadline exceeded")
+            headers[qctx.DEADLINE_HEADER] = f"{rem:.3f}"
+            sock_timeout = min(sock_timeout, rem + 0.25)
         req = urllib.request.Request(
             uri + path, data=body, method=method, headers=headers)
         try:
             with urllib.request.urlopen(
-                    req, timeout=self.timeout, context=self._ssl_ctx) as resp:
+                    req, timeout=sock_timeout, context=self._ssl_ctx) as resp:
                 return resp.read()
         except urllib.error.HTTPError as e:
             detail = e.read().decode(errors="replace")
             raise ClientError(f"{method} {path}: {e.code}: {detail}", status=e.code)
+        except TimeoutError as e:
+            raise ClientError(f"{method} {path}: timed out: {e}")
         except urllib.error.URLError as e:
             raise ClientError(f"{method} {path}: {e.reason}")
 
@@ -143,8 +156,9 @@ class InternalClient:
         out = self._request("GET", uri, "/internal/nodes")
         return json.loads(out)
 
-    def status(self, uri: str) -> dict:
-        return self._json("GET", uri, "/status")
+    def status(self, uri: str, timeout: Optional[float] = None) -> dict:
+        out = self._request("GET", uri, "/status", timeout=timeout)
+        return json.loads(out) if out else {}
 
     def translate_keys(self, uri: str, index: str, field: Optional[str],
                        keys: list[str], create: bool = True) -> list:

@@ -18,7 +18,7 @@ from pilosa_tpu.api import API, ApiError
 from pilosa_tpu.encoding.protobuf import CONTENT_TYPE as PROTO_CONTENT_TYPE
 from pilosa_tpu.encoding.protobuf import Serializer
 from pilosa_tpu.models.field import FieldOptions
-from pilosa_tpu.utils import tracing
+from pilosa_tpu.utils import qctx, tracing
 
 # (method, regex) -> handler name; ordered
 ROUTES: list[tuple[str, re.Pattern, str]] = [
@@ -66,7 +66,7 @@ ROUTES: list[tuple[str, re.Pattern, str]] = [
 # applies to routes in the spec).
 ALLOWED_QUERY_ARGS: dict[str, frozenset] = {
     "post_query": frozenset({"shards", "remote", "columnAttrs",
-                             "excludeRowAttrs", "excludeColumns"}),
+                             "excludeRowAttrs", "excludeColumns", "timeout"}),
     "get_export": frozenset({"index", "field", "shard"}),
     "get_fragment_blocks": frozenset({"index", "field", "view", "shard"}),
     "get_fragment_block_data": frozenset({"index", "field", "view", "shard",
@@ -84,12 +84,42 @@ class Handler:
 
     def __init__(self, api: API,
                  cluster_message_fn: Optional[Callable[[dict], None]] = None,
-                 stats=None):
+                 stats=None, query_timeout: float = 0.0):
         self.api = api
         self.cluster_message_fn = cluster_message_fn
         self.stats = stats
+        self.query_timeout = query_timeout  # [cluster] query-timeout default
         self.serializer = Serializer()
         self._local = threading.local()
+
+    def _set_deadline(self, route: str, query: dict, headers) -> object:
+        """Adopt the caller's remaining deadline (X-Pilosa-Deadline, set by
+        InternalClient on every fan-out RPC), a ?timeout= duration on
+        /query, or the server's [cluster] query-timeout default. Returns a
+        contextvar token to reset, or None. The deadline is checked between
+        shard batches (executor.go:2591-2608 validateQueryContext)."""
+        import time
+
+        incoming = (headers or {}).get(qctx.DEADLINE_HEADER)
+        secs = None
+        if incoming:
+            try:
+                secs = float(incoming)
+            except ValueError:
+                secs = None
+        elif route == "post_query":
+            arg = self._arg(query, "timeout")
+            if arg:
+                from pilosa_tpu.utils.duration import parse_duration
+                try:
+                    secs = parse_duration(arg)
+                except ValueError:
+                    raise ApiError(f"invalid timeout: {arg!r}")
+            elif self.query_timeout > 0:
+                secs = self.query_timeout
+        if secs is None:
+            return None
+        return qctx.deadline.set(time.monotonic() + secs)
 
     def dispatch(self, method: str, path: str, query: dict, body: bytes,
                  headers=None):
@@ -111,12 +141,18 @@ class Handler:
                     return self._error(
                         400, f"invalid query argument(s): {', '.join(sorted(unknown))}")
                 handler = getattr(self, name)
+                dl_token = self._set_deadline(name, query, headers)
                 try:
                     return handler(match.groupdict(), query, body)
+                except qctx.QueryTimeoutError as e:
+                    return self._error(504, str(e))
                 except ApiError as e:
                     return self._error(e.status, str(e))
                 except Exception as e:  # noqa: BLE001 — surface as 500
                     return self._error(500, str(e))
+                finally:
+                    if dl_token is not None:
+                        qctx.deadline.reset(dl_token)
         finally:
             if token is not None:
                 tracing.current_trace_id.reset(token)

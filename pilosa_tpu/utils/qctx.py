@@ -1,0 +1,40 @@
+"""Per-query context: deadline propagation and cancellation.
+
+The reference makes queries ctx-cancellable (executor.go:2591-2608
+validateQueryContext, checked between shard batches) and carries the
+context across node boundaries implicitly via net/http request contexts.
+Here the deadline rides a contextvar — it propagates into the executor's
+fan-out pool (submits run in copied contexts) — and crosses node
+boundaries explicitly as an X-Pilosa-Deadline header carrying the
+remaining seconds, which the remote re-applies as its own local deadline.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import time
+from typing import Optional
+
+DEADLINE_HEADER = "X-Pilosa-Deadline"
+
+# absolute time.monotonic() deadline for the current query, or None
+deadline: contextvars.ContextVar[Optional[float]] = contextvars.ContextVar(
+    "query_deadline", default=None)
+
+
+class QueryTimeoutError(Exception):
+    """The query exceeded its deadline (context.DeadlineExceeded analog)."""
+
+
+def remaining() -> Optional[float]:
+    """Seconds left before the deadline, or None when no deadline is set."""
+    dl = deadline.get()
+    return None if dl is None else dl - time.monotonic()
+
+
+def check() -> None:
+    """Raise QueryTimeoutError once the deadline has passed — called between
+    shard batches / recount chunks / fan-out steps, never inside them."""
+    rem = remaining()
+    if rem is not None and rem <= 0:
+        raise QueryTimeoutError("query deadline exceeded")
