@@ -206,8 +206,10 @@ class Field:
         return changed
 
     def _clear_other_rows(self, row_id: int, column: int) -> None:
-        """Mutex semantics: at most one row set per column
-        (mutexVector clear-then-set, fragment.go:398-407)."""
+        """Mutex semantics: at most one row set per column (mutexVector
+        clear-then-set, fragment.go:387-407). Uses the column probe
+        (rows_for_column — fragment.go:2446-2455 rowsVector.Get) so cost is
+        independent of how many rows the fragment holds."""
         shard = column // SHARD_WIDTH
         for v in self.views.values():
             if v.name.startswith(VIEW_BSI_PREFIX):
@@ -215,8 +217,8 @@ class Field:
             frag = v.fragment(shard)
             if frag is None:
                 continue
-            for rid in frag.row_ids():
-                if rid != row_id and frag.contains(rid, column % SHARD_WIDTH):
+            for rid in frag.rows_for_column(column):
+                if rid != row_id:
                     v.clear_bit(rid, column)
 
     def set_value(self, column: int, value: int) -> bool:

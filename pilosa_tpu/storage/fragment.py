@@ -52,12 +52,20 @@ def pos(row_id: int, column: int) -> int:
 class Fragment:
     """Host-authoritative storage for one shard of one view of one field."""
 
-    def __init__(self, path: str, index: str, field: str, view: str, shard: int):
+    def __init__(self, path: str, index: str, field: str, view: str, shard: int,
+                 wal_fsync: Optional[bool] = None):
         self.path = path
         self.index = index
         self.field = field
         self.view = view
         self.shard = shard
+        # fsync per acked op. Default (off) matches the reference, which
+        # writes through an unbuffered os.File but does not fsync
+        # (roaring.go:977); "always" survives power loss, not just process
+        # death, at ~100x write cost.
+        if wal_fsync is None:
+            wal_fsync = os.environ.get("PILOSA_TPU_WAL_FSYNC", "") == "always"
+        self.wal_fsync = wal_fsync
         self.storage = Bitmap()
         self.op_n = 0
         self._op_file = None
@@ -103,7 +111,11 @@ class Fragment:
             raise RuntimeError(
                 f"fragment file locked by another process: {self.path}")
         try:
-            self._op_file = open(self.path, "ab")
+            # Unbuffered: every acked op must reach the kernel before the
+            # write returns (the reference appends through an os.File
+            # syscall, roaring.go:977 writeOp — a userspace-buffered WAL
+            # loses acked writes on crash, defeating its purpose).
+            self._op_file = open(self.path, "ab", buffering=0)
             if os.path.getsize(self.path) == 0:
                 # Seed an empty snapshot header so the WAL has something to
                 # append to (openStorage marshals the empty bitmap into a
@@ -128,6 +140,7 @@ class Fragment:
             # mutated containers, so laziness survives
             self.storage.repair()
         self.storage.op_writer = self._op_file
+        self.storage.op_sync = self.wal_fsync
         self.closed = False
         return self
 
@@ -273,6 +286,24 @@ class Fragment:
                     break
         return out
 
+    def rows_for_column(self, column: int) -> list[int]:
+        """Row ids with this column's bit set — the reference's mutex column
+        probe (rowsVector.Get → rows(0, filterColumn(col)),
+        fragment.go:2446-2455). Only the single candidate container per row
+        (key ≡ col>>16 mod keys-per-row) is probed, so a mutex write costs
+        one membership test per *existing* candidate container instead of a
+        full per-row scan over every row id."""
+        col = column % SHARD_WIDTH
+        keys_per_row = SHARD_WIDTH >> 16
+        sub, low = col >> 16, col & 0xFFFF
+        out: list[int] = []
+        for key in self.storage.containers:
+            if key % keys_per_row == sub and self.storage.contains(
+                    (key << 16) | low):
+                out.append(key // keys_per_row)
+        out.sort()
+        return out
+
     def bit_count(self) -> int:
         return self.storage.count()
 
@@ -382,9 +413,10 @@ class Fragment:
         self.storage.op_n = 0
         if not self.closed:
             # the sidecar lock is held throughout — no ownership window
-            self._op_file = open(self.path, "ab")
+            self._op_file = open(self.path, "ab", buffering=0)
             self._remap_after_snapshot()
             self.storage.op_writer = self._op_file
+            self.storage.op_sync = self.wal_fsync
 
     def _remap_after_snapshot(self) -> None:
         """Swap storage onto the freshly-written file (the reference remaps

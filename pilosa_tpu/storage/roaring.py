@@ -32,6 +32,7 @@ File format (docs/architecture.md, roaring/roaring.go:812-985):
 from __future__ import annotations
 
 import io
+import os
 import struct
 from typing import Iterator, Optional
 
@@ -415,6 +416,7 @@ class Bitmap:
     def __init__(self, values=None):
         self.containers: dict[int, Container] = {}
         self.op_writer: Optional[io.RawIOBase] = None
+        self.op_sync = False  # fsync after each op (fragment plumbs config)
         self.op_n = 0
         if values is not None:
             self.add_many(np.asarray(values, dtype=np.uint64))
@@ -484,6 +486,8 @@ class Bitmap:
             return
         body = struct.pack("<BQ", typ, value)
         self.op_writer.write(body + struct.pack("<I", fnv1a32(body)))
+        if self.op_sync:
+            os.fsync(self.op_writer.fileno())
         self.op_n += 1
 
     # -- queries ------------------------------------------------------------

@@ -266,6 +266,59 @@ def test_flock_second_process_refused(tmp_path):
     frag.close()
 
 
+def test_crash_recovery_acked_writes_survive(tmp_path):
+    """A process killed after acking set_bit()s must not lose them: the WAL
+    is unbuffered (each op is a write(2) before the ack, the reference's
+    os.File semantics — roaring.go:977 writeOp). The child exits via
+    os._exit, which skips every userspace flush; a buffered WAL fails this.
+    """
+    import subprocess
+    import sys
+
+    n = 500
+    code = (
+        "import os\n"
+        "from pilosa_tpu.storage.fragment import Fragment\n"
+        f"f = Fragment({str(tmp_path / 'f')!r}, 'i', 'f', 'standard', 0).open()\n"
+        f"for i in range({n}):\n"
+        "    assert f.set_bit(i % 7, i)\n"
+        "os._exit(0)\n"  # simulated crash: no close(), no flush, no atexit
+    )
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                         text=True, cwd=repo_root)
+    assert out.returncode == 0, (out.stdout, out.stderr)
+
+    frag = Fragment(str(tmp_path / "f"), "i", "f", "standard", 0).open()
+    try:
+        for i in range(n):
+            assert frag.contains(i % 7, i), f"lost acked write {i}"
+        assert frag.op_n == n
+    finally:
+        frag.close()
+
+
+def test_wal_fsync_mode(tmp_path):
+    """PILOSA_TPU_WAL_FSYNC=always fsyncs per acked op (power-loss
+    durability beyond the reference's process-crash guarantee)."""
+    frag = Fragment(str(tmp_path / "f"), "i", "f", "standard", 0,
+                    wal_fsync=True).open()
+    try:
+        assert frag.storage.op_sync
+        assert frag.set_bit(3, 9)
+        assert frag.clear_bit(3, 9)
+        frag.snapshot()
+        assert frag.storage.op_sync  # plumbed through snapshot re-open
+        assert frag.set_bit(4, 1)
+    finally:
+        frag.close()
+    g = Fragment(str(tmp_path / "f"), "i", "f", "standard", 0).open()
+    try:
+        assert g.contains(4, 1) and not g.contains(3, 9)
+    finally:
+        g.close()
+
+
 def test_snapshot_remaps_and_preserves_laziness(tmp_path):
     """After a WAL-compaction snapshot, unread containers re-point at the
     new mapping without ever being parsed; data stays correct."""

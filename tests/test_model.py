@@ -93,6 +93,37 @@ def test_mutex_field(holder):
     assert f.row(2).columns().tolist() == [50]
 
 
+def test_mutex_write_cost_independent_of_row_count(holder):
+    """Mutex clear-other-rows is a column probe (fragment.go:2446-2455
+    rowsVector.Get), not a per-row scan: only containers in the written
+    column's 64K chunk are membership-tested, so rows whose bits live in
+    other chunks cost nothing."""
+    idx = holder.create_index("i")
+    f = idx.create_field("m", FieldOptions(type=FieldType.MUTEX))
+    # 200 rows with bits ONLY in column chunk 1 (columns >= 65536)
+    f.import_bits(list(range(200)), [70_000] * 200)
+    frag = f.views["standard"].fragment(0)
+    probes = 0
+    orig = frag.storage.contains
+
+    def counting(v):
+        nonlocal probes
+        probes += 1
+        return orig(v)
+
+    frag.storage.contains = counting
+    f.set_bit(5, 10)     # column chunk 0: none of the 200 containers match
+    # exactly one probe: add()'s own changed-check — zero column-probe work
+    assert probes == 1
+    f.set_bit(6, 70_000)  # chunk 1: probes candidates, clears all 200
+    frag.storage.contains = orig
+    assert f.row(6).columns().tolist() == [70_000]
+    for rid in range(200):
+        if rid not in (5, 6):
+            assert f.row(rid).columns().size == 0
+    assert f.row(5).columns().tolist() == [10]
+
+
 def test_bool_field(holder):
     idx = holder.create_index("i")
     f = idx.create_field("b", FieldOptions(type=FieldType.BOOL))
