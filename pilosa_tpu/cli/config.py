@@ -34,6 +34,11 @@ class ClusterConfig:
     replicas: int = 1
     hosts: list[str] = field(default_factory=list)
     long_query_time: float = 0.0
+    # liveness probing (gossip probe/suspicion analog,
+    # gossip/gossip.go:488-519): consecutive failed /status probes before a
+    # peer is marked down, and the per-probe timeout in seconds
+    liveness_threshold: int = 3
+    probe_timeout: float = 2.0
 
 
 @dataclass

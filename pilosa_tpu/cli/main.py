@@ -110,6 +110,8 @@ def cmd_server(args) -> int:
         data_dir, host=cfg.host, port=cfg.port, mesh=mesh,
         cluster_hosts=cfg.cluster.hosts if not cfg.cluster.disabled else None,
         replica_n=cfg.cluster.replicas,
+        liveness_threshold=cfg.cluster.liveness_threshold,
+        probe_timeout=cfg.cluster.probe_timeout,
         anti_entropy_interval=cfg.anti_entropy.interval,
         join=getattr(args, "join", False),
         long_query_time=cfg.cluster.long_query_time,

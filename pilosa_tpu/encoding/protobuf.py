@@ -78,19 +78,29 @@ def _encode_result(result) -> pb.QueryResult:
         r.Row.Attrs.extend(_encode_attrs(result.attrs))
     elif isinstance(result, Pairs):
         r.Type = RESULT_PAIRS
-        r.Pairs.extend(pb.Pair(ID=int(i), Count=int(c)) for i, c in result)
+        if result.keys is not None:
+            r.Pairs.extend(
+                pb.Pair(ID=int(i), Key=k, Count=int(c))
+                for (i, c), k in zip(result, result.keys))
+        else:
+            r.Pairs.extend(pb.Pair(ID=int(i), Count=int(c)) for i, c in result)
     elif isinstance(result, ValCount):
         r.Type = RESULT_VALCOUNT
         r.ValCount.Val = int(result.val)
         r.ValCount.Count = int(result.count)
     elif isinstance(result, RowIdentifiers):
         r.Type = RESULT_ROWIDENTIFIERS
-        r.RowIdentifiers.Rows.extend(int(x) for x in result)
+        if result.keys is not None:
+            r.RowIdentifiers.Keys.extend(result.keys)
+        else:
+            r.RowIdentifiers.Rows.extend(int(x) for x in result)
     elif isinstance(result, GroupCounts):
         r.Type = RESULT_GROUPCOUNTS
         for gc in result:
             g = pb.GroupCount(Count=int(gc["count"]))
             g.Group.extend(
+                pb.FieldRow(Field=fr["field"], RowKey=fr["rowKey"])
+                if "rowKey" in fr else
                 pb.FieldRow(Field=fr["field"], RowID=int(fr["rowID"]))
                 for fr in gc["group"])
             r.GroupCounts.append(g)
@@ -115,7 +125,10 @@ def decode_result(r: pb.QueryResult):
         row.keys = list(r.Row.Keys)
         return row
     if r.Type == RESULT_PAIRS:
-        return Pairs((p.ID, p.Count) for p in r.Pairs)
+        pairs = Pairs((p.ID, p.Count) for p in r.Pairs)
+        if any(p.Key for p in r.Pairs):
+            pairs.keys = [p.Key for p in r.Pairs]
+        return pairs
     if r.Type == RESULT_VALCOUNT:
         return ValCount(r.ValCount.Val, r.ValCount.Count)
     if r.Type == RESULT_UINT64:
@@ -123,10 +136,17 @@ def decode_result(r: pb.QueryResult):
     if r.Type == RESULT_BOOL:
         return bool(r.Changed)
     if r.Type == RESULT_ROWIDENTIFIERS:
+        if r.RowIdentifiers.Keys:
+            out = RowIdentifiers()
+            out.keys = list(r.RowIdentifiers.Keys)
+            return out
         return RowIdentifiers(r.RowIdentifiers.Rows)
     if r.Type == RESULT_GROUPCOUNTS:
         return GroupCounts(
-            {"group": [{"field": fr.Field, "rowID": fr.RowID} for fr in g.Group],
+            {"group": [
+                {"field": fr.Field, "rowKey": fr.RowKey} if fr.RowKey
+                else {"field": fr.Field, "rowID": fr.RowID}
+                for fr in g.Group],
              "count": g.Count}
             for g in r.GroupCounts)
     return None

@@ -53,11 +53,19 @@ class ExecutionError(ValueError):
 
 
 class Pairs(list):
-    """TopN result: [(row_id, count)] (reference Pairs, cache.go:317)."""
+    """TopN result: [(row_id, count)] (reference Pairs, cache.go:317).
+    `keys` holds the translated row keys, index-aligned with the pairs,
+    when the field is keyed (Pair.Key, cache.go:319)."""
+
+    keys: Optional[list] = None
 
 
 class RowIdentifiers(list):
-    """Rows result: sorted row ids (reference RowIdentifiers)."""
+    """Rows result: sorted row ids (reference RowIdentifiers,
+    executor.go:858-861). `keys` holds translated row keys on keyed
+    fields (RowIdentifiers.Keys)."""
+
+    keys: Optional[list] = None
 
 
 class GroupCounts(list):
@@ -152,9 +160,15 @@ class Executor:
                 self.stats.count(f"query/{call.name}")
                 with self.tracer.start_span(f"executor.{call.name}") as span:
                     if distributed:
-                        results.append(self._execute_distributed(index, call, shards))
+                        result = self._execute_distributed(index, call, shards)
                     else:
-                        results.append(self._execute_call(index, call, shards))
+                        result = self._execute_call(index, call, shards)
+                    if not remote:
+                        # ids -> keys on the coordinator only; remote
+                        # sub-results stay raw (translateResults,
+                        # executor.go:2323,2483)
+                        result = self._translate_result(index, call, result)
+                    results.append(result)
                     span.set_tag("index", index_name)
             return results
         finally:
@@ -818,6 +832,42 @@ class Executor:
 
     # -------------------------------------------------------------- writes
 
+    def _translate_result(self, index: Index, call: Call, result):
+        """Map result ids back to keys on keyed fields (translateResult,
+        executor.go:2497-2590): TopN Pair.Key, Rows RowIdentifiers.Keys,
+        GroupBy FieldRow.RowKey. Row column keys render at the API layer
+        (api.py) where the JSON/protobuf writers live."""
+        if self.translator is None:
+            return result
+        while call.name == "Options" and call.children:
+            call = call.children[0]
+
+        def row_key(fname: str, rid: int) -> str:
+            # fall back to the decimal id, never "": proto3 strings have no
+            # presence, so an empty key would decode as "unkeyed" on the
+            # wire (a translator miss here is pathological anyway — keyed
+            # fields only hold ids the translator minted)
+            return (self.translator.translate_row_to_string(
+                index.name, fname, int(rid)) or str(rid))
+
+        if isinstance(result, Pairs):
+            fname = call.args.get("_field")
+            f = index.field(fname) if fname else None
+            if f is not None and f.options.keys:
+                result.keys = [row_key(fname, rid) for rid, _ in result]
+        elif isinstance(result, RowIdentifiers):
+            fname = call.args.get("_field") or call.args.get("field")
+            f = index.field(fname) if fname else None
+            if f is not None and f.options.keys:
+                result.keys = [row_key(fname, rid) for rid in result]
+        elif isinstance(result, GroupCounts):
+            for gc in result:
+                for fr in gc["group"]:
+                    f = index.field(fr.get("field"))
+                    if f is not None and f.options.keys and "rowID" in fr:
+                        fr["rowKey"] = row_key(fr["field"], fr.pop("rowID"))
+        return result
+
     def _translate_col(self, index: Index, value, create: bool = True):
         """Column key -> id. Reads pass create=False: querying an unknown key
         must not mint ids into the shared translate log."""
@@ -994,8 +1044,13 @@ class Executor:
         excluded = excluded | {node_id}
         regroup: dict[str, list[int]] = {}
         for s in node_shards:
-            cand = next((n.id for n in self.cluster.shard_nodes(index.name, s)
-                         if n.id not in excluded), None)
+            replicas = [n.id for n in self.cluster.shard_nodes(index.name, s)
+                        if n.id not in excluded]
+            # prefer replicas not marked down by liveness probing; fall back
+            # to a down-marked one (the marker may be stale) before erroring
+            cand = next((r for r in replicas
+                         if not self.cluster.is_down(r)),
+                        replicas[0] if replicas else None)
             if cand is None:
                 raise ExecutionError(
                     f"shard {s} unavailable on all replicas: {err}")
@@ -1022,7 +1077,14 @@ class Executor:
                 # writes also land on replicas of each shard
                 replica_targets: dict[str, list[int]] = {}
                 for s in node_shards:
-                    for n in self.cluster.shard_nodes(index.name, s):
+                    live = [n for n in self.cluster.shard_nodes(index.name, s)
+                            if not self.cluster.is_down(n.id)]
+                    if not live:
+                        # never ack a write that landed nowhere
+                        raise ExecutionError(
+                            f"all replicas down for write to shard {s}")
+                    for n in live:
+                        # down replicas heal via anti-entropy on return
                         replica_targets.setdefault(n.id, []).append(s)
                 for rid, rshards in replica_targets.items():
                     if rid == self.cluster.local_id:
@@ -1043,6 +1105,13 @@ class Executor:
             targets = self.cluster.shard_nodes(index.name, col // SHARD_WIDTH)
         else:  # SetRowAttrs
             targets = self.cluster.nodes
+        # skip probe-detected-down replicas: a write acked by the live
+        # replicas lands on the returning node via anti-entropy; all
+        # replicas down -> hard error below (no live target)
+        live = [n for n in targets if not self.cluster.is_down(n.id)]
+        if targets and not live:
+            raise ExecutionError("all replicas down for write")
+        targets = live
         result = None
         for node in targets:
             if node.id == self.cluster.local_id:

@@ -111,6 +111,11 @@ class Cluster:
         self.cluster_id = str(uuid.uuid4())
         self.on_state_change: Optional[Callable[[str], None]] = None
         self.active_job: Optional[ResizeJob] = None
+        # Nodes detected dead by liveness probing (server._probe_peers).
+        # They stay in `nodes` (still members of the topology — the
+        # reference keeps them in Topology with nodeStateDown,
+        # cluster.go:1697-1701) but placement routes around them.
+        self.down_ids: set[str] = set()
 
     # -- membership ---------------------------------------------------------
 
@@ -142,13 +147,59 @@ class Cluster:
         self.nodes = sorted(nodes, key=lambda n: n.id)
         if self.nodes:
             self.coordinator_id = self.coordinator_id or self.nodes[0].id
-        self._set_state(STATE_NORMAL)
+        self._recompute_liveness_state()
 
     def _set_state(self, state: str) -> None:
         if state != self.state:
             self.state = state
             if self.on_state_change is not None:
                 self.on_state_change(state)
+
+    # -- liveness (reference: memberlist probe -> NodeLeave ->
+    # ReceiveEvent, gossip/gossip.go:488-519; cluster.go:1690-1703) ---------
+
+    def is_down(self, node_id: str) -> bool:
+        return node_id in self.down_ids
+
+    def mark_down(self, node_id: str) -> None:
+        """A peer failed K consecutive liveness probes: route around it and
+        recompute cluster state (nodeStateDown + determineClusterState,
+        cluster.go:1697-1701, :522-533)."""
+        if node_id == self.local_id or node_id in self.down_ids:
+            return
+        self.down_ids.add(node_id)
+        n = self.node_by_id(node_id)
+        if n is not None:
+            n.state = "DOWN"
+        if self.state != STATE_RESIZING:
+            self._recompute_liveness_state()
+
+    def mark_up(self, node_id: str) -> None:
+        """A down peer answered a probe again — the temporarily-unavailable
+        host came back (cluster.go:1694-1696 'expect it to come back up')."""
+        if node_id not in self.down_ids:
+            return
+        self.down_ids.discard(node_id)
+        n = self.node_by_id(node_id)
+        if n is not None:
+            n.state = "READY"
+        if self.state != STATE_RESIZING:
+            self._recompute_liveness_state()
+
+    def _recompute_liveness_state(self) -> None:
+        """determineClusterState (cluster.go:522-533): fewer losses than
+        ReplicaN -> every shard still has a live replica -> DEGRADED;
+        otherwise data is unreachable -> STARTING. Callers in a RESIZING
+        window (probe-driven mark_down/mark_up) defer; authoritative
+        membership replacement (set_static, resize completion) recomputes
+        unconditionally — that transition is what ends RESIZING."""
+        self.down_ids &= {n.id for n in self.nodes}
+        if not self.down_ids:
+            self._set_state(STATE_NORMAL)
+        elif len(self.down_ids) < self.replica_n:
+            self._set_state(STATE_DEGRADED)
+        else:
+            self._set_state(STATE_STARTING)
 
     # -- placement ----------------------------------------------------------
 
@@ -171,11 +222,18 @@ class Cluster:
 
     def shards_by_node(self, index: str, shards: list[int]) -> dict[str, list[int]]:
         """Group shards by primary owner — the mapReduce fan-out plan
-        (executor.go:2163 shardsByNode)."""
+        (executor.go:2163 shardsByNode). Known-down nodes are skipped up
+        front (the first live replica becomes primary) so queries don't eat
+        a ClientError + failover round-trip per down peer."""
         out: dict[str, list[int]] = {}
         for s in shards:
             nodes = self.shard_nodes(index, s)
-            if nodes:
+            live = [n for n in nodes if n.id not in self.down_ids]
+            if live:
+                out.setdefault(live[0].id, []).append(s)
+            elif nodes:
+                # every replica down: keep the primary so the query surfaces
+                # "shard unavailable" instead of silently dropping the shard
                 out.setdefault(nodes[0].id, []).append(s)
         return out
 
@@ -260,12 +318,12 @@ class Cluster:
             else:
                 self.remove_node(job.node_id)
             self.active_job = None
-            self._set_state(STATE_NORMAL)
+            self._recompute_liveness_state()
 
     def abort_resize(self) -> None:
         """api.ResizeAbort (api.go:1131)."""
         self.active_job = None
-        self._set_state(STATE_NORMAL)
+        self._recompute_liveness_state()
 
     # -- topology persistence (cluster.go:1534-1646, JSON not protobuf) -----
 

@@ -43,6 +43,8 @@ class Server:
                  anti_entropy_interval: float = 0.0,
                  cache_flush_interval: float = 60.0,
                  membership_interval: float = 5.0,
+                 liveness_threshold: int = 3,
+                 probe_timeout: float = 2.0,
                  join: bool = False,
                  resize_timeout: float = 120.0,
                  mesh=None,
@@ -103,6 +105,12 @@ class Server:
         self.cache_flush_interval = cache_flush_interval
         self._cache_flush_timer: Optional[threading.Timer] = None
         self.membership_interval = membership_interval
+        # liveness probing (the memberlist probe/suspicion analog,
+        # gossip/gossip.go:488-519): after `liveness_threshold` consecutive
+        # failed /status probes a peer is marked down and routed around
+        self.liveness_threshold = liveness_threshold
+        self.probe_timeout = probe_timeout
+        self._probe_failures: dict[str, int] = {}
         # join=True: this node is being added to an existing cluster —
         # cluster_hosts are seed URIs (the gossip-seeds analog). It announces
         # itself and stays STARTING until the coordinator's resize completes
@@ -206,8 +214,13 @@ class Server:
 
     def _membership_tick(self) -> None:
         try:
-            if self.join and self.cluster.state == STATE_STARTING:
-                self.request_join()  # keep knocking until admitted
+            if self.join and self.cluster.state == STATE_STARTING \
+                    and not self.cluster.down_ids:
+                # keep knocking until admitted — but only when STARTING
+                # means "not yet joined"; liveness-induced STARTING (peers
+                # down >= ReplicaN) must fall through so probing can detect
+                # their return and mark them back up
+                self.request_join()
             else:
                 # fetch over the network WITHOUT the lock, then apply the
                 # merge under it so it cannot interleave with a join/leave
@@ -219,6 +232,7 @@ class Server:
                         if self.cluster.state != STATE_RESIZING \
                                 and self.cluster.active_job is None:
                             self._apply_membership(reports)
+                self._probe_peers()
         finally:
             self._schedule_membership_refresh()
 
@@ -259,6 +273,62 @@ class Server:
         self.cluster.set_static(list(nodes.values()))
         # lowest node id coordinates (deterministic across peers)
         self.cluster.coordinator_id = min(nodes)
+
+    def _probe_peers(self) -> None:
+        """Liveness detection: probe every known peer's /status each
+        membership tick. `liveness_threshold` consecutive failures mark the
+        node down (memberlist probe -> suspicion -> NodeLeave,
+        gossip/gossip.go:488-519); placement then routes around it and the
+        cluster state recomputes (DEGRADED / STARTING, cluster.go:522-533).
+        A later successful probe marks it back up — the reference treats
+        this as 'temporarily unavailable... expect it to come back up'
+        (cluster.go:1694-1696)."""
+        if self._left or self.closed:
+            return
+        peers = [n for n in list(self.cluster.nodes)
+                 if n.id != self.node_id and n.uri]
+        if not peers:
+            return
+
+        # probe concurrently: N down peers must cost one probe_timeout per
+        # tick, not N of them (the membership timer is a single thread)
+        def probe(node):
+            try:
+                self.client.status(node.uri, timeout=self.probe_timeout)
+                return True
+            except ClientError:
+                return False
+
+        results: dict[str, bool] = {}
+        threads = []
+        for node in peers:
+            t = threading.Thread(
+                target=lambda n=node: results.__setitem__(n.id, probe(n)),
+                daemon=True)
+            t.start()
+            threads.append(t)
+        for t in threads:
+            t.join(self.probe_timeout + 1.0)
+        for node in peers:
+            alive = results.get(node.id, False)
+            if alive:
+                if self.cluster.is_down(node.id):
+                    self.logger.printf("liveness: node %s (%s) back up",
+                                       node.id, node.uri)
+                    self.cluster.mark_up(node.id)
+                self._probe_failures.pop(node.id, None)
+            else:
+                n = self._probe_failures.get(node.id, 0) + 1
+                self._probe_failures[node.id] = n
+                if (n == self.liveness_threshold
+                        and not self.cluster.is_down(node.id)):
+                    self.logger.printf(
+                        "liveness: node %s (%s) failed %d probes, marking "
+                        "down (cluster -> %s)", node.id, node.uri, n,
+                        "DEGRADED" if len(self.cluster.down_ids) + 1
+                        < self.cluster.replica_n else "STARTING")
+                    self.cluster.mark_down(node.id)
+                    self.stats.count("liveness/node_down")
 
     def close(self) -> None:
         self.closed = True
@@ -609,8 +679,11 @@ class Server:
             else:
                 aborted = False
                 self.cluster.complete_resize(job, msg["node"])
-                finished = (self.cluster.active_job is None
-                            and self.cluster.state == STATE_NORMAL)
+                # done when the job cleared — the post-resize state may be
+                # DEGRADED if an unrelated node is probe-marked down;
+                # completion steps (topology broadcast, watchdog cancel,
+                # pending-resize drain) must still run
+                finished = self.cluster.active_job is None
                 if finished and job.event == EVENT_LEAVE:
                     self._removed_ids.add(job.node_id)
         if aborted:
@@ -806,7 +879,8 @@ class Server:
         (attrs replicate to every node; each node pulls on its own pass)."""
         merged = 0
         for node in self.cluster.nodes:
-            if node.id == self.node_id or not node.uri:
+            if node.id == self.node_id or not node.uri \
+                    or self.cluster.is_down(node.id):
                 continue
             blocks = [{"id": b, "checksum": chk.hex()}
                       for b, chk in store.blocks()]
@@ -826,7 +900,8 @@ class Server:
         local_blocks = dict(frag.blocks())
         merged = 0
         for node in self.cluster.shard_nodes(iname, shard):
-            if node.id == self.node_id or not node.uri:
+            if node.id == self.node_id or not node.uri \
+                    or self.cluster.is_down(node.id):
                 continue
             try:
                 remote = {b["id"]: b["checksum"]

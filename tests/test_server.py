@@ -124,6 +124,40 @@ def test_keyed_index(server):
     assert out["ids"][0] == 1 and out["ids"][1] >= 2
 
 
+def test_keyed_result_translation(server):
+    """TopN pairs, Rows identifiers, and GroupBy groups come back as keys
+    on keyed fields (translateResult, executor.go:2497-2590)."""
+    u = server.uri
+    jpost(u, "/index/kt", {"options": {"keys": True}})
+    jpost(u, "/index/kt/field/f", {"options": {"keys": True}})
+    jpost(u, "/index/kt/field/g", {"options": {"keys": True}})
+    for col in ("a", "b", "c"):
+        jpost(u, "/index/kt/query", raw=f"Set('{col}', f='hot')".encode())
+    jpost(u, "/index/kt/query", raw=b"Set('a', f='cold')")
+    jpost(u, "/index/kt/query", raw=b"Set('a', g='left')")
+    jpost(u, "/index/kt/query", raw=b"Set('b', g='left')")
+
+    _, out = jpost(u, "/index/kt/query", raw=b"TopN(f, n=2)")
+    pairs = out["results"][0]
+    assert [p["key"] for p in pairs] == ["hot", "cold"]
+    assert [p["count"] for p in pairs] == [3, 1]
+
+    _, out = jpost(u, "/index/kt/query", raw=b"Rows(field=f)")
+    assert sorted(out["results"][0]["keys"]) == ["cold", "hot"]
+    assert out["results"][0]["rows"] is None
+
+    _, out = jpost(u, "/index/kt/query", raw=b"GroupBy(Rows(field=f), Rows(field=g))")
+    groups = out["results"][0]
+    assert groups, "GroupBy returned no groups"
+    for gc in groups:
+        for fr in gc["group"]:
+            assert "rowKey" in fr and "rowID" not in fr
+    flat = {tuple(fr["rowKey"] for fr in gc["group"]): gc["count"]
+            for gc in groups}
+    assert flat[("hot", "left")] == 2
+    assert flat[("cold", "left")] == 1
+
+
 def test_fragment_internals_and_misc(server):
     u = server.uri
     jpost(u, "/index/i", {})
@@ -237,6 +271,60 @@ def test_cluster_distributed_topn_and_sum(cluster3):
     assert out["results"][0] == [{"id": 1, "count": 6}, {"id": 2, "count": 3}]
     _, out = jpost(cluster3[2].uri, "/index/i/query", raw=b"Sum(field=v)")
     assert out["results"][0] == {"value": 30, "count": 3}
+
+
+def test_liveness_detects_crashed_node(cluster3):
+    """A crashed (not gracefully removed) node is detected by liveness
+    probing: after `liveness_threshold` failed probes the cluster enters
+    DEGRADED, placement routes around the dead node (no per-query
+    ClientError churn), and queries stay correct (gossip probe ->
+    NodeLeave -> ReceiveEvent, gossip/gossip.go:488-519,
+    cluster.go:1690-1703; determineClusterState :522-533)."""
+    s0, s1, s2 = cluster3
+    jpost(s0.uri, "/index/i", {})
+    jpost(s0.uri, "/index/i/field/f", {})
+    cols = [5, SHARD_WIDTH + 9, 2 * SHARD_WIDTH + 3, 3 * SHARD_WIDTH + 1]
+    for c in cols:
+        jpost(s0.uri, "/index/i/query", raw=f"Set({c}, f=1)".encode())
+
+    # crash s2's HTTP plane (SIGKILL analog: sockets die, no leave message)
+    s2.http.close()
+    for s in (s0, s1):
+        s.probe_timeout = 0.5
+        for _ in range(s.liveness_threshold):
+            s._probe_peers()
+        assert s.cluster.is_down(s2.node_id)
+        # 1 lost < replica_n=2 -> every shard still has a live replica
+        assert s.cluster.state == "DEGRADED"
+
+    # placement no longer routes primaries to the dead node
+    shards = [c // SHARD_WIDTH for c in cols]
+    groups = s0.cluster.shards_by_node("i", shards)
+    assert s2.node_id not in groups
+
+    # queries from the survivors are correct, with zero failover retries
+    calls = {"n": 0}
+    orig = s0.executor.client.query_proto
+
+    def counting(uri, *a, **kw):
+        calls["n"] += 1
+        assert uri != s2.uri, "query routed to a known-dead node"
+        return orig(uri, *a, **kw)
+
+    s0.executor.client.query_proto = counting
+    _, out = jpost(s0.uri, "/index/i/query", raw=b"Count(Row(f=1))")
+    assert out["results"] == [4]
+    s0.executor.client.query_proto = orig
+
+    # writes succeed while a replica is down (it heals via anti-entropy)
+    status, out = jpost(s0.uri, "/index/i/query",
+                        raw=f"Set({4 * SHARD_WIDTH + 7}, f=1)".encode())
+    assert status == 200 and out["results"] == [True]
+
+    # a successful probe marks the node back up -> NORMAL
+    s0.cluster.mark_up(s2.node_id)
+    assert s0.cluster.state == "NORMAL"
+    assert s0.cluster.node_by_id(s2.node_id).state == "READY"
 
 
 def test_anti_entropy_heals_divergence(cluster3):
