@@ -124,6 +124,9 @@ def cmd_server(args) -> int:
         tls_certificate=cfg.tls.certificate,
         tls_key=cfg.tls.key,
         tls_skip_verify=cfg.tls.skip_verify,
+        tracing_sampler_type=cfg.tracing.sampler_type,
+        tracing_sampler_param=cfg.tracing.sampler_param,
+        tracing_endpoint=cfg.tracing.agent_host_port,
     ).open()
     mesh_desc = f"{mesh.size}-device mesh" if mesh is not None else "1 device"
     print(f"pilosa-tpu {__version__} serving at {server.uri} "

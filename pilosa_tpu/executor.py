@@ -774,7 +774,24 @@ class Executor:
 
     def _execute_group_by(self, index: Index, call: Call, shards) -> list[dict]:
         """GroupBy(Rows(...), ..., limit=, filter=) — cross product of row
-        iterators with intersection counts (executor.go:897-1090)."""
+        iterators with intersection counts (executor.go:897-1090).
+
+        Device-batched redesign of the reference's per-combination iterator
+        walk: each Rows axis becomes one HBM-resident [R, S, W] slab (leaves
+        shared with every other query through the residency manager), and
+        each level of the cross product is computed in fused and+popcount
+        dispatches of at most P_CHUNK prefixes — counts[P, R] =
+        popcount(prefix ⊗ axis). Prefix slabs are never persisted: each
+        chunk's prefix is re-gathered from the component axis slabs and
+        AND-reduced inside the fused dispatch, so device memory stays
+        O(P_CHUNK · S · W) regardless of how many combinations survive.
+        Zero-count prefixes are pruned between levels (the groupByIterator
+        early-exit). Groups emit in lexicographic iterator order, so
+        `limit` matches the reference's cutoff semantics — and the final
+        level stops dispatching once `limit` nonzero groups exist."""
+        import jax.numpy as jnp
+        from pilosa_tpu.ops.bitvector import intersect_count, popcount
+
         shards = self._query_shards(index, shards)
         limit = call.uint_arg("limit")
         rows_calls = [c for c in call.children if c.name == "Rows"]
@@ -783,51 +800,93 @@ class Executor:
         filt_calls = [c for c in call.children if c.name != "Rows"]
         if len(filt_calls) > 1:
             raise ExecutionError("GroupBy supports at most one filter call")
-        filter_dense = None
-        filter_call = filt_calls[0] if filt_calls else None
-        if filter_call is not None:
-            program, leaves = self._compile(index, filter_call, shards)
-            filter_dense = self.runner.row_leaves(leaves, program, len(shards))
+        filter_dev = None
+        if filt_calls:
+            program, leaves = self._compile(index, filt_calls[0], shards)
+            filter_dev = self.runner.row_leaves_dev(leaves, program)  # [S', W]
 
-        # per Rows call: list of (field, row_id, dense[S, W])
+        # per Rows call: (field, [row_ids], device slab [R, S', W])
         axes = []
         for rc in rows_calls:
             fname = rc.args.get("_field") or rc.args.get("field")
             f = index.field(fname)
             if f is None:
                 raise ExecutionError(f"field not found: {fname}")
-            row_ids = self._execute_rows(index, rc, shards)
-            slabs = [
-                np.stack([self._cached_row(index, fname, VIEW_STANDARD, s, rid)
-                          for s in shards])
-                for rid in row_ids
-            ]
-            axes.append([(fname, rid, slab) for rid, slab in zip(row_ids, slabs)])
+            row_ids = list(self._execute_rows(index, rc, shards))
+            if not row_ids:
+                return GroupCounts([])
+            slab = jnp.stack([
+                self._row_leaf_dev(index, fname, VIEW_STANDARD, shards, rid)
+                for rid in row_ids])
+            axes.append((fname, row_ids, slab))
 
-        from pilosa_tpu.ops.bitvector import popcount
+        P_CHUNK = 64  # prefixes per dispatch: bounds the fused broadcast
+
+        # level-0 slab with the filter folded in (one [R0, S, W] array — the
+        # only level whose slab is ever materialized beyond the axis leaves)
+        fname0, rows0, slab0 = axes[0]
+        if filter_dev is not None:
+            slab0 = jnp.bitwise_and(slab0, filter_dev[None])
+        axis_slabs = [slab0] + [a[2] for a in axes[1:]]
+
+        def prefix_chunk(comb, li, st, en):
+            """Re-gather + AND-reduce the [st:en] prefix slabs from their
+            component axes (fused by XLA with the downstream count)."""
+            pref = axis_slabs[0][comb[0][st:en]]
+            for a in range(1, li):
+                pref = jnp.bitwise_and(pref, axis_slabs[a][comb[a][st:en]])
+            return pref  # [chunk, S, W]
+
+        # comb: one index array per axis consumed so far; row-major order of
+        # the arrays IS the reference's lexicographic iterator order
+        comb = [np.arange(len(rows0))]
+        if len(axes) == 1:
+            counts = np.asarray(popcount(slab0).sum(axis=-1))  # [R0]
+            live = np.nonzero(counts)[0]
+            comb, counts = [live], counts[live]
+        else:
+            counts = None
+            for li in range(1, len(axes)):
+                _, row_ids, slab = axes[li]
+                last = li == len(axes) - 1
+                P, R = len(comb[0]), len(row_ids)
+                live_p_parts, live_r_parts, count_parts = [], [], []
+                found = 0
+                for st in range(0, P, P_CHUNK):
+                    qctx.check()  # abort between dispatches
+                    en = min(st + P_CHUNK, P)
+                    c = intersect_count(
+                        prefix_chunk(comb, li, st, en)[:, None],
+                        slab[None])                     # [chunk, R, S]
+                    cmat = np.asarray(c.sum(axis=-1))   # [chunk, R]
+                    lp, lr = np.nonzero(cmat)
+                    live_p_parts.append(lp + st)
+                    live_r_parts.append(lr)
+                    count_parts.append(cmat[lp, lr])
+                    found += lp.size
+                    if last and limit is not None and found >= limit:
+                        break  # lex order: later chunks can't precede these
+                live_p = np.concatenate(live_p_parts) if live_p_parts else \
+                    np.empty(0, dtype=np.int64)
+                live_r = np.concatenate(live_r_parts) if live_r_parts else \
+                    np.empty(0, dtype=np.int64)
+                if live_p.size == 0:
+                    return GroupCounts([])
+                counts = np.concatenate(count_parts)
+                comb = [ci[live_p] for ci in comb] + [live_r]
+
         results = []
-
-        def recurse(i: int, acc: Optional[np.ndarray], group):
-            qctx.check()  # abort between group combinations
+        axis_rows = [rows0] + [a[1] for a in axes[1:]]
+        axis_names = [fname0] + [a[0] for a in axes[1:]]
+        for k in range(len(counts)):
+            results.append({
+                "group": [{"field": axis_names[a],
+                           "rowID": int(axis_rows[a][comb[a][k]])}
+                          for a in range(len(comb))],
+                "count": int(counts[k]),
+            })
             if limit is not None and len(results) >= limit:
-                return
-            if i == len(axes):
-                dense = acc if filter_dense is None else acc & filter_dense
-                count = int(np.asarray(popcount(dense)).sum())
-                if count > 0:
-                    results.append({
-                        "group": [{"field": fn, "rowID": rid} for fn, rid in group],
-                        "count": count,
-                    })
-                return
-            for fname, rid, slab in axes[i]:
-                nxt = slab if acc is None else acc & slab
-                # prune empty prefixes (groupByIterator early-exit)
-                if acc is not None and not nxt.any():
-                    continue
-                recurse(i + 1, nxt, group + [(fname, rid)])
-
-        recurse(0, None, [])
+                break
         return GroupCounts(results)
 
     # -------------------------------------------------------------- writes

@@ -57,7 +57,10 @@ class Server:
                  diagnostics_interval: float = 0.0,
                  tls_certificate: str = "",
                  tls_key: str = "",
-                 tls_skip_verify: bool = False):
+                 tls_skip_verify: bool = False,
+                 tracing_sampler_type: str = "off",
+                 tracing_sampler_param: float = 0.0,
+                 tracing_endpoint: str = ""):
         self.data_dir = data_dir
         self.holder = Holder(data_dir)
         self.node_id = node_id or self._load_or_create_id()
@@ -70,9 +73,18 @@ class Server:
         self.client = InternalClient(tls_skip_verify=tls_skip_verify)
         from pilosa_tpu.utils.logger import Logger
         from pilosa_tpu.utils.stats import new_stats_client
-        from pilosa_tpu.utils.tracing import Tracer
+        from pilosa_tpu.utils.tracing import SpanExporter, Tracer
         self.stats = new_stats_client(metric_service, metric_host)
-        self.tracer = Tracer()
+        # [tracing] config (server/config.go:96-104): an endpoint enables
+        # batched span export; sampler gates which traces ship. Accepts a
+        # full URL or the reference's bare agent "host:port" form.
+        if tracing_endpoint and "://" not in tracing_endpoint:
+            tracing_endpoint = f"http://{tracing_endpoint}/api/traces"
+        exporter = (SpanExporter(tracing_endpoint)
+                    if tracing_endpoint else None)
+        self.tracer = Tracer(exporter=exporter,
+                             sampler_type=tracing_sampler_type,
+                             sampler_param=tracing_sampler_param)
         self.logger = Logger()
         from pilosa_tpu.utils.diagnostics import (
             DiagnosticsCollector,
@@ -287,6 +299,11 @@ class Server:
             return
         peers = [n for n in list(self.cluster.nodes)
                  if n.id != self.node_id and n.uri]
+        # drop counters for nodes no longer in membership, so a node that
+        # is removed and later re-added starts from a clean slate
+        peer_ids = {n.id for n in peers}
+        for stale in set(self._probe_failures) - peer_ids:
+            del self._probe_failures[stale]
         if not peers:
             return
 
@@ -320,7 +337,7 @@ class Server:
             else:
                 n = self._probe_failures.get(node.id, 0) + 1
                 self._probe_failures[node.id] = n
-                if (n == self.liveness_threshold
+                if (n >= self.liveness_threshold
                         and not self.cluster.is_down(node.id)):
                     self.logger.printf(
                         "liveness: node %s (%s) failed %d probes, marking "
@@ -342,6 +359,8 @@ class Server:
             self._resize_watchdog.cancel()
         self.runtime_monitor.close()
         self.diagnostics.close()
+        if self.tracer.exporter is not None:
+            self.tracer.exporter.close()  # final flush
         self.http.close()
         self.holder.close()
         self.translate.close()

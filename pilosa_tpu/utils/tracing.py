@@ -26,13 +26,15 @@ current_trace_id: contextvars.ContextVar[Optional[str]] = contextvars.ContextVar
 
 
 class Span:
-    __slots__ = ("tracer", "name", "trace_id", "start", "end", "tags")
+    __slots__ = ("tracer", "name", "trace_id", "start", "end", "tags",
+                 "start_wall")
 
     def __init__(self, tracer, name: str, trace_id: str):
         self.tracer = tracer
         self.name = name
         self.trace_id = trace_id
         self.start = time.monotonic()
+        self.start_wall = time.time()  # wall clock for export timestamps
         self.end: Optional[float] = None
         self.tags: dict = {}
 
@@ -53,23 +55,130 @@ class Span:
         self.finish()
 
 
-class Tracer:
-    """Recording tracer; keeps the last `limit` finished spans."""
+class SpanExporter:
+    """Batched JSON-over-HTTP span shipper — the export backend the
+    reference configures through its Jaeger agent settings
+    (tracing/opentracing/opentracing.go:21-39, server/config.go:96-104).
+    Jaeger-thrift egress isn't available here, so the wire format is a
+    Jaeger-JSON-shaped batch POSTed to `endpoint`:
 
-    def __init__(self, limit: int = 1000):
+        {"process": {"serviceName": "pilosa-tpu"},
+         "spans": [{"traceID", "operationName", "startTimeMicros",
+                    "durationMicros", "tags"}]}
+
+    Spans buffer in memory and flush on a background timer or when the
+    buffer reaches `batch_size`. Export failures drop the batch (tracing
+    must never block or break the serving path)."""
+
+    def __init__(self, endpoint: str, batch_size: int = 64,
+                 flush_interval: float = 2.0, service_name: str = "pilosa-tpu"):
+        self.endpoint = endpoint
+        self.batch_size = batch_size
+        self.flush_interval = flush_interval
+        self.service_name = service_name
+        self._buf: list[dict] = []
+        self._lock = threading.Lock()
+        self._timer: Optional[threading.Timer] = None
+        self._closed = False
+        self.exported = 0  # total spans successfully shipped
+        self._schedule()
+
+    def _schedule(self) -> None:
+        if self._closed or self.flush_interval <= 0:
+            return
+        self._timer = threading.Timer(self.flush_interval, self._tick)
+        self._timer.daemon = True
+        self._timer.start()
+
+    def _tick(self) -> None:
+        try:
+            self.flush()
+        finally:
+            self._schedule()
+
+    def export(self, span: "Span") -> None:
+        rec = {
+            "traceID": span.trace_id,
+            "operationName": span.name,
+            "startTimeMicros": int(span.start_wall * 1e6),
+            "durationMicros": int(span.duration() * 1e6),
+            "tags": {k: str(v) for k, v in span.tags.items()},
+        }
+        with self._lock:
+            self._buf.append(rec)
+            full = len(self._buf) >= self.batch_size
+        if full:
+            # hand the POST to a background thread: Span.finish runs on the
+            # serving path and must never block on a slow collector
+            threading.Thread(target=self.flush, daemon=True).start()
+
+    def flush(self) -> None:
+        with self._lock:
+            batch, self._buf = self._buf, []
+        if not batch:
+            return
+        import json
+        import urllib.request
+        body = json.dumps({"process": {"serviceName": self.service_name},
+                           "spans": batch}).encode()
+        req = urllib.request.Request(
+            self.endpoint, data=body, method="POST",
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=2.0):
+                pass
+            self.exported += len(batch)
+        except Exception:
+            pass  # drop the batch: never let tracing break serving
+
+    def close(self) -> None:
+        self._closed = True
+        if self._timer is not None:
+            self._timer.cancel()
+        self.flush()
+
+
+class Tracer:
+    """Recording tracer; keeps the last `limit` finished spans.
+
+    `sampler_type`/`sampler_param` mirror the reference's Jaeger sampler
+    config (server/config.go:96-104): "const" with param>=1 samples
+    everything, "probabilistic" samples that fraction, "off"/param 0
+    samples nothing (recording still happens for slow-query logging; the
+    sampler only gates *export*)."""
+
+    def __init__(self, limit: int = 1000, exporter: Optional[SpanExporter] = None,
+                 sampler_type: str = "const", sampler_param: float = 1.0):
         self.limit = limit
         self._lock = threading.Lock()
         self.spans: list[Span] = []
+        self.exporter = exporter
+        self.sampler_type = sampler_type
+        self.sampler_param = sampler_param
 
     def start_span(self, name: str, trace_id: Optional[str] = None) -> Span:
         return Span(self, name,
                     trace_id or current_trace_id.get() or uuid.uuid4().hex[:16])
+
+    def _sampled(self, span: Span) -> bool:
+        if self.exporter is None or self.sampler_type == "off":
+            return False
+        if self.sampler_type == "probabilistic":
+            # deterministic per-trace: hash the trace id so every span of
+            # one trace gets the same verdict on every node (ids from
+            # X-Pilosa-Trace-Id are caller-supplied, not always hex)
+            import zlib
+            h = zlib.crc32(span.trace_id.encode()) if span.trace_id else 0
+            return (h % 10_000) < self.sampler_param * 10_000
+        return self.sampler_param >= 1  # const
 
     def _record(self, span: Span) -> None:
         with self._lock:
             self.spans.append(span)
             if len(self.spans) > self.limit:
                 self.spans = self.spans[-self.limit:]
+        if self._sampled(span):
+            self.exporter.export(span)
 
     def finished(self, name: Optional[str] = None) -> list[Span]:
         with self._lock:

@@ -224,6 +224,71 @@ def test_group_by(ex):
     assert groups == [{"group": [{"field": "f", "rowID": 1}], "count": 3}]
 
 
+def test_group_by_three_axes_filter_and_scale(ex):
+    """Device-batched GroupBy: 3 axes with a filter, verified against a
+    brute-force numpy cross product; then a 40x40 two-axis product to
+    exercise the chunked [P, R] dispatch path (P > P_CHUNK)."""
+    rng = np.random.default_rng(7)
+    idx = ex.holder.create_index("i")
+    f = idx.create_field("f")
+    g = idx.create_field("g")
+    h = idx.create_field("h")
+    n_cols = 500
+    sets = {}
+    for field, rows in ((f, [1, 2, 3]), (g, [5, 6]), (h, [9, 10])):
+        rids, cids = [], []
+        for r in rows:
+            cols_ = rng.choice(n_cols, size=120, replace=False)
+            sets[(field.name, r)] = set(int(c) for c in cols_)
+            rids += [r] * len(cols_)
+            cids += list(cols_)
+        field.import_bits(rids, cids)
+    filt = sets[("f", 1)] | sets[("f", 2)]
+
+    (groups,) = ex.execute(
+        "i", "GroupBy(Rows(field=f), Rows(field=g), Rows(field=h), "
+             "Union(Row(f=1), Row(f=2)))")
+    expect = []
+    for fr in (1, 2, 3):
+        for gr in (5, 6):
+            for hr in (9, 10):
+                c = len(sets[("f", fr)] & sets[("g", gr)]
+                        & sets[("h", hr)] & filt)
+                if c > 0:
+                    expect.append(
+                        {"group": [{"field": "f", "rowID": fr},
+                                   {"field": "g", "rowID": gr},
+                                   {"field": "h", "rowID": hr}],
+                         "count": c})
+    assert groups == expect
+
+    # 40x40 = 1600 combinations: crosses the P_CHUNK=64 boundary many times
+    big1 = idx.create_field("b1")
+    big2 = idx.create_field("b2")
+    r1, c1, r2, c2 = [], [], [], []
+    for r in range(40):
+        cols_ = rng.choice(n_cols, size=30, replace=False)
+        sets[("b1", r)] = set(int(c) for c in cols_)
+        r1 += [r] * 30
+        c1 += list(cols_)
+        cols_ = rng.choice(n_cols, size=30, replace=False)
+        sets[("b2", r)] = set(int(c) for c in cols_)
+        r2 += [r] * 30
+        c2 += list(cols_)
+    big1.import_bits(r1, c1)
+    big2.import_bits(r2, c2)
+    (groups,) = ex.execute("i", "GroupBy(Rows(field=b1), Rows(field=b2))")
+    got = {(d["group"][0]["rowID"], d["group"][1]["rowID"]): d["count"]
+           for d in groups}
+    expect_big = {}
+    for a in range(40):
+        for b in range(40):
+            c = len(sets[("b1", a)] & sets[("b2", b)])
+            if c > 0:
+                expect_big[(a, b)] = c
+    assert got == expect_big
+
+
 def test_attrs(ex):
     idx = ex.holder.create_index("i")
     idx.create_field("f")

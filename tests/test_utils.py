@@ -1,6 +1,7 @@
 """Aux subsystem tests: stats, tracing, logger, attr store, translate store."""
 
 import io
+import time
 
 import pytest
 
@@ -181,6 +182,74 @@ def test_diagnostics_collect_and_flush():
     srv.shutdown()
     # no URL -> disabled, flush is a no-op
     assert DiagnosticsCollector("1.0.0").flush() is False
+
+
+def test_span_exporter_ships_batches():
+    """Config-enabled span export to a collector (the reference's Jaeger
+    wiring, tracing/opentracing/opentracing.go:21-39): spans buffer, flush
+    in batches, and sampler type/param gate what ships."""
+    import json
+    import threading
+    from http.server import BaseHTTPRequestHandler, HTTPServer
+    from pilosa_tpu.utils.tracing import SpanExporter
+
+    received = []
+
+    class H(BaseHTTPRequestHandler):
+        def do_POST(self):
+            n = int(self.headers["Content-Length"])
+            received.append(json.loads(self.rfile.read(n)))
+            self.send_response(200)
+            self.end_headers()
+
+        def log_message(self, *a):
+            pass
+
+    srv = HTTPServer(("127.0.0.1", 0), H)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    url = f"http://127.0.0.1:{srv.server_address[1]}/api/traces"
+
+    exp = SpanExporter(url, batch_size=2, flush_interval=0)  # manual flush
+    tr = Tracer(exporter=exp, sampler_type="const", sampler_param=1.0)
+    with tr.start_span("executor.Count") as s:
+        s.set_tag("index", "i")
+    assert exp.exported == 0  # buffered below batch_size
+    with tr.start_span("executor.TopN"):
+        pass  # second span hits batch_size -> background flush
+    deadline = time.time() + 5
+    while exp.exported < 2 and time.time() < deadline:
+        time.sleep(0.01)
+    assert exp.exported == 2
+    batch = received[0]
+    assert batch["process"]["serviceName"] == "pilosa-tpu"
+    ops = [s["operationName"] for s in batch["spans"]]
+    assert ops == ["executor.Count", "executor.TopN"]
+    assert batch["spans"][0]["tags"] == {"index": "i"}
+    assert batch["spans"][0]["durationMicros"] >= 0
+
+    # sampler off -> recorded locally, never exported
+    tr_off = Tracer(exporter=exp, sampler_type="off")
+    with tr_off.start_span("x"):
+        pass
+    exp.flush()
+    assert exp.exported == 2
+    assert len(tr_off.finished("x")) == 1
+
+    # probabilistic is deterministic per trace id
+    tr_p = Tracer(exporter=exp, sampler_type="probabilistic",
+                  sampler_param=0.5)
+    v1 = tr_p._sampled(tr_p.start_span("y", trace_id="abc"))
+    v2 = tr_p._sampled(tr_p.start_span("y", trace_id="abc"))
+    assert v1 == v2
+
+    # export failure (collector gone) drops the batch, never raises
+    srv.shutdown()
+    with tr.start_span("a"):
+        pass
+    with tr.start_span("b"):
+        pass
+    assert exp.exported == 2
+    exp.close()
 
 
 def test_runtime_monitor_gauges():
