@@ -78,10 +78,14 @@ class MeshConfig:
     virtual meshes; empty = default, i.e. the TPU plugin).
     host_devices: when >0, force N virtual CPU host devices via XLA_FLAGS —
     the 8-device test-mesh recipe, exposed as config for CI parity.
+    replicas: when >1, fold the device list into a ("replica", "shard")
+    mesh — data replicated per slice, query stream data-parallel over
+    replicas (SURVEY §2.9 strategy 3; the on-mesh ReplicaN analog).
     """
     devices: str = "auto"
     platform: str = ""
     host_devices: int = 0
+    replicas: int = 1
 
 
 @dataclass
@@ -190,6 +194,7 @@ class Config:
             f'devices = "{self.mesh.devices}"',
             f'platform = "{self.mesh.platform}"',
             f"host-devices = {self.mesh.host_devices}",
+            f"replicas = {self.mesh.replicas}",
         ]
         return "\n".join(lines) + "\n"
 

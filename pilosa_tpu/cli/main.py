@@ -103,7 +103,8 @@ def cmd_server(args) -> int:
     try:
         mesh = mesh_from_config(devices=cfg.mesh.devices,
                                 platform=cfg.mesh.platform,
-                                host_devices=cfg.mesh.host_devices)
+                                host_devices=cfg.mesh.host_devices,
+                                replicas=cfg.mesh.replicas)
     except ValueError as e:
         raise SystemExit(f"error: building device mesh: {e}")
     server = Server(

@@ -879,14 +879,14 @@ class Executor:
         axis_rows = [rows0] + [a[1] for a in axes[1:]]
         axis_names = [fname0] + [a[0] for a in axes[1:]]
         for k in range(len(counts)):
+            if limit is not None and len(results) >= limit:
+                break  # before append: limit=0 yields [] (old recursion)
             results.append({
                 "group": [{"field": axis_names[a],
                            "rowID": int(axis_rows[a][comb[a][k]])}
                           for a in range(len(comb))],
                 "count": int(counts[k]),
             })
-            if limit is not None and len(results) >= limit:
-                break
         return GroupCounts(results)
 
     # -------------------------------------------------------------- writes

@@ -28,12 +28,27 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from pilosa_tpu.ops.bitvector import popcount
 
 SHARD_AXIS = "shard"
+REPLICA_AXIS = "replica"
 
 
-def make_mesh(devices: Optional[Sequence] = None, axis: str = SHARD_AXIS) -> Mesh:
-    """1-D mesh over all (or given) devices; the shard axis is the analog of
-    the reference's node ring (cluster.go:857)."""
+def make_mesh(devices: Optional[Sequence] = None, axis: str = SHARD_AXIS,
+              replicas: int = 1) -> Mesh:
+    """Mesh over all (or given) devices; the shard axis is the analog of
+    the reference's node ring (cluster.go:857).
+
+    replicas > 1 builds a 2-D ("replica", "shard") mesh: slab leaves are
+    sharded over "shard" and replicated over "replica" (SURVEY §2.9
+    strategy 3 — the ReplicaN copies of the reference mapped onto mesh
+    slices), and the query *stream* data-parallelizes over "replica"
+    (pair_stream_counts): each replica serves its slice of the queries
+    against a full copy of the data."""
     devices = list(devices) if devices is not None else jax.devices()
+    if replicas > 1:
+        if len(devices) % replicas:
+            raise ValueError(
+                f"{len(devices)} devices not divisible by {replicas} replicas")
+        return Mesh(np.array(devices).reshape(replicas, -1),
+                    (REPLICA_AXIS, axis))
     return Mesh(np.array(devices), (axis,))
 
 
@@ -65,7 +80,7 @@ def force_platform(platform: str, host_devices: int = 0,
 
 
 def mesh_from_config(devices: str = "auto", platform: str = "",
-                     host_devices: int = 0) -> Optional[Mesh]:
+                     host_devices: int = 0, replicas: int = 1) -> Optional[Mesh]:
     """Build the production server's mesh from [mesh] config (cli/config.py).
 
     Must run before any other backend use in the process: platform forcing
@@ -94,7 +109,7 @@ def mesh_from_config(devices: str = "auto", platform: str = "",
         avail = avail[:n]
     if len(avail) < 2:
         return None
-    return make_mesh(avail)
+    return make_mesh(avail, replicas=max(replicas, 1))
 
 
 # -- program evaluation ------------------------------------------------------
@@ -161,6 +176,53 @@ def count_pair_stream(rows: jax.Array, ii: jax.Array, jj: jax.Array,
     return tot
 
 
+def pair_stream_counts(mesh: Mesh, rows: jax.Array, ii: np.ndarray,
+                       jj: np.ndarray) -> np.ndarray:
+    """Per-query counts for a stream of K Count(Intersect(Row i, Row j))
+    queries on a replica×shard mesh — the throughput form of the serving
+    path (SURVEY §2.9 strategy 3).
+
+    SPMD layout: rows[R, S, W] sharded P(None, "shard", None) and
+    *replicated* over "replica"; the query stream ii/jj[K] shards over
+    "replica" so each replica slice scans only its K/replicas queries
+    against its full data copy. Inside shard_map each step is the fused
+    gather+and+popcount; the only collective is a psum over "shard" (ICI)
+    for each query's global count. Returns host int64[K].
+    """
+    from jax.experimental.shard_map import shard_map
+
+    n_rep = mesh.shape.get(REPLICA_AXIS, 1)
+    # on a 1-D ('shard',) mesh there is no replica axis: every device scans
+    # the full stream (replicated), sharded only over the data
+    rep_spec = P(REPLICA_AXIS) if REPLICA_AXIS in mesh.shape else P()
+    k = ii.shape[0]
+    pad = (-k) % n_rep
+    if pad:  # pad with (0, 0) no-op queries, dropped after gather
+        ii = np.concatenate([ii, np.zeros(pad, ii.dtype)])
+        jj = np.concatenate([jj, np.zeros(pad, jj.dtype)])
+    ii_d = jax.device_put(ii, NamedSharding(mesh, rep_spec))
+    jj_d = jax.device_put(jj, NamedSharding(mesh, rep_spec))
+
+    @jax.jit
+    @functools.partial(
+        shard_map, mesh=mesh,
+        in_specs=(P(None, SHARD_AXIS, None), rep_spec, rep_spec),
+        out_specs=rep_spec,
+        check_rep=False)
+    def run(rows_blk, ii_blk, jj_blk):
+        def body(_, ij):
+            i, j = ij
+            a = jax.lax.dynamic_index_in_dim(rows_blk, i, 0, keepdims=False)
+            b = jax.lax.dynamic_index_in_dim(rows_blk, j, 0, keepdims=False)
+            local = jnp.sum(popcount(jnp.bitwise_and(a, b)))
+            return 0, jax.lax.psum(local, SHARD_AXIS)
+        _, counts = jax.lax.scan(body, 0, (ii_blk, jj_blk))
+        return counts
+
+    out = np.asarray(run(rows, ii_d, jj_d)).astype(np.int64)
+    return out[:k]
+
+
 class DeviceRunner:
     """Executes shard-slab programs, optionally over a mesh.
 
@@ -184,12 +246,25 @@ class DeviceRunner:
     def n_devices(self) -> int:
         return 1 if self.mesh is None else self.mesh.size
 
+    @property
+    def n_shard_slots(self) -> int:
+        """Devices along the shard axis — what leaf padding must align to
+        (the replica axis holds copies, not partitions)."""
+        return 1 if self.mesh is None else self.mesh.shape[SHARD_AXIS]
+
+    @property
+    def n_replicas(self) -> int:
+        return (1 if self.mesh is None
+                else self.mesh.shape.get(REPLICA_AXIS, 1))
+
     def put_leaf(self, rows: np.ndarray) -> jax.Array:
         """Place one leaf [S, W] on device(s), padded to a multiple of the
-        mesh size and sharded over the shard axis — the unit cached by the
-        HBM residency manager (parallel/residency.py)."""
+        shard-axis size and sharded over it — the unit cached by the HBM
+        residency manager (parallel/residency.py). On a replica×shard mesh
+        the unmentioned replica axis replicates: every replica slice holds
+        a full copy of the leaf (ReplicaN on-mesh, SURVEY §2.9)."""
         s = rows.shape[0]
-        pad = (-s) % self.n_devices
+        pad = (-s) % self.n_shard_slots
         if pad:
             rows = np.pad(rows, ((0, pad), (0, 0)))
         rows = np.ascontiguousarray(rows)

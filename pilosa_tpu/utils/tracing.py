@@ -79,6 +79,7 @@ class SpanExporter:
         self._buf: list[dict] = []
         self._lock = threading.Lock()
         self._timer: Optional[threading.Timer] = None
+        self._flush_pending = False  # at most one batch-full flusher thread
         self._closed = False
         self.exported = 0  # total spans successfully shipped
         self._schedule()
@@ -106,11 +107,22 @@ class SpanExporter:
         }
         with self._lock:
             self._buf.append(rec)
-            full = len(self._buf) >= self.batch_size
-        if full:
-            # hand the POST to a background thread: Span.finish runs on the
-            # serving path and must never block on a slow collector
-            threading.Thread(target=self.flush, daemon=True).start()
+            # hand the POST to one background thread: Span.finish runs on
+            # the serving path and must never block on a slow collector,
+            # and a slow collector must not fan out unbounded threads
+            spawn = (len(self._buf) >= self.batch_size
+                     and not self._flush_pending)
+            if spawn:
+                self._flush_pending = True
+        if spawn:
+            threading.Thread(target=self._bg_flush, daemon=True).start()
+
+    def _bg_flush(self) -> None:
+        try:
+            self.flush()
+        finally:
+            with self._lock:
+                self._flush_pending = False
 
     def flush(self) -> None:
         with self._lock:

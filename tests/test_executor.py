@@ -15,10 +15,16 @@ from pilosa_tpu.models.row import Row
 from pilosa_tpu.parallel.mesh import DeviceRunner, make_mesh
 
 
-@pytest.fixture(params=["single", "mesh"])
+@pytest.fixture(params=["single", "mesh", "replica_mesh"])
 def ex(tmp_path, request):
     h = Holder(str(tmp_path / "data")).open()
-    runner = DeviceRunner(make_mesh() if request.param == "mesh" else None)
+    mesh = None
+    if request.param == "mesh":
+        mesh = make_mesh()
+    elif request.param == "replica_mesh":
+        # 2x4 replica×shard: leaves replicated per slice, sharded within
+        mesh = make_mesh(replicas=2)
+    runner = DeviceRunner(mesh)
     e = Executor(h, runner=runner)
     yield e
     h.close()
