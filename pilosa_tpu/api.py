@@ -383,8 +383,16 @@ class API:
             shard = int(col) // SHARD_WIDTH
             owners = owners_by_shard.get(shard)
             if owners is None:
-                owners = owners_by_shard[shard] = \
-                    self.cluster.shard_nodes(index_name, shard)
+                all_owners = self.cluster.shard_nodes(index_name, shard)
+                # skip probe-detected-down replicas: the returning node
+                # heals via anti-entropy; zero live owners is a hard error
+                # (an acked import must land somewhere)
+                owners = [n for n in all_owners
+                          if not self.cluster.is_down(n.id)]
+                if all_owners and not owners:
+                    raise ApiError(
+                        f"all replicas down for shard {shard}", status=503)
+                owners_by_shard[shard] = owners
             for node in owners:
                 if node.id == self.cluster.local_id:
                     local_idx.append(i)
@@ -446,7 +454,14 @@ class API:
         f = self._field(index_name, field_name)
         if not remote and self.forward_roaring_fn is not None \
                 and len(self.cluster.nodes) > 1:
-            owners = self.cluster.shard_nodes(index_name, shard)
+            all_owners = self.cluster.shard_nodes(index_name, shard)
+            # same down-replica policy as _route_import: skip (heals via
+            # anti-entropy on return), hard error when nothing is live
+            owners = [n for n in all_owners
+                      if not self.cluster.is_down(n.id)]
+            if all_owners and not owners:
+                raise ApiError(
+                    f"all replicas down for shard {shard}", status=503)
             for node in owners:
                 if node.id != self.cluster.local_id:
                     try:

@@ -39,6 +39,9 @@ class ClusterConfig:
     # peer is marked down, and the per-probe timeout in seconds
     liveness_threshold: int = 3
     probe_timeout: float = 2.0
+    # seconds between membership refresh + liveness probe ticks (the
+    # memberlist ProbeInterval analog, gossip/gossip.go:508-519)
+    membership_interval: float = 5.0
 
 
 @dataclass

@@ -113,6 +113,7 @@ def cmd_server(args) -> int:
         replica_n=cfg.cluster.replicas,
         liveness_threshold=cfg.cluster.liveness_threshold,
         probe_timeout=cfg.cluster.probe_timeout,
+        membership_interval=cfg.cluster.membership_interval,
         anti_entropy_interval=cfg.anti_entropy.interval,
         join=getattr(args, "join", False),
         long_query_time=cfg.cluster.long_query_time,

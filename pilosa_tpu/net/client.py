@@ -152,8 +152,8 @@ class InternalClient:
     def send_message(self, uri: str, message: dict) -> None:
         self._json("POST", uri, "/internal/cluster/message", message)
 
-    def nodes(self, uri: str) -> list[dict]:
-        out = self._request("GET", uri, "/internal/nodes")
+    def nodes(self, uri: str, timeout: Optional[float] = None) -> list[dict]:
+        out = self._request("GET", uri, "/internal/nodes", timeout=timeout)
         return json.loads(out)
 
     def status(self, uri: str, timeout: Optional[float] = None) -> dict:

@@ -123,6 +123,7 @@ class Server:
         self.liveness_threshold = liveness_threshold
         self.probe_timeout = probe_timeout
         self._probe_failures: dict[str, int] = {}
+        self._return_sync_running = False  # single-flight node-return heal
         # join=True: this node is being added to an existing cluster —
         # cluster_hosts are seed URIs (the gossip-seeds analog). It announces
         # itself and stays STARTING until the coordinator's resize completes
@@ -267,7 +268,11 @@ class Server:
             if huri == self.http.uri:
                 continue
             try:
-                reports.extend(self.client.nodes(huri) or [])
+                # short timeout: a SIGSTOP'd/hung seed must not stall the
+                # membership tick for the client's default 30s — liveness
+                # probing downstream of this fetch depends on ticks firing
+                reports.extend(
+                    self.client.nodes(huri, timeout=self.probe_timeout) or [])
             except ClientError:
                 pass
         return reports
@@ -333,6 +338,7 @@ class Server:
                     self.logger.printf("liveness: node %s (%s) back up",
                                        node.id, node.uri)
                     self.cluster.mark_up(node.id)
+                    self._on_node_return(node)
                 self._probe_failures.pop(node.id, None)
             else:
                 n = self._probe_failures.get(node.id, 0) + 1
@@ -346,6 +352,75 @@ class Server:
                         < self.cluster.replica_n else "STARTING")
                     self.cluster.mark_down(node.id)
                     self.stats.count("liveness/node_down")
+
+    def _on_node_return(self, node) -> None:
+        """Heal a peer that was probe-marked down and came back: broadcasts
+        skipped it while down, so (a) the coordinator re-pushes schema DDL +
+        available shards it may have missed, and (b) this node runs one
+        anti-entropy pass — even when the periodic ticker is disabled — so
+        writes acked during the outage reach the returning replica (the
+        reference's returning memberlist node gets the cluster status on
+        re-join, cluster.go:1755-1765, and heals via anti-entropy).
+
+        Every observer pushes (not just the coordinator — the down node may
+        BE the coordinator); the sync applies via create-if-not-exists, so
+        duplicate pushes are idempotent. Missed delete-index/delete-field
+        broadcasts are NOT replayed — the returning node keeps the deleted
+        schema objects, matching the reference (a memberlist node that was
+        partitioned through a DeleteIndex keeps it too; holder.go has no
+        delete reconciliation) — but stale fragments are never pushed back
+        to peers (the peer's 404 distinguishes missing-fragment from
+        missing-field, _sync_fragment).
+
+        The entire heal runs on a background thread (the probe tick must
+        never block on the returning node), is single-flight, and syncs
+        only the shards this node co-owns with the returner — not a full
+        cluster-wide pass per observer."""
+        if self._return_sync_running:
+            return
+        self._return_sync_running = True
+
+        def heal():
+            try:
+                try:
+                    self.client.send_message(node.uri, {
+                        "type": "schema-sync",
+                        "schema": self.holder.schema(),
+                        "availableShards": {
+                            iname: {fname: [int(s)
+                                            for s in f.available_shards.slice()]
+                                    for fname, f in idx.fields.items()}
+                            for iname, idx in self.holder.indexes.items()},
+                    })
+                except ClientError as e:
+                    self.logger.printf(
+                        "liveness: schema re-sync to %s failed: %s",
+                        node.id, e)
+                try:
+                    self._sync_with_node(node.id)
+                except Exception as e:  # noqa: BLE001 — best-effort healing
+                    self.logger.printf(
+                        "liveness: post-return sync failed: %s", e)
+            finally:
+                self._return_sync_running = False
+
+        threading.Thread(target=heal, daemon=True).start()
+
+    def _sync_with_node(self, node_id: str) -> int:
+        """One anti-entropy pass scoped to fragments co-owned with one peer
+        (the returning-node heal: full sync_holder per observer would be an
+        O(N^2) RPC storm per return event)."""
+        merged = 0
+        for iname, idx in self.holder.indexes.items():
+            for fname, field in idx.fields.items():
+                for vname, view in field.views.items():
+                    for shard in view.shards():
+                        owners = {n.id for n in
+                                  self.cluster.shard_nodes(iname, shard)}
+                        if self.node_id in owners and node_id in owners:
+                            merged += self._sync_fragment(
+                                iname, fname, vname, shard)
+        return merged
 
     def close(self) -> None:
         self.closed = True
@@ -419,6 +494,19 @@ class Server:
             self._apply_topology(msg["nodes"], msg.get("removed"))
         elif mtype == "cluster-state":
             self.cluster._set_state(msg["state"])
+        elif mtype == "schema-sync":
+            # coordinator push to a node returning from down: DDL broadcasts
+            # it missed while broadcasts skipped it (_on_node_return)
+            self._apply_schema(msg.get("schema", []))
+            for iname, fields in msg.get("availableShards", {}).items():
+                idx = self.holder.index(iname)
+                if idx is None:
+                    continue
+                for fname, shards in fields.items():
+                    f = idx.field(fname)
+                    if f is not None:
+                        for s in shards:
+                            f.add_available_shard(int(s), quiet=True)
         else:
             raise ValueError(f"unknown cluster message type: {mtype}")
 
@@ -429,9 +517,11 @@ class Server:
                         "field": field_name, "shard": shard})
 
     def broadcast(self, msg: dict) -> None:
-        """SendSync: POST to every peer (server.go:582-604)."""
+        """SendSync: POST to every peer (server.go:582-604). Known-down
+        peers are skipped — they re-sync membership/schema on return."""
         for node in self.cluster.nodes:
-            if node.id == self.node_id or not node.uri:
+            if node.id == self.node_id or not node.uri \
+                    or self.cluster.is_down(node.id):
                 continue
             try:
                 self.client.send_message(node.uri, msg)
@@ -926,8 +1016,18 @@ class Server:
                 remote = {b["id"]: b["checksum"]
                           for b in self.client.fragment_blocks(
                               node.uri, iname, fname, vname, shard)}
-            except ClientError:
-                continue
+            except ClientError as e:
+                if e.status != 404 or "fragment not found" not in str(e):
+                    # a missing *index/field* on the peer means it was
+                    # deleted there (we missed the broadcast while down):
+                    # do NOT push — that would churn RPCs against the
+                    # deleted schema every pass
+                    continue
+                # peer owns the shard but has no fragment at all (e.g. it
+                # was down for the write that created it): every local
+                # block is local-only — push them all, creating the
+                # fragment remotely via the import
+                remote = {}
             for blk in set(local_blocks) | set(remote):
                 lc = local_blocks.get(blk)
                 if lc is not None and remote.get(blk) == lc.hex():
@@ -935,8 +1035,10 @@ class Server:
                 try:
                     data = self.client.block_data(node.uri, iname, fname, vname,
                                                   shard, blk)
-                except ClientError:
-                    continue
+                except ClientError as e:
+                    if e.status != 404:
+                        continue
+                    data = {}  # no remote fragment/block: all pairs push
                 import numpy as np
                 sets_r, sets_c = frag.merge_block(
                     blk, np.array(data.get("rowIDs", []), dtype=np.int64),
