@@ -41,14 +41,18 @@ from pilosa_tpu.utils.translate import TranslateStore
 
 
 class ApiError(Exception):
-    def __init__(self, msg: str, status: int = 400):
+    def __init__(self, msg: str, status: int = 400, code: str = ""):
         super().__init__(msg)
         self.status = status
+        # machine-readable discriminator carried in the JSON error body —
+        # peers dispatch on it (e.g. anti-entropy distinguishes a missing
+        # fragment from deleted schema) without parsing prose
+        self.code = code
 
 
 class NotFoundError(ApiError):
-    def __init__(self, msg: str):
-        super().__init__(msg, status=404)
+    def __init__(self, msg: str, code: str = ""):
+        super().__init__(msg, status=404, code=code)
 
 
 class ConflictError(ApiError):
@@ -367,6 +371,17 @@ class API:
         f.import_bits(row_ids, column_ids, ts)
         self._import_existence(index, column_ids)
 
+    def _live_shard_owners(self, index_name: str, shard: int) -> list:
+        """Owning replicas minus probe-detected-down nodes — the shared
+        routing policy of every import path: a down replica is skipped (it
+        heals via anti-entropy on return), and zero live owners is a hard
+        503 (an acked import must land somewhere)."""
+        all_owners = self.cluster.shard_nodes(index_name, shard)
+        owners = [n for n in all_owners if not self.cluster.is_down(n.id)]
+        if all_owners and not owners:
+            raise ApiError(f"all replicas down for shard {shard}", status=503)
+        return owners
+
     def _route_import(self, index_name: str, field_name: str,
                       a_ids: list, column_ids: list, extra,
                       values: bool = False):
@@ -383,16 +398,8 @@ class API:
             shard = int(col) // SHARD_WIDTH
             owners = owners_by_shard.get(shard)
             if owners is None:
-                all_owners = self.cluster.shard_nodes(index_name, shard)
-                # skip probe-detected-down replicas: the returning node
-                # heals via anti-entropy; zero live owners is a hard error
-                # (an acked import must land somewhere)
-                owners = [n for n in all_owners
-                          if not self.cluster.is_down(n.id)]
-                if all_owners and not owners:
-                    raise ApiError(
-                        f"all replicas down for shard {shard}", status=503)
-                owners_by_shard[shard] = owners
+                owners = owners_by_shard[shard] = \
+                    self._live_shard_owners(index_name, shard)
             for node in owners:
                 if node.id == self.cluster.local_id:
                     local_idx.append(i)
@@ -454,14 +461,7 @@ class API:
         f = self._field(index_name, field_name)
         if not remote and self.forward_roaring_fn is not None \
                 and len(self.cluster.nodes) > 1:
-            all_owners = self.cluster.shard_nodes(index_name, shard)
-            # same down-replica policy as _route_import: skip (heals via
-            # anti-entropy on return), hard error when nothing is live
-            owners = [n for n in all_owners
-                      if not self.cluster.is_down(n.id)]
-            if all_owners and not owners:
-                raise ApiError(
-                    f"all replicas down for shard {shard}", status=503)
+            owners = self._live_shard_owners(index_name, shard)
             for node in owners:
                 if node.id != self.cluster.local_id:
                     try:
@@ -589,7 +589,7 @@ class API:
         view = f.view(view_name)
         frag = view.fragment(shard) if view else None
         if frag is None:
-            raise NotFoundError("fragment not found")
+            raise NotFoundError("fragment not found", code="fragment-not-found")
         return [{"id": b, "checksum": chk.hex()} for b, chk in frag.blocks()]
 
     def fragment_block_data(self, index_name: str, field_name: str,
@@ -598,7 +598,7 @@ class API:
         view = f.view(view_name)
         frag = view.fragment(shard) if view else None
         if frag is None:
-            raise NotFoundError("fragment not found")
+            raise NotFoundError("fragment not found", code="fragment-not-found")
         rows, cols = frag.block_data(block)
         return {"rowIDs": rows.tolist(), "columnIDs": cols.tolist()}
 
@@ -630,7 +630,7 @@ class API:
         view = f.view(view_name)
         frag = view.fragment(shard) if view else None
         if frag is None:
-            raise NotFoundError("fragment not found")
+            raise NotFoundError("fragment not found", code="fragment-not-found")
         return frag.storage.to_bytes()
 
     def delete_remote_available_shard(self, index_name: str, field_name: str,

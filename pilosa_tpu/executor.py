@@ -594,32 +594,49 @@ class Executor:
 
         if ids_arg is not None:
             # explicit ids / distributed phase-2 recount: exact counts for
-            # just these rows
+            # just these rows. Plain row counts come from HOST container
+            # metadata (row().Count() sums container cardinalities — the
+            # reference's fragment.top RowIDs path); the device is only
+            # needed when an intersection source is in play.
             ids = list(ids_arg)
             if allowed is not None:
                 ids = [rid for rid in ids
                        if f.row_attrs.attrs(rid).get(attr_name) in allowed]
-            pairs = self._exact_counts(index, f, shards, ids,
-                                       src_dense, tanimoto)
+            if src_dense is None:
+                pairs = self._host_row_counts(index, f, shards, ids)
+            else:
+                pairs = self._exact_counts(index, f, shards, ids,
+                                           src_dense, tanimoto)
         else:
-            cand = self._topn_candidate_pairs(index, f, shards)
+            cand_ids, cand_counts = self._topn_candidate_arrays(
+                index, f, shards)
             if allowed is not None:
-                cand = [(rid, c) for rid, c in cand
-                        if f.row_attrs.attrs(rid).get(attr_name) in allowed]
+                keep = np.fromiter(
+                    (f.row_attrs.attrs(int(r)).get(attr_name) in allowed
+                     for r in cand_ids), bool, cand_ids.size)
+                cand_ids, cand_counts = cand_ids[keep], cand_counts[keep]
             if threshold:
                 # cached counts bound the final count from above (they are
                 # full row counts; intersection can only shrink them), so
                 # rows under the floor can be dropped before any recount
-                cand = [(rid, c) for rid, c in cand if c >= threshold]
+                keep = cand_counts >= threshold
+                cand_ids, cand_counts = cand_ids[keep], cand_counts[keep]
             if src_dense is not None:
+                cand = list(zip(cand_ids.tolist(), cand_counts.tolist()))
                 pairs = self._topn_src_walk(index, f, shards, cand,
                                             src_dense, n, tanimoto)
             else:
                 # cached counts are exact per-shard (write-maintained,
-                # view.py:141-147); recount only the merged winners
-                winners = cand[:n] if n is not None else cand
-                pairs = self._exact_counts(
-                    index, f, shards, [rid for rid, _ in winners], None, 0)
+                # view.py:141-147) but a row can be missing from a shard's
+                # cache (evicted below the floor), so the merged winners are
+                # recounted — on the HOST from container cardinality sums
+                # (the reference's two-phase exact recount walks
+                # fragment.row().Count(), not dense bits; materializing a
+                # dense [S, W] leaf per winner would move MBs for rows that
+                # hold a handful of bits)
+                winner_ids = cand_ids[:n] if n is not None else cand_ids
+                pairs = self._host_row_counts(
+                    index, f, shards, winner_ids.tolist())
         if threshold:
             pairs = [(i, c) for i, c in pairs if c >= threshold]
         merged = merge_pairs([pairs])
@@ -627,15 +644,19 @@ class Executor:
             merged = merged[:n]
         return Pairs((i, c) for i, c in merged if c > 0)
 
-    def _topn_candidate_pairs(self, index: Index, f, shards) -> list[tuple[int, int]]:
-        """Merged (row_id, cached_count) candidates from per-shard rank
-        caches, count-desc. A ranked field's missing/empty cache is rebuilt
-        in place (guaranteed-present); a cache-less field yields NO
-        candidates, matching the reference's nopCache (cache.go:461-481) —
-        the round-1 full-row-id-scan fallback is gone."""
+    def _topn_candidate_arrays(self, index: Index, f, shards):
+        """Merged (ids, cached_counts) int64 arrays from per-shard rank
+        caches, count-desc — all-numpy (memoized per-cache rank order +
+        vectorized reduce; the pure-Python tuple walk dominated TopN p50).
+        A ranked field's missing/empty cache is rebuilt in place
+        (guaranteed-present); a cache-less field yields NO candidates,
+        matching the reference's nopCache (cache.go:461-481) — the round-1
+        full-row-id-scan fallback is gone."""
+        from pilosa_tpu.models.cache import merge_pair_arrays
+
         view = f.view(VIEW_STANDARD)
         if view is None:
-            return []
+            return np.empty(0, np.int64), np.empty(0, np.int64)
         per_shard = []
         for s in shards:
             cache = view.rank_caches.get(s)
@@ -645,8 +666,8 @@ class Executor:
                     view.refresh_rank_cache(s)
                     cache = view.rank_caches.get(s)
             if cache is not None and len(cache):
-                per_shard.append(cache.top())
-        return merge_pairs(per_shard)
+                per_shard.append(cache.top_arrays())
+        return merge_pair_arrays(per_shard)
 
     def _topn_src_walk(self, index: Index, f, shards,
                        pairs: list[tuple[int, int]], src_dense, n,
@@ -709,6 +730,30 @@ class Executor:
         if n is None:
             return out
         return [(-nrid, c) for c, nrid in heap]
+
+    def _host_row_counts(self, index: Index, f, shards,
+                         row_ids: list[int]) -> list[tuple[int, int]]:
+        """Exact full-row counts from container metadata — O(containers in
+        the row's key range) per (row, shard), zero dense materialization
+        (fragment.go top RowIDs path via row().Count()). Memoized on the
+        row-generation key so a repeated TopN costs dict lookups."""
+        view = f.view(VIEW_STANDARD)
+        out = []
+        for rid in row_ids:
+            total = 0
+            for s in shards:
+                frag = view.fragment(s) if view is not None else None
+                if frag is None:
+                    continue
+                key = ("rowcount", index.name, f.name, s, rid,
+                       frag.row_generation(rid), self._row_cache_epoch)
+                c = self._row_cache.get(key)
+                if c is None:
+                    c = frag.row_count(rid)
+                    self._row_cache[key] = c
+                total += c
+            out.append((rid, total))
+        return out
 
     def _exact_counts(self, index: Index, f, shards, row_ids: list[int],
                       src_dense, tanimoto: int):

@@ -19,9 +19,10 @@ from pilosa_tpu.utils import qctx, tracing
 
 
 class ClientError(Exception):
-    def __init__(self, msg: str, status: int = 0):
+    def __init__(self, msg: str, status: int = 0, code: str = ""):
         super().__init__(msg)
         self.status = status
+        self.code = code  # machine-readable ApiError.code from the peer
 
 
 class InternalClient:
@@ -64,7 +65,13 @@ class InternalClient:
                 return resp.read()
         except urllib.error.HTTPError as e:
             detail = e.read().decode(errors="replace")
-            raise ClientError(f"{method} {path}: {e.code}: {detail}", status=e.code)
+            code = ""
+            try:
+                code = json.loads(detail).get("code", "")
+            except (ValueError, AttributeError):
+                pass
+            raise ClientError(f"{method} {path}: {e.code}: {detail}",
+                              status=e.code, code=code)
         except TimeoutError as e:
             raise ClientError(f"{method} {path}: timed out: {e}")
         except urllib.error.URLError as e:

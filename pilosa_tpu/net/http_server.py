@@ -147,7 +147,7 @@ class Handler:
                 except qctx.QueryTimeoutError as e:
                     return self._error(504, str(e))
                 except ApiError as e:
-                    return self._error(e.status, str(e))
+                    return self._error(e.status, str(e), code=e.code)
                 except Exception as e:  # noqa: BLE001 — surface as 500
                     return self._error(500, str(e))
                 finally:
@@ -162,13 +162,17 @@ class Handler:
 
     # -- helpers ------------------------------------------------------------
 
-    def _error(self, status: int, msg: str):
+    def _error(self, status: int, msg: str, code: str = ""):
         """Protobuf clients get errors as QueryResponse{Err} so they can
-        unmarshal them (proto.go encodes Err the same way); JSON otherwise."""
+        unmarshal them (proto.go encodes Err the same way); JSON otherwise.
+        `code` is the machine-readable discriminator (ApiError.code)."""
         if self._wants_proto():
             return (status, PROTO_CONTENT_TYPE,
                     self.serializer.encode_query_response([], err=msg))
-        return status, "application/json", json.dumps({"error": msg}).encode()
+        body = {"error": msg}
+        if code:
+            body["code"] = code
+        return status, "application/json", json.dumps(body).encode()
 
     @staticmethod
     def _json(payload, status: int = 200):

@@ -123,7 +123,8 @@ class Server:
         self.liveness_threshold = liveness_threshold
         self.probe_timeout = probe_timeout
         self._probe_failures: dict[str, int] = {}
-        self._return_sync_running = False  # single-flight node-return heal
+        # node ids with an in-flight return-heal (single-flight per node)
+        self._return_sync_running: set[str] = set()
         # join=True: this node is being added to an existing cluster —
         # cluster_hosts are seed URIs (the gossip-seeds analog). It announces
         # itself and stays STARTING until the coordinator's resize completes
@@ -373,12 +374,13 @@ class Server:
         missing-field, _sync_fragment).
 
         The entire heal runs on a background thread (the probe tick must
-        never block on the returning node), is single-flight, and syncs
-        only the shards this node co-owns with the returner — not a full
-        cluster-wide pass per observer."""
-        if self._return_sync_running:
+        never block on the returning node), is single-flight PER RETURNING
+        NODE (two nodes returning together each get their own heal), and
+        syncs only the shards this node co-owns with the returner — not a
+        full cluster-wide pass per observer."""
+        if node.id in self._return_sync_running:
             return
-        self._return_sync_running = True
+        self._return_sync_running.add(node.id)
 
         def heal():
             try:
@@ -402,7 +404,7 @@ class Server:
                     self.logger.printf(
                         "liveness: post-return sync failed: %s", e)
             finally:
-                self._return_sync_running = False
+                self._return_sync_running.discard(node.id)
 
         threading.Thread(target=heal, daemon=True).start()
 
@@ -1012,12 +1014,13 @@ class Server:
             if node.id == self.node_id or not node.uri \
                     or self.cluster.is_down(node.id):
                 continue
+            peer_has_fragment = True
             try:
                 remote = {b["id"]: b["checksum"]
                           for b in self.client.fragment_blocks(
                               node.uri, iname, fname, vname, shard)}
             except ClientError as e:
-                if e.status != 404 or "fragment not found" not in str(e):
+                if e.code != "fragment-not-found":
                     # a missing *index/field* on the peer means it was
                     # deleted there (we missed the broadcast while down):
                     # do NOT push — that would churn RPCs against the
@@ -1028,17 +1031,21 @@ class Server:
                 # block is local-only — push them all, creating the
                 # fragment remotely via the import
                 remote = {}
+                peer_has_fragment = False
             for blk in set(local_blocks) | set(remote):
                 lc = local_blocks.get(blk)
                 if lc is not None and remote.get(blk) == lc.hex():
                     continue
-                try:
-                    data = self.client.block_data(node.uri, iname, fname, vname,
-                                                  shard, blk)
-                except ClientError as e:
-                    if e.status != 404:
-                        continue
-                    data = {}  # no remote fragment/block: all pairs push
+                if not peer_has_fragment:
+                    data = {}  # proven absent: skip the per-block 404 RPC
+                else:
+                    try:
+                        data = self.client.block_data(node.uri, iname, fname,
+                                                      vname, shard, blk)
+                    except ClientError as e:
+                        if e.status != 404:
+                            continue
+                        data = {}  # block raced away: all pairs push
                 import numpy as np
                 sets_r, sets_c = frag.merge_block(
                     blk, np.array(data.get("rowIDs", []), dtype=np.int64),
