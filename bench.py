@@ -455,7 +455,9 @@ def _probe_backend(timeout_s: float):
 def _emit_failure(error: str) -> None:
     detail = {"error": error}
     try:
-        small_shards = min(64, N_SHARDS)
+        # scale the estimate to the headline metric's workload (the
+        # EXEC_SHARDS executor benchmark, not the kernel slab)
+        small_shards = min(64, EXEC_SHARDS)
         rng = np.random.default_rng(7)
         rows = rng.integers(
             0, 2**32, size=(2, small_shards, WORDS_PER_SHARD),
@@ -463,7 +465,7 @@ def _emit_failure(error: str) -> None:
         np.bitwise_count(rows[0] & rows[1]).sum()  # warm
         t0 = time.perf_counter()
         np.bitwise_count(rows[0] & rows[1]).sum()
-        cpu_s = (time.perf_counter() - t0) * (N_SHARDS / small_shards)
+        cpu_s = (time.perf_counter() - t0) * (EXEC_SHARDS / small_shards)
         detail["cpu_numpy_ms_per_query_est"] = round(cpu_s * 1e3, 4)
         detail["baseline_shards_measured"] = small_shards
     except Exception as e:  # pragma: no cover

@@ -804,12 +804,14 @@ class Executor:
                 frag = view.fragment(s)
                 if frag is None:
                     continue
-                if column is not None and column // SHARD_WIDTH != s:
-                    continue
-                for rid in frag.row_ids():
-                    if column is not None and not frag.contains(rid, column % SHARD_WIDTH):
+                if column is not None:
+                    if column // SHARD_WIDTH != s:
                         continue
-                    out.add(rid)
+                    # column probe (fragment.go:2446 filterColumn): only
+                    # the candidate container per row is membership-tested
+                    out.update(frag.rows_for_column(column))
+                else:
+                    out.update(frag.row_ids())
         rows = sorted(out)
         if previous is not None:
             rows = [r for r in rows if r > previous]

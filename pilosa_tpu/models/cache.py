@@ -46,33 +46,38 @@ class RankCache:
         self._top_memo = None
         self._version = 0
 
-    def add(self, row_id: int, count: int) -> None:
+    def _dirty(self) -> None:
+        # ORDER MATTERS: bump the version AFTER the counts mutation (every
+        # writer calls this last). A reader that raced the mutation tagged
+        # its snapshot with the PRE-write version, so the post-mutation
+        # bump marks it stale and the next read recomputes.
         self._version += 1
         self._top_memo = None
+
+    def add(self, row_id: int, count: int) -> None:
         if count <= 0:
             self.counts.pop(row_id, None)
-            return
-        self.counts[row_id] = count
+        else:
+            self.counts[row_id] = count
+        self._dirty()
         if len(self.counts) > self.cache_size * THRESHOLD_FACTOR:
             self.invalidate()
 
     def bulk_add(self, pairs: Iterable[tuple[int, int]]) -> None:
-        self._version += 1
-        self._top_memo = None
         for row_id, count in pairs:
             if count > 0:
                 self.counts[row_id] = count
+        self._dirty()
         if len(self.counts) > self.cache_size * THRESHOLD_FACTOR:
             self.invalidate()
 
     def invalidate(self) -> None:
         """Prune to the top cache_size rows by count."""
-        self._version += 1
-        self._top_memo = None
-        if len(self.counts) <= self.cache_size:
-            return
-        top = heapq.nlargest(self.cache_size, self.counts.items(), key=lambda kv: kv[1])
-        self.counts = dict(top)
+        if len(self.counts) > self.cache_size:
+            top = heapq.nlargest(self.cache_size, self.counts.items(),
+                                 key=lambda kv: kv[1])
+            self.counts = dict(top)
+        self._dirty()
 
     def top_arrays(self):
         """(ids, counts) int64 arrays in Pairs order (count desc, id asc),
@@ -141,26 +146,25 @@ class LRUCache(RankCache):
     cache_type = CACHE_TYPE_LRU
 
     def add(self, row_id: int, count: int) -> None:
-        self._version += 1
-        self._top_memo = None
         if count <= 0:
             self.counts.pop(row_id, None)
+            self._dirty()
             return
         # dict preserves insertion order: delete+insert marks recency
         self.counts.pop(row_id, None)
         self.counts[row_id] = count
         while len(self.counts) > self.cache_size:
             self.counts.pop(next(iter(self.counts)))
+        self._dirty()
 
     def bulk_add(self, pairs: Iterable[tuple[int, int]]) -> None:
         for row_id, count in pairs:
             self.add(row_id, count)
 
     def invalidate(self) -> None:
-        self._version += 1
-        self._top_memo = None
         while len(self.counts) > self.cache_size:
             self.counts.pop(next(iter(self.counts)))
+        self._dirty()
 
 
 class NopCache(RankCache):

@@ -8,6 +8,7 @@ fragments by shard and creates them on demand (view.go:208-263).
 from __future__ import annotations
 
 import os
+import threading
 from typing import Optional
 
 from pilosa_tpu.constants import SHARD_WIDTH
@@ -37,6 +38,10 @@ class View:
         self.field = field
         self.name = name
         self.fragments: dict[int, Fragment] = {}
+        # serializes fragment creation: two HTTP threads racing
+        # create_fragment_if_not_exists would both construct + open() the
+        # same file, and the loser trips its sibling's flock
+        self._frag_mu = threading.Lock()
         self.track_rank = track_rank and cache_type != CACHE_TYPE_NONE
         self.cache_size = cache_size
         self.cache_type = cache_type
@@ -101,7 +106,10 @@ class View:
     def create_fragment_if_not_exists(self, shard: int) -> Fragment:
         frag = self.fragments.get(shard)
         if frag is None:
-            frag = self._open_fragment(shard)
+            with self._frag_mu:  # double-checked: creation is rare
+                frag = self.fragments.get(shard)
+                if frag is None:
+                    frag = self._open_fragment(shard)
         return frag
 
     def shards(self) -> list[int]:
@@ -145,8 +153,12 @@ class View:
         if cache is not None:
             # row_count walks at most 16 container keys — cheap enough to
             # keep cached counts exact (the reference recounts via rowCache,
-            # fragment.go:435-440)
-            cache.add(row_id, frag.row_count(row_id))
+            # fragment.go:435-440). The count-read + cache-store pair runs
+            # under the fragment write lock: two racing writers could
+            # otherwise store their reads out of order and pin a stale
+            # count until the row's next write.
+            with frag.mu:
+                cache.add(row_id, frag.row_count(row_id))
 
     def refresh_rank_cache(self, shard: int) -> None:
         if not self.track_rank:

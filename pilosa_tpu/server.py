@@ -1010,6 +1010,7 @@ class Server:
             return 0
         local_blocks = dict(frag.blocks())
         merged = 0
+        adopted = False  # any peer pairs merged in -> snapshot for the WAL
         for node in self.cluster.shard_nodes(iname, shard):
             if node.id == self.node_id or not node.uri \
                     or self.cluster.is_down(node.id):
@@ -1047,9 +1048,10 @@ class Server:
                             continue
                         data = {}  # block raced away: all pairs push
                 import numpy as np
-                sets_r, sets_c = frag.merge_block(
+                sets_r, sets_c, n_adopted = frag.merge_block(
                     blk, np.array(data.get("rowIDs", []), dtype=np.int64),
                     np.array(data.get("columnIDs", []), dtype=np.int64))
+                adopted |= n_adopted > 0
                 merged += 1
                 # push local-only pairs back to the peer
                 if sets_r.size:
@@ -1063,4 +1065,9 @@ class Server:
                                                    {vname: payload}, remote=True)
                     except ClientError:
                         pass
+        if adopted:
+            # merge_block bulk-adds bypass the op-log; one snapshot per sync
+            # pass makes the adopted pairs durable (same contract as the
+            # bulk import paths)
+            frag.snapshot()
         return merged

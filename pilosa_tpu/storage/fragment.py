@@ -22,12 +22,14 @@ Row r of the shard occupies absolute bit positions [r*2^20, (r+1)*2^20)
 from __future__ import annotations
 
 import fcntl
+import functools
 import hashlib
 import io
 import mmap
 import os
 import struct
 import tarfile
+import threading
 from typing import Iterable, Optional
 
 import numpy as np
@@ -42,6 +44,20 @@ from pilosa_tpu.storage.roaring import Bitmap
 SNAPSHOT_EXT = ".snapshotting"
 CACHE_EXT = ".cache"
 LOCK_EXT = ".lock"
+
+
+def _locked(method):
+    """Serialize a mutating Fragment method under the per-fragment write
+    lock (the reference's fragment.mu, fragment.go:76): the HTTP server is
+    threaded, and an unsynchronized container read-modify-write loses
+    concurrent single-bit updates. Readers stay lock-free — container
+    swaps are atomic object-reference stores under the GIL, so a racing
+    read sees the old or new container, never a torn one."""
+    @functools.wraps(method)
+    def wrapper(self, *args, **kwargs):
+        with self.mu:
+            return method(self, *args, **kwargs)
+    return wrapper
 
 
 def pos(row_id: int, column: int) -> int:
@@ -66,6 +82,9 @@ class Fragment:
         if wal_fsync is None:
             wal_fsync = os.environ.get("PILOSA_TPU_WAL_FSYNC", "") == "always"
         self.wal_fsync = wal_fsync
+        # per-fragment write lock (fragment.mu, fragment.go:76); RLock:
+        # bulk paths snapshot() while holding it
+        self.mu = threading.RLock()
         self.storage = Bitmap()
         self.op_n = 0
         self._op_file = None
@@ -180,6 +199,7 @@ class Fragment:
     def row_generation(self, row_id: int) -> int:
         return max(self._row_gen.get(row_id, 0), self._bulk_gen)
 
+    @_locked
     def set_bit(self, row_id: int, column: int) -> bool:
         """Set one bit; appends to the WAL and snapshots at MAX_OP_N
         (fragment.go:382-433 setBit + incrementOpN)."""
@@ -189,6 +209,7 @@ class Fragment:
         self._increment_op_n()
         return changed
 
+    @_locked
     def clear_bit(self, row_id: int, column: int) -> bool:
         changed = self.storage.remove(pos(row_id, column))
         if changed:
@@ -204,6 +225,7 @@ class Fragment:
         if self.op_n > MAX_OP_N:
             self.snapshot()
 
+    @_locked
     def set_row(self, row_id: int, columns: np.ndarray) -> None:
         """Whole-row replace (setRow, fragment.go:501-586). Bulk path: no WAL,
         snapshot responsibility is the caller's (bulk import batches rows)."""
@@ -213,6 +235,7 @@ class Fragment:
         self.storage.add_many(cols)
         self._touch(row_id)
 
+    @_locked
     def clear_row(self, row_id: int) -> int:
         base = row_id * SHARD_WIDTH
         vals = self.storage.slice(base, base + SHARD_WIDTH)
@@ -223,6 +246,7 @@ class Fragment:
 
     # -- BSI value mutation (fragment.go:597-660) ---------------------------
 
+    @_locked
     def set_value(self, column: int, bit_depth: int, value: int) -> bool:
         """Write a BSI value: rows 0..bit_depth-1 are place values, row
         bit_depth is the not-null row (fragment.go:597-618,630)."""
@@ -235,6 +259,7 @@ class Fragment:
         changed |= self.set_bit(bit_depth, column)
         return changed
 
+    @_locked
     def clear_value(self, column: int, bit_depth: int) -> bool:
         changed = False
         for i in range(bit_depth + 1):
@@ -309,6 +334,7 @@ class Fragment:
 
     # -- bulk import (fragment.go:1445-1706) --------------------------------
 
+    @_locked
     def bulk_import(self, row_ids: Iterable[int], columns: Iterable[int]) -> None:
         """Standard bulk set path: group by row, merge into each row, one
         snapshot at the end (bulkImportStandard, fragment.go:1458-1533)."""
@@ -322,6 +348,7 @@ class Fragment:
             self._touch(int(rid))
         self.snapshot()
 
+    @_locked
     def bulk_import_mutex(self, row_ids: Iterable[int], columns: Iterable[int]) -> None:
         """Mutex bulk set path: last write wins per column, and every other
         row's bit for a written column is cleared — preserving the
@@ -348,6 +375,7 @@ class Fragment:
             self._touch(rid)
         self.snapshot()
 
+    @_locked
     def bulk_import_values(self, columns: Iterable[int], values: Iterable[int],
                            bit_depth: int) -> None:
         """BSI bulk import (importValue, fragment.go:1624-1658)."""
@@ -370,6 +398,7 @@ class Fragment:
             self._touch(i)
         self.snapshot()
 
+    @_locked
     def import_roaring(self, data: bytes, clear: bool = False) -> None:
         """Union (or clear) a pre-built roaring bitmap into storage in one op
         (importRoaring, fragment.go:1659-1706)."""
@@ -389,6 +418,7 @@ class Fragment:
 
     # -- snapshot / WAL compaction (fragment.go:1707-1781) ------------------
 
+    @_locked
     def snapshot(self) -> None:
         tmp = self.path + SNAPSHOT_EXT
         os.makedirs(os.path.dirname(self.path), exist_ok=True)
@@ -422,11 +452,16 @@ class Fragment:
         """Swap storage onto the freshly-written file (the reference remaps
         after snapshot, fragment.go:1737-1781): lazy entries re-point at the
         new mmap; already-materialized containers carry over as-is (their
-        content was just written). The old mapping closes immediately —
-        nothing references it afterwards."""
+        content was just written).
+
+        The old mapping is NOT closed here: lock-free readers may still
+        hold the old Bitmap and lazily materialize its containers from the
+        old mmap mid-query. Dropping our references lets refcounting
+        reclaim the mapping once the last such reader finishes — an
+        explicit close would yield 'mmap closed or invalid' crashes on
+        queries racing a snapshot."""
         from pilosa_tpu.storage.roaring import LazyContainer
 
-        old_mm = self._mmap
         old = self.storage
         self._map()  # fresh lazy parse of the new file
         for key, c in old.containers.items():
@@ -434,8 +469,6 @@ class Fragment:
                 self.storage.containers[key] = c
             elif c.materialized:
                 self.storage.containers[key] = c._real
-        if old_mm is not None:
-            old_mm.close()
 
     # -- anti-entropy block checksums (fragment.go:1226-1443) ---------------
 
@@ -473,19 +506,33 @@ class Fragment:
         cols = (vals % np.uint64(SHARD_WIDTH)).astype(np.int64)
         return rows, cols
 
+    @_locked
     def merge_block(self, blk: int, peer_rows: np.ndarray, peer_cols: np.ndarray):
         """3-way-ish merge: adopt the union of local and peer pairs; returns
-        (sets_for_peer, clears_for_peer) deltas the caller pushes back
-        (mergeBlock, fragment.go:1323-1443 — reference adopts union sets)."""
+        (sets_for_peer_rows, sets_for_peer_cols, n_adopted) — the deltas the
+        caller pushes back plus how many peer pairs were merged in locally
+        (mergeBlock, fragment.go:1323-1443 — reference streams sorted
+        pairsets). Vectorized as sorted position-array set difference: a
+        100-row block can hold up to 100 * 2^20 pairs, and building Python
+        tuple-sets of those froze anti-entropy at BASELINE scale."""
         local_rows, local_cols = self.block_data(blk)
-        local = set(zip(local_rows.tolist(), local_cols.tolist()))
-        peer = set(zip(np.asarray(peer_rows).tolist(), np.asarray(peer_cols).tolist()))
-        missing_local = peer - local
-        missing_peer = local - peer
-        for r, c in missing_local:
-            self.set_bit(int(r), int(c))
-        sets = np.array(sorted(missing_peer), dtype=np.int64).reshape(-1, 2)
-        return sets[:, 0], sets[:, 1]
+        sw = np.uint64(SHARD_WIDTH)
+        local_pos = local_rows.astype(np.uint64) * sw \
+            + local_cols.astype(np.uint64)
+        peer_pos = np.asarray(peer_rows, dtype=np.uint64) * sw \
+            + np.asarray(peer_cols, dtype=np.uint64)
+        missing_local = np.setdiff1d(peer_pos, local_pos)  # sorted, unique
+        missing_peer = np.setdiff1d(local_pos, peer_pos)
+        if missing_local.size:
+            # bulk adds bypass the op-log; callers that need the adopted
+            # pairs durable snapshot once per sync pass (server._sync_
+            # fragment), the same WAL contract as the bulk import paths
+            self.storage.add_many(missing_local)
+            for rid in np.unique(missing_local // sw):
+                self._touch(int(rid))
+        return ((missing_peer // sw).astype(np.int64),
+                (missing_peer % sw).astype(np.int64),
+                int(missing_local.size))
 
     # -- archive streaming for resize copies (fragment.go:1823-1998) --------
 
@@ -496,6 +543,7 @@ class Fragment:
             info.size = len(data)
             tar.addfile(info, io.BytesIO(data))
 
+    @_locked
     def read_from_tar(self, fileobj) -> None:
         with tarfile.open(fileobj=fileobj, mode="r") as tar:
             member = tar.getmember("data")

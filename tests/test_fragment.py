@@ -153,9 +153,10 @@ def test_blocks_and_merge(frag, tmp_path):
     peer.set_bit(0, 9)       # peer has extra bit in block 0
     frag.set_bit(50, 4)      # local extra in block 0
     pr, pc = peer.block_data(0)
-    sets_r, sets_c = frag.merge_block(0, pr, pc)
+    sets_r, sets_c, n_adopted = frag.merge_block(0, pr, pc)
     # local adopted the peer's bit
     assert frag.contains(0, 9)
+    assert n_adopted >= 1
     # delta for the peer: the local-only pairs
     assert list(zip(sets_r.tolist(), sets_c.tolist())) == [(50, 4)]
     # checksums equal after peer applies delta
