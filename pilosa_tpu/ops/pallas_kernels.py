@@ -104,6 +104,56 @@ def program_count(leaves: jax.Array, program) -> jax.Array:
     return padded[:, 0]
 
 
+def _pair_stream_kernel(ii_ref, jj_ref, a_ref, b_ref, out_ref):
+    """One (query, shard-block) grid step of the Count(Intersect) stream:
+    the scalar-prefetched ii/jj pick which rows' blocks the pipeline DMAs
+    (a_ref/b_ref are [1, blk, W] windows of the SAME resident slab), and
+    the per-query count accumulates across the inner shard-block dim."""
+    sb = pl.program_id(1)
+    inter = jnp.bitwise_and(a_ref[0], b_ref[0])  # [blk, W]
+    partial = jnp.sum(jax.lax.population_count(inter).astype(jnp.int32))
+
+    @pl.when(sb == 0)
+    def _init():
+        out_ref[...] = jnp.zeros_like(out_ref)
+
+    out_ref[...] += partial
+
+
+@jax.jit
+def pair_stream_counts(rows: jax.Array, ii: jax.Array,
+                       jj: jax.Array) -> jax.Array:
+    """[R, S, W] x int32[K] x int32[K] -> int32[K] per-query intersection
+    counts — the Pallas form of the serving hot loop (mesh.py
+    count_pair_stream's lax.scan + dynamic gather).
+
+    Explicit-blocking rationale: each query's two operand rows stream
+    HBM->VMEM in [blk, W] windows with the data-dependent row index fed
+    through scalar prefetch (PrefetchScalarGridSpec), so the pipeline
+    double-buffers the DMAs for grid step (q, sb+1) while (q, sb) computes
+    — the scan path instead serializes a full-row gather per query. The
+    fused and+popcount touches each word exactly once in VMEM."""
+    _, s, w = rows.shape
+    k = ii.shape[0]
+    blk = SHARD_BLOCK if s % SHARD_BLOCK == 0 else 1
+    spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(k, s // blk),
+        in_specs=[
+            pl.BlockSpec((1, blk, w), lambda q, sb, ii, jj: (ii[q], sb, 0)),
+            pl.BlockSpec((1, blk, w), lambda q, sb, ii, jj: (jj[q], sb, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 128), lambda q, sb, ii, jj: (q, 0)),
+    )
+    out = pl.pallas_call(
+        _pair_stream_kernel,
+        grid_spec=spec,
+        out_shape=jax.ShapeDtypeStruct((k, 128), jnp.int32),
+        interpret=_interpret(),
+    )(ii, jj, rows, rows)
+    return out[:, 0]
+
+
 def available() -> bool:
     """Pallas compiles on this backend (real TPU or interpret fallback)."""
     try:
