@@ -320,6 +320,76 @@ def bench_topn(ex) -> dict:
     }
 
 
+# per axis; 100x100 = 10k combinations on TPU. CPU smoke runs scale this
+# down — the dense cross product is ~5 GB of fused and+popcount per query,
+# which the CPU backend emulates at ~0.3 GB/s.
+GROUPBY_ROWS = int(os.environ.get("PILOSA_BENCH_GROUPBY_ROWS", "100"))
+GROUPBY_SHARDS = 4
+
+
+def build_groupby_index(holder):
+    """Index 'gb', fields 'g1'/'g2': GROUPBY_ROWS rows each with random
+    bits over GROUPBY_SHARDS shards — the 100x100 cross product the GroupBy
+    redesign is sized against. A separate index: GroupBy fans out over the
+    INDEX's shard union, and sharing index 'b' would drag the 128
+    executor-bench shards (32x the device work, GBs through the tunnel)
+    into every GroupBy query."""
+    idx = holder.create_index("gb", track_existence=False)
+    rng = np.random.default_rng(19)
+    n_cols = GROUPBY_SHARDS * SHARD_WIDTH
+    sets = {}
+    for fname in ("g1", "g2"):
+        fld = idx.create_field(fname)
+        rows, cols = [], []
+        for r in range(GROUPBY_ROWS):
+            c = rng.integers(0, n_cols, size=400, dtype=np.uint64)
+            sets[(fname, r)] = np.unique(c)
+            rows.append(np.full(c.size, r, dtype=np.uint64))
+            cols.append(c)
+        fld.import_bits(np.concatenate(rows), np.concatenate(cols))
+    return sets
+
+
+def bench_groupby(ex, sets) -> dict:
+    (groups,) = ex.execute("gb", "GroupBy(Rows(field=g1), Rows(field=g2))")
+    # spot-check a handful of combos against the generator's sets
+    got = {(d["group"][0]["rowID"], d["group"][1]["rowID"]): d["count"]
+           for d in groups}
+    for a in (0, GROUPBY_ROWS // 2, GROUPBY_ROWS - 1):
+        for b in (GROUPBY_ROWS // 3, GROUPBY_ROWS - 1):
+            expect = np.intersect1d(sets[("g1", a)], sets[("g2", b)],
+                                    assume_unique=True).size
+            assert got.get((a, b), 0) == expect, (a, b)
+    lat = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        ex.execute("gb", "GroupBy(Rows(field=g1), Rows(field=g2))")
+        lat.append(time.perf_counter() - t0)
+    p50 = sorted(lat)[len(lat) // 2]
+
+    # CPU baseline: the same cross product as vectorized numpy set
+    # intersections over the sorted column arrays
+    t0 = time.perf_counter()
+    n = 0
+    for a in range(GROUPBY_ROWS):
+        sa = sets[("g1", a)]
+        for b in range(GROUPBY_ROWS):
+            if np.intersect1d(sa, sets[("g2", b)],
+                              assume_unique=True).size:
+                n += 1
+    cpu_s = time.perf_counter() - t0
+    assert n == len(got)
+
+    return {
+        "metric": f"groupby_{GROUPBY_ROWS}x{GROUPBY_ROWS}_p50_ms",
+        "value": round(p50 * 1e3, 3),
+        "unit": "ms",
+        "vs_baseline": round(cpu_s / p50, 2),
+        "combinations": GROUPBY_ROWS * GROUPBY_ROWS,
+        "path": "Executor GroupBy device-batched cross product",
+    }
+
+
 def build_bsi_index(holder):
     """Index 'b' / field 'v': BSI int values on every column of
     BSI_SHARDS shards."""
@@ -423,7 +493,15 @@ def worker() -> None:
 
     def stage(name, fn, *a):
         t0 = time.perf_counter()
-        m = fn(*a)
+        try:
+            m = fn(*a)
+        except Exception as e:  # noqa: BLE001 — one stage must not eat
+            # the whole artifact; record the failure and keep measuring
+            metrics.append({"metric": f"{name}_error", "value": 0.0,
+                            "unit": "error", "vs_baseline": 0.0,
+                            "error": f"{type(e).__name__}: {e}"[:300]})
+            print(f"[bench] {name} FAILED: {e}", file=sys.stderr)
+            return
         m["stage_s"] = round(time.perf_counter() - t0, 1)
         metrics.append(m)
         print(f"[bench] {name}: {m['value']} {m['unit']} "
@@ -440,6 +518,8 @@ def worker() -> None:
         stage("executor", bench_executor, ex, row_bits)
         build_topn_index(holder)
         stage("topn", bench_topn, ex)
+        gsets = build_groupby_index(holder)
+        stage("groupby", bench_groupby, ex, gsets)
         vals = build_bsi_index(holder)
         stage("bsi", bench_bsi, ex, vals)
         holder.close()
@@ -447,7 +527,14 @@ def worker() -> None:
     finally:
         shutil.rmtree(tmp, ignore_errors=True)
 
-    head = next(m for m in metrics if m["metric"] == METRIC)
+    head = next((m for m in metrics if m["metric"] == METRIC), None)
+    if head is None:
+        # the headline stage itself failed: emit METRIC explicitly as a
+        # failure (value 0.0) so regression tracking sees a failed run,
+        # not a silently-substituted different measurement; the other
+        # stages' numbers still ride in detail.metrics
+        head = {"metric": METRIC, "value": 0.0, "unit": "queries/s/chip",
+                "vs_baseline": 0.0}
     result = dict(head)
     result["detail"] = {
         "device": str(devices[0]),
