@@ -514,14 +514,30 @@ def worker() -> None:
     try:
         holder = Holder(tmp).open()
         ex = Executor(holder)
-        row_bits = build_exec_index(holder)
-        stage("executor", bench_executor, ex, row_bits)
-        build_topn_index(holder)
-        stage("topn", bench_topn, ex)
-        gsets = build_groupby_index(holder)
-        stage("groupby", bench_groupby, ex, gsets)
-        vals = build_bsi_index(holder)
-        stage("bsi", bench_bsi, ex, vals)
+
+        def staged(name, build, bench):
+            """Index build + measurement under one fault barrier: a build
+            failure must cost only its own stage, like a bench failure."""
+            try:
+                args = build()
+            except Exception as e:  # noqa: BLE001
+                metrics.append({"metric": f"{name}_error", "value": 0.0,
+                                "unit": "error", "vs_baseline": 0.0,
+                                "error": f"build: {type(e).__name__}: {e}"[:300]})
+                print(f"[bench] {name} build FAILED: {e}", file=sys.stderr)
+                return
+            stage(name, bench, *args)
+
+        def topn_build():
+            build_topn_index(holder)
+            return (ex,)
+
+        staged("executor", lambda: (ex, build_exec_index(holder)),
+               bench_executor)
+        staged("topn", topn_build, bench_topn)
+        staged("groupby", lambda: (ex, build_groupby_index(holder)),
+               bench_groupby)
+        staged("bsi", lambda: (ex, build_bsi_index(holder)), bench_bsi)
         holder.close()
         stage("http", bench_http, tmp)
     finally:

@@ -134,9 +134,9 @@ class API:
             raise NotFoundError(f"index not found: {index_name}")
         query = pql
         if isinstance(pql, str):
-            from pilosa_tpu.pql import parse_string
+            from pilosa_tpu.pql import parse_string_cached
             try:
-                query = parse_string(pql)
+                query = parse_string_cached(pql)
             except ValueError as e:
                 raise ApiError(str(e))
         if self.max_writes_per_request > 0:

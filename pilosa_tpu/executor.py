@@ -39,7 +39,7 @@ from pilosa_tpu.models.view import VIEW_STANDARD
 from pilosa_tpu.ops import bsi as bsi_ops
 from pilosa_tpu.ops.bitvector import columns_from_dense
 from pilosa_tpu.parallel.mesh import DeviceRunner
-from pilosa_tpu.pql import Call, Condition, Query, parse_string
+from pilosa_tpu.pql import Call, Condition, Query, parse_string_cached
 from pilosa_tpu.pql.ast import BETWEEN, EQ, GT, GTE, LT, LTE, NEQ
 from pilosa_tpu.utils import qctx
 
@@ -141,7 +141,7 @@ class Executor:
         nodes (ctx cancellation, executor.go:2591-2608); an inherited
         deadline (HTTP layer) applies when omitted."""
         if isinstance(query, str):
-            query = parse_string(query)
+            query = parse_string_cached(query)
         if not isinstance(query, Query):
             raise TypeError("query must be a PQL string or Query")
         index = self.holder.index(index_name)

@@ -8,5 +8,16 @@ timestamps — implemented as a hand-written recursive-descent parser instead
 of a generated PEG parser.
 """
 
+import functools
+
 from pilosa_tpu.pql.ast import Call, Condition, Query  # noqa: F401
 from pilosa_tpu.pql.parser import PQLError, parse_string  # noqa: F401
+
+
+@functools.lru_cache(maxsize=1024)
+def parse_string_cached(pql: str):
+    """Plan-cache form of parse_string: repeated query strings skip the
+    parse (the executor treats the AST as read-only, so sharing one Query
+    across threads is safe). Serving workloads repeat query shapes; the
+    LRU bounds memory against high-cardinality embedded ids."""
+    return parse_string(pql)
