@@ -84,6 +84,9 @@ class MeshConfig:
     replicas: when >1, fold the device list into a ("replica", "shard")
     mesh — data replicated per slice, query stream data-parallel over
     replicas (SURVEY §2.9 strategy 3; the on-mesh ReplicaN analog).
+    0 = auto multi-slice: one replica per TPU slice, so the data-plane
+    psum stays on ICI and only per-query scalars cross slices on DCN
+    (make_multislice_mesh).
     """
     devices: str = "auto"
     platform: str = ""

@@ -52,6 +52,54 @@ def make_mesh(devices: Optional[Sequence] = None, axis: str = SHARD_AXIS,
     return Mesh(np.array(devices), (axis,))
 
 
+def group_by_slice(devices) -> list[list]:
+    """Devices bucketed by TPU slice (ICI domain), slice ids ascending.
+    Single-slice and CPU devices (no slice_index) land in one bucket."""
+    buckets: dict = {}
+    for d in devices:
+        buckets.setdefault(getattr(d, "slice_index", 0), []).append(d)
+    return [buckets[k] for k in sorted(buckets)]
+
+
+def make_multislice_mesh(devices: Optional[Sequence] = None) -> Mesh:
+    """Multi-slice (DCN) mesh: one replica per TPU slice, shards within.
+
+    The scaling-book hybrid-mesh recipe applied to this workload: the
+    replica axis crosses slice boundaries and therefore rides DCN — which
+    is fine, because with `pair_stream_counts` ONLY the query-stream
+    scatter and the per-query count gather cross replicas (bytes per
+    query, not data); every data-plane collective (the psum over "shard")
+    stays inside a slice on ICI. Data is fully replicated per slice, so
+    slices serve independent query throughput — the multi-slice form of
+    the reference's ReplicaN node groups (SURVEY §2.9 strategy 3).
+
+    Uses mesh_utils.create_hybrid_device_mesh when the backend exposes
+    slice topology; falls back to slice-bucketed reshape (and to a plain
+    1-D shard mesh on single-slice/CPU backends)."""
+    devices = list(devices) if devices is not None else jax.devices()
+    slices = group_by_slice(devices)
+    if len(slices) <= 1:
+        return make_mesh(devices)
+    per = min(len(s) for s in slices)
+    dropped = len(devices) - len(slices) * per
+    if dropped:
+        import warnings
+
+        warnings.warn(
+            f"multislice mesh: uneven slices truncated to {per} devices "
+            f"each; {dropped} of {len(devices)} devices left idle")
+    try:
+        from jax.experimental import mesh_utils
+
+        arr = mesh_utils.create_hybrid_device_mesh(
+            mesh_shape=(1, per), dcn_mesh_shape=(len(slices), 1),
+            devices=[d for s in slices for d in s[:per]])
+    except Exception:  # topology helpers unavailable: bucketed reshape
+        arr = np.array([s[:per] for s in slices])
+    return Mesh(np.asarray(arr).reshape(len(slices), per),
+                (REPLICA_AXIS, SHARD_AXIS))
+
+
 def force_platform(platform: str, host_devices: int = 0,
                    reset: bool = False) -> None:
     """Force the jax platform BEFORE backend init — the one shared recipe
@@ -109,6 +157,8 @@ def mesh_from_config(devices: str = "auto", platform: str = "",
         avail = avail[:n]
     if len(avail) < 2:
         return None
+    if replicas == 0:  # auto: one replica per TPU slice (DCN multi-slice)
+        return make_multislice_mesh(avail)
     return make_mesh(avail, replicas=max(replicas, 1))
 
 
