@@ -44,6 +44,10 @@ from pilosa_tpu.constants import (
     MAGIC_NUMBER,
     STORAGE_VERSION,
 )
+from pilosa_tpu.storage.containers import (
+    make_container_store,
+    resolve_store_kind,
+)
 
 BITMAP_WORDS = CONTAINER_BITS // 64  # 1024 x uint64
 HEADER_BASE_SIZE = 8
@@ -413,8 +417,16 @@ class Bitmap:
     roaring/roaring.go:119-122).
     """
 
-    def __init__(self, values=None):
-        self.containers: dict[int, Container] = {}
+    def __init__(self, values=None, store: Optional[str] = None):
+        # pluggable container collection (the `Containers` abstraction,
+        # roaring/roaring.go:67): "dict" (default, sliceContainers analog)
+        # or "btree" (the enterprise/b B+Tree analog) — see
+        # storage/containers.py. `store=None` defers to the
+        # PILOSA_TPU_CONTAINER_STORE env (the build-tag selection analog).
+        # The resolved kind is recorded so derived bitmaps (intersect/union/
+        # slice results) inherit it.
+        self.store_kind = resolve_store_kind(store)
+        self.containers = make_container_store(self.store_kind)
         self.op_writer: Optional[io.RawIOBase] = None
         self.op_sync = False  # fsync after each op (fragment plumbs config)
         self.op_n = 0
@@ -468,7 +480,9 @@ class Bitmap:
         writeOp, roaring/roaring.go:154,977). Returns True if changed."""
         changed = not self.contains(value)
         if changed:
-            key, low = value >> 16, value & 0xFFFF
+            # canonical int keys: numpy scalars hash like ints in the dict
+            # store but would interleave as a distinct type in ordered stores
+            key, low = int(value) >> 16, int(value) & 0xFFFF
             self._store(key, self._with_key(key).add_many(np.array([low], dtype=np.uint16)))
         self._write_op(OP_ADD, value)
         return changed
@@ -476,7 +490,7 @@ class Bitmap:
     def remove(self, value: int) -> bool:
         changed = self.contains(value)
         if changed:
-            key, low = value >> 16, value & 0xFFFF
+            key, low = int(value) >> 16, int(value) & 0xFFFF
             self._store(key, self.containers[key].remove_many(np.array([low], dtype=np.uint16)))
         self._write_op(OP_REMOVE, value)
         return changed
@@ -562,18 +576,25 @@ class Bitmap:
         if stop <= start:
             return []
         lo, hi = start >> 16, (stop - 1) >> 16
+        if hasattr(self.containers, "irange"):
+            # ordered store: O(log n + k) range walk instead of full scan
+            return list(self.containers.irange(lo, hi))
         return sorted(k for k in self.containers if lo <= k <= hi)
 
     def min(self) -> Optional[int]:
         if not self.containers:
             return None
-        key = min(self.containers)
+        key = (self.containers.first_key()
+               if hasattr(self.containers, "first_key")
+               else min(self.containers))
         return (key << 16) | int(self.containers[key].values()[0])
 
     def max(self) -> Optional[int]:
         if not self.containers:
             return None
-        key = max(self.containers)
+        key = (self.containers.last_key()
+               if hasattr(self.containers, "last_key")
+               else max(self.containers))
         return (key << 16) | int(self.containers[key].values()[-1])
 
     def any(self) -> bool:
@@ -628,7 +649,7 @@ class Bitmap:
     # -- set algebra --------------------------------------------------------
 
     def _binary(self, other: "Bitmap", kind: str) -> "Bitmap":
-        out = Bitmap()
+        out = Bitmap(store=self.store_kind)
         if kind in ("and",):
             keys = set(self.containers) & set(other.containers)
         elif kind == "andnot":
