@@ -50,8 +50,11 @@ from pilosa_tpu.constants import SHARD_WIDTH, WORDS_PER_SHARD
 # kernel-stream slab (config 2): 1024 shards x 2^20 = 1.07B columns/row
 N_SHARDS = int(os.environ.get("PILOSA_BENCH_SHARDS", "1024"))
 N_ROWS = 16          # resident rows: 16 x 134MB = 2.1GB HBM
-K_BATCH = 32         # distinct queries per dispatch
-N_DISPATCH = 6       # chained dispatches measured
+# queries per dispatch: dispatch/tunnel overhead (~1.5-8 ms each through
+# axon) amortizes across the batch — K=32 reads ~360 GB/s effective,
+# K=512 ~660 GB/s on the same kernel (measured r3)
+K_BATCH = int(os.environ.get("PILOSA_BENCH_K", "512"))
+N_DISPATCH = 4       # chained dispatches measured
 
 # engine-path scales (kept moderate: fragment data is built on HOST and the
 # leaves ride the tunnel into HBM once at warmup)
@@ -64,6 +67,11 @@ TOPN_N = 1000
 BSI_SHARDS = 16
 HTTP_QUERIES = 200
 ENGINE_QUERIES = 100
+# serving throughput is measured under concurrent clients (the reference's
+# QPS numbers are concurrent server loads; a single-stream loop over a
+# high-latency device link measures the link RTT, not the engine)
+EXEC_THREADS = int(os.environ.get("PILOSA_BENCH_THREADS", "32"))
+HTTP_THREADS = 16
 
 METRIC = ("executor_intersect_count_qps" if EXEC_SHARDS == 128
           else f"executor_intersect_count_qps_{EXEC_SHARDS}shards")
@@ -109,8 +117,9 @@ def bench_kernel() -> dict:
 
     from pilosa_tpu.parallel.mesh import count_pair_stream, eval_count_total
 
-    pairs = [((p * 5 + 1) % N_ROWS, (p * 11 + 3) % N_ROWS)
-             for p in range(K_BATCH)]
+    prng = np.random.default_rng(23)
+    pairs = [tuple(prng.choice(N_ROWS, size=2, replace=False))
+             for _ in range(K_BATCH)]
     ii = jnp.array([p[0] for p in pairs], dtype=jnp.int32)
     jj = jnp.array([p[1] for p in pairs], dtype=jnp.int32)
 
@@ -177,7 +186,10 @@ def bench_kernel() -> dict:
 
             ref = np.asarray(pallas_stream(rows[:, :4, :], ii[:1], jj[:1]))
             assert int(ref[0]) == expect, (int(ref[0]), expect)
-            int(pallas_stream(rows, ii, jj).sum())  # compile + warm
+            # warm TWICE: the first execution of a fresh pallas binary runs
+            # ~4x slow (observed r3); steady state starts at the second
+            int(pallas_stream(rows, ii, jj).sum())
+            int(pallas_stream(rows, ii, jj).sum())
             t0 = time.perf_counter()
             acc = jnp.int32(0)
             for _ in range(N_DISPATCH):
@@ -219,6 +231,8 @@ def build_exec_index(holder):
 
 
 def bench_executor(ex, row_bits) -> dict:
+    import threading
+
     qs = [f"Count(Intersect(Row(f={i % EXEC_ROWS}), Row(f={(i * 3 + 1) % EXEC_ROWS})))"
           for i in range(ENGINE_QUERIES)]
     # warmup: residency fill (host->HBM through the tunnel, one-time) +
@@ -231,10 +245,37 @@ def bench_executor(ex, row_bits) -> dict:
     for q in qs[:4]:
         ex.execute("b", q)
 
+    # single-stream latency (each query = dispatch + scalar fetch, so over
+    # a tunnel this is dominated by link RTT; reported as p50 in detail)
     t0 = time.perf_counter()
-    for q in qs:
+    for q in qs[:20]:
         ex.execute("b", q)
-    tpu_s = (time.perf_counter() - t0) / len(qs)
+    single_s = (time.perf_counter() - t0) / 20
+
+    # concurrent throughput: EXEC_THREADS client threads, the serving QPS
+    # analog of the reference's concurrent query benchmarks (dispatches
+    # and fetches from different queries overlap on the link)
+    per_thread = max(8, ENGINE_QUERIES // 4)
+    errors = []
+
+    def client(tid):
+        try:
+            for i in range(per_thread):
+                ex.execute("b", qs[(tid * 7 + i) % len(qs)])
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [threading.Thread(target=client, args=(t,))
+               for t in range(EXEC_THREADS)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    if errors:
+        raise errors[0]
+    tpu_s = wall / (EXEC_THREADS * per_thread)
 
     # CPU baseline: the same dense AND+popcount work in numpy (per query:
     # two [S, W] operands), scaled from a slice
@@ -254,9 +295,13 @@ def bench_executor(ex, row_bits) -> dict:
         "unit": "queries/s/chip",
         "vs_baseline": round(cpu_s / tpu_s, 2),
         "tpu_ms_per_query": round(tpu_s * 1e3, 4),
+        "single_stream_ms_per_query": round(single_s * 1e3, 4),
+        "concurrency": EXEC_THREADS,
         "cpu_numpy_ms_per_query": round(cpu_s * 1e3, 4),
         "columns_per_operand": EXEC_SHARDS * SHARD_WIDTH,
-        "path": "Executor.execute (parse+compile+residency+device+merge)",
+        "path": "Executor.execute (parse+compile+residency+device+merge), "
+                f"{EXEC_THREADS} concurrent clients; baseline is "
+                "single-core numpy on the same dense work",
     }
 
 
@@ -462,16 +507,44 @@ def bench_http(tmpdir) -> dict:
         out = post("/index/h/query", q)  # warm residency + compile
         assert isinstance(out["results"][0], int)
         t0 = time.perf_counter()
-        for _ in range(HTTP_QUERIES):
+        for _ in range(10):
             post("/index/h/query", q)
-        per_q = (time.perf_counter() - t0) / HTTP_QUERIES
+        single_s = (time.perf_counter() - t0) / 10
+
+        # concurrent clients (the threaded server's actual serving mode)
+        import threading
+
+        per_thread = HTTP_QUERIES // HTTP_THREADS
+        errors = []
+
+        def client():
+            try:
+                for _ in range(per_thread):
+                    post("/index/h/query", q)
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        threads = [threading.Thread(target=client)
+                   for _ in range(HTTP_THREADS)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        if errors:
+            raise errors[0]
+        per_q = wall / (HTTP_THREADS * per_thread)
         return {
             "metric": "http_count_qps",
             "value": round(1.0 / per_q, 2),
             "unit": "queries/s",
             "vs_baseline": 0.0,  # no HTTP-path numpy equivalent
             "tpu_ms_per_query": round(per_q * 1e3, 4),
-            "path": "HTTP loopback: wire + parse + execute",
+            "single_stream_ms_per_query": round(single_s * 1e3, 4),
+            "concurrency": HTTP_THREADS,
+            "path": "HTTP loopback: wire + parse + execute, "
+                    f"{HTTP_THREADS} concurrent clients",
         }
     finally:
         srv.close()

@@ -295,7 +295,8 @@ class Executor:
             val = cond.value if not isinstance(cond.value, list) else tuple(cond.value)
             key = ("bsicmp", index.name, cond_field, cond.op, val, depth,
                    shards_t, gens)
-            return leaf(key, lambda: self._bsi_compare(index, cond_field, cond, shards))
+            return leaf(key, lambda: self._bsi_compare_dev(
+                index, cond_field, cond, shards))
 
         def existence_leaf():
             from pilosa_tpu.constants import EXISTENCE_FIELD_NAME
@@ -429,30 +430,51 @@ class Executor:
         """(planes[depth, S', W], exists[S', W]) device arrays for an int
         field, assembled by stacking HBM-resident plane leaves on device
         (S' = S padded to the mesh size; pad shards are all-zero so every
-        BSI kernel sees them as empty). Repeat aggregations touch no host
-        memory."""
-        import jax.numpy as jnp
+        BSI kernel sees them as empty). The stacked slab is itself cached
+        in the residency manager keyed by the plane generations, so repeat
+        aggregations reuse one HBM slab — no host memory, no restack."""
         depth = f.bit_depth
         vname = f.bsi_view_name
         exists = self._row_leaf_dev(index, f.name, vname, shards, depth)
-        planes = jnp.stack([
-            self._row_leaf_dev(index, f.name, vname, shards, i)
-            for i in range(depth)])
+        gens = tuple(self._leaf_gens(index, f.name, vname, shards, i)
+                     for i in range(depth))
+        key = ("bsiplanes", index.name, f.name, depth, tuple(shards), gens)
+        # the stack is built from HOST rows so the per-plane leaves don't
+        # also occupy residency budget — only the slab (what the kernels
+        # read) is cached; on a mesh the runner shards the [depth, S', W]
+        # slab over the shard axis like any leaf batch
+        planes = self.residency.leaf(key, lambda: self.runner.put_plane_slab(
+            np.stack([
+                np.stack([self._cached_row(index, f.name, vname, s, i)
+                          for s in shards])
+                for i in range(depth)])))
         return planes, exists
 
     def _bsi_compare(self, index: Index, field_name: str, cond: Condition,
                      shards) -> np.ndarray:
+        """Host [S, W] comparison mask — only for results that leave the
+        device (top-level Range -> Row columns). Query composition uses
+        _bsi_compare_dev, which never round-trips the mask through the
+        host (megabytes per query on a high-latency device link)."""
+        s = len(shards)
+        return np.asarray(self._bsi_compare_dev(
+            index, field_name, cond, shards))[:s]
+
+    def _bsi_compare_dev(self, index: Index, field_name: str,
+                         cond: Condition, shards):
+        """Device [S', W] mask of columns satisfying `cond` — computed and
+        LEFT in HBM (one fused comparison-sweep dispatch, zero fetches)."""
         f = self._bsi_field(index, field_name)
         planes, exists = self._bsi_planes(index, f, shards)
         depth = f.bit_depth
         op = cond.op
-        s = len(shards)
 
-        def fetch(dev) -> np.ndarray:  # device [S', W] -> host [S, W]
-            return np.asarray(dev)[:s]
+        def fetch(dev):  # composition stays on device
+            return dev
 
-        def empty() -> np.ndarray:
-            return np.zeros((s, WORDS), dtype=np.uint32)
+        def empty():
+            return self.runner.put_leaf(
+                np.zeros((len(shards), WORDS), dtype=np.uint32))
 
         # != null -> not-null row (executor.go:1344)
         if op == NEQ and cond.value is None:
@@ -515,9 +537,10 @@ class Executor:
         filt = self._bsi_filter(index, call, shards)
         if filt is not None:
             exists = jnp.bitwise_and(exists, filt)
-        counts = np.asarray(bsi_ops.plane_counts(planes, exists))  # [depth, S']
-        from pilosa_tpu.ops.bitvector import popcount
-        n = int(np.asarray(popcount(exists)).sum())
+        # one dispatch + one fetch: per-plane counts with the exists count
+        # packed as the last row (bsi_ops.sum_counts)
+        packed = np.asarray(bsi_ops.sum_counts(planes, exists))  # [depth+1, S']
+        counts, n = packed[:-1], int(packed[-1].sum())
         raw_sum = bsi_ops.counts_to_sum(counts.sum(axis=1))
         # add base back per counted value (val = raw + base*count)
         return ValCount(val=raw_sum + f.base * n, count=n)
@@ -539,9 +562,9 @@ class Executor:
         filt = self._bsi_filter(index, call, shards)
         if filt is not None:
             exists = jnp.bitwise_and(exists, filt)
-        fn = bsi_ops.bsi_min if is_min else bsi_ops.bsi_max
-        bits, cnt = fn(planes, exists)  # [depth, S'], [S']
-        bits, cnt = np.asarray(bits), np.asarray(cnt)
+        fn = bsi_ops.bsi_min_packed if is_min else bsi_ops.bsi_max_packed
+        packed = np.asarray(fn(planes, exists))  # [depth+1, S'] one fetch
+        bits, cnt = packed[:-1], packed[-1]
         best_val, best_cnt = None, 0
         for i in range(len(shards)):
             if cnt[i] == 0:
@@ -733,27 +756,18 @@ class Executor:
 
     def _host_row_counts(self, index: Index, f, shards,
                          row_ids: list[int]) -> list[tuple[int, int]]:
-        """Exact full-row counts from container metadata — O(containers in
-        the row's key range) per (row, shard), zero dense materialization
-        (fragment.go top RowIDs path via row().Count()). Memoized on the
-        row-generation key so a repeated TopN costs dict lookups."""
+        """Exact full-row counts from container metadata — one vectorized
+        Fragment.row_counts call per shard (each a dict probe per row over
+        a generation-cached row->count map), zero dense materialization
+        (fragment.go top RowIDs path via row().Count())."""
         view = f.view(VIEW_STANDARD)
-        out = []
-        for rid in row_ids:
-            total = 0
+        totals = np.zeros(len(row_ids), dtype=np.int64)
+        if view is not None:
             for s in shards:
-                frag = view.fragment(s) if view is not None else None
-                if frag is None:
-                    continue
-                key = ("rowcount", index.name, f.name, s, rid,
-                       frag.row_generation(rid), self._row_cache_epoch)
-                c = self._row_cache.get(key)
-                if c is None:
-                    c = frag.row_count(rid)
-                    self._row_cache[key] = c
-                total += c
-            out.append((rid, total))
-        return out
+                frag = view.fragment(s)
+                if frag is not None:
+                    totals += frag.row_counts(row_ids)
+        return [(rid, int(c)) for rid, c in zip(row_ids, totals)]
 
     def _exact_counts(self, index: Index, f, shards, row_ids: list[int],
                       src_dense, tanimoto: int):
@@ -867,7 +881,15 @@ class Executor:
                 for rid in row_ids])
             axes.append((fname, row_ids, slab))
 
-        P_CHUNK = 64  # prefixes per dispatch: bounds the fused broadcast
+        # prefixes per dispatch: the [chunk, R, S, W] intermediate is fused
+        # into the popcount reduction (never hits HBM), so chunking is
+        # bounded by per-dispatch COMPUTE (~2^31 words = ~8.6 GB of fused
+        # and+popcount, ~15 ms at the measured stream rate) — each dispatch
+        # round trip costs more than that on a tunneled link, so bigger
+        # chunks are strictly faster until the abort granularity suffers
+        def chunk_for(slab) -> int:
+            r, s, w = slab.shape
+            return int(min(512, max(16, (1 << 31) // max(1, r * s * w))))
 
         # level-0 slab with the filter folded in (one [R0, S, W] array — the
         # only level whose slab is ever materialized beyond the axis leaves)
@@ -897,11 +919,12 @@ class Executor:
                 _, row_ids, slab = axes[li]
                 last = li == len(axes) - 1
                 P, R = len(comb[0]), len(row_ids)
+                p_chunk = chunk_for(slab)
                 live_p_parts, live_r_parts, count_parts = [], [], []
                 found = 0
-                for st in range(0, P, P_CHUNK):
+                for st in range(0, P, p_chunk):
                     qctx.check()  # abort between dispatches
-                    en = min(st + P_CHUNK, P)
+                    en = min(st + p_chunk, P)
                     c = intersect_count(
                         prefix_chunk(comb, li, st, en)[:, None],
                         slab[None])                     # [chunk, R, S]

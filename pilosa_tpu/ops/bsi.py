@@ -49,6 +49,17 @@ def plane_counts(planes: jax.Array, filter_row: jax.Array) -> jax.Array:
     return popcount(jnp.bitwise_and(planes, filter_row[None]))
 
 
+@jax.jit
+def sum_counts(planes: jax.Array, filter_row: jax.Array) -> jax.Array:
+    """plane_counts with the filter's own popcount appended as the last row
+    -> int32[depth + 1, ...]: everything Sum needs in ONE dispatch and ONE
+    host fetch (rows 0..depth-1 = per-plane counts, row depth = value
+    count). Matters on high-latency device links where each fetch is a
+    round trip."""
+    pc = popcount(jnp.bitwise_and(planes, filter_row[None]))
+    return jnp.concatenate([pc, popcount(filter_row)[None]], axis=0)
+
+
 def bsi_min(planes: jax.Array, candidate: jax.Array):
     """Greedy high-to-low bit descent for the minimum value.
 
@@ -93,6 +104,20 @@ def bsi_max(planes: jax.Array, candidate: jax.Array):
 
 bsi_min = jax.jit(bsi_min)
 bsi_max = jax.jit(bsi_max)
+
+
+@jax.jit
+def bsi_min_packed(planes: jax.Array, candidate: jax.Array) -> jax.Array:
+    """bsi_min with bits and count packed into one int32[depth + 1, ...] —
+    single dispatch + single fetch (row depth = attaining-row count)."""
+    bits, cnt = bsi_min(planes, candidate)
+    return jnp.concatenate([bits, cnt[None]], axis=0)
+
+
+@jax.jit
+def bsi_max_packed(planes: jax.Array, candidate: jax.Array) -> jax.Array:
+    bits, cnt = bsi_max(planes, candidate)
+    return jnp.concatenate([bits, cnt[None]], axis=0)
 
 
 def _compare(planes, exists, pred_bits, op):

@@ -331,6 +331,20 @@ class DeviceRunner:
         return jax.device_put(
             rows, NamedSharding(self.mesh, P(SHARD_AXIS, None)))
 
+    def put_plane_slab(self, planes: np.ndarray) -> jax.Array:
+        """Place a [depth, S, W] BSI plane slab on device(s), shard-axis
+        padded and sharded like a batch of leaves (every plane partitioned
+        over the same shard slots, replicated over the replica axis)."""
+        s = planes.shape[1]
+        pad = (-s) % self.n_shard_slots
+        if pad:
+            planes = np.pad(planes, ((0, 0), (0, pad), (0, 0)))
+        planes = np.ascontiguousarray(planes)
+        if self.mesh is None:
+            return jax.device_put(planes)
+        return jax.device_put(
+            planes, NamedSharding(self.mesh, P(None, SHARD_AXIS, None)))
+
     # -- leaf-list evaluation (HBM-resident leaves, no per-query restack) ---
     # `leaves` is a Python list of [S, W] device arrays (a jit pytree arg):
     # cached leaves stay in HBM and only the compiled program runs per query.

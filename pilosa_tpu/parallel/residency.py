@@ -44,7 +44,12 @@ class DeviceResidency:
 
         `key` must encode content versions (fragment row generations), so a
         write to any underlying row produces a new key and the stale entry
-        ages out by LRU."""
+        ages out by LRU.
+
+        `make()` may return a host array (uploaded via the runner) or a
+        jax.Array already composed on device (e.g. a BSI comparison mask) —
+        the latter is cached as-is, avoiding a device->host->device round
+        trip."""
         with self._lock:
             arr = self._lru.get(key)
             if arr is not None:
@@ -53,7 +58,8 @@ class DeviceResidency:
                 return arr
             epoch = self.epoch
         host = make()
-        arr = self.runner.put_leaf(host)
+        arr = host if isinstance(host, jax.Array) else \
+            self.runner.put_leaf(host)
         with self._lock:
             self.misses += 1
             if self.epoch != epoch:

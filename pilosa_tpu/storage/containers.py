@@ -136,14 +136,9 @@ class BTreeContainers(MutableMapping):
             rnode.children = parent.children[mid + 1:]
             del parent.keys[mid:], parent.children[mid + 1:]
             new_child = rnode
-            # loop continues: insert (sep, rnode) into the next parent
-            left_child: Any = parent
-            if not path:
-                root = _Inner()
-                root.keys = [sep]
-                root.children = [left_child, rnode]
-                self._root = root
-                return
+            # loop continues: insert (sep, rnode) into the next parent;
+            # when path is exhausted, parent IS the root and the tail below
+            # grows a new root above it
         root = _Inner()
         root.keys = [sep]
         root.children = [self._root, new_child]

@@ -104,6 +104,8 @@ class Fragment:
         # Cached block checksums, invalidated per-block on writes
         # (fragment.go:1226-1305).
         self._block_checksums: dict[int, bytes] = {}
+        # (generation, {row_id: count}) — see row_counts()
+        self._row_counts_cache = None
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -291,6 +293,35 @@ class Fragment:
     def row_count(self, row_id: int) -> int:
         base = row_id * SHARD_WIDTH
         return self.storage.count_range(base, base + SHARD_WIDTH)
+
+    def row_counts(self, row_ids) -> np.ndarray:
+        """Vectorized exact counts for many rows — ONE container-key pass
+        builds a row->count map (rows are container-aligned, so a row's
+        count is a plain sum of its containers' cardinalities; lazy
+        containers never parse), cached until the next mutation bumps
+        `generation`. The TopN recount path asks for ~n=1000 winners per
+        query; per-row count_range walks the key space per call."""
+        cached = self._row_counts_cache
+        if cached is None or cached[0] != self.generation:
+            kpr = SHARD_WIDTH >> 16  # container keys per row
+            items = list(self.storage.containers.items())
+            if items:
+                keys = np.fromiter((k for k, _ in items), np.int64,
+                                   len(items))
+                ns = np.fromiter((c.n for _, c in items), np.int64,
+                                 len(items))
+                rids = keys // kpr
+                uids, inv = np.unique(rids, return_inverse=True)
+                sums = np.zeros(uids.size, dtype=np.int64)
+                np.add.at(sums, inv, ns)
+                m = dict(zip(uids.tolist(), sums.tolist()))
+            else:
+                m = {}
+            cached = (self.generation, m)
+            self._row_counts_cache = cached
+        m = cached[1]
+        return np.fromiter((m.get(int(r), 0) for r in row_ids), np.int64,
+                           count=len(row_ids))
 
     def max_row_id(self) -> int:
         m = self.storage.max()
