@@ -24,6 +24,7 @@ reference's rowCache is invalidated on mutation (fragment.go:435-440).
 
 from __future__ import annotations
 
+import os
 from datetime import datetime
 from typing import Optional
 
@@ -119,6 +120,13 @@ class Executor:
         # transfers (parallel/residency.py)
         from pilosa_tpu.parallel.residency import DeviceResidency
         self.residency = DeviceResidency(self.runner)
+        # continuous batching of concurrent simple Counts into single
+        # device dispatches (parallel/batcher.py); PILOSA_TPU_BATCH=0
+        # falls back to one dispatch per query
+        from pilosa_tpu.parallel.batcher import CountBatcher
+        self.batcher = (CountBatcher()
+                        if os.environ.get("PILOSA_TPU_BATCH", "1") != "0"
+                        else None)
 
     def clear_caches(self) -> None:
         """Drop the host row cache and all HBM-resident leaves. Called on
@@ -359,11 +367,27 @@ class Executor:
                         out.attrs = attrs
         return out
 
+    # programs the continuous batcher can coalesce (batcher.py): a bare
+    # leaf or one binary op over two leaves — the dominant Count shapes
+    _BATCHABLE_OPS = ("and", "or", "xor", "andnot")
+
     def _execute_count(self, index: Index, call: Call, shards) -> int:
         if len(call.children) != 1:
             raise ExecutionError("Count() takes exactly one argument")
         shards = self._query_shards(index, shards)
         program, leaves = self._compile(index, call.children[0], shards)
+        if self.batcher is not None:
+            # concurrent Counts coalesce into one device dispatch
+            # (continuous batching — parallel/batcher.py)
+            if program == ("leaf", 0) and len(leaves) == 1:
+                return self.batcher.count("id", leaves[0], None)
+            if (len(leaves) == 2 and isinstance(program, tuple)
+                    and len(program) == 3
+                    and program[0] in self._BATCHABLE_OPS
+                    and program[1] == ("leaf", 0)
+                    and program[2] == ("leaf", 1)
+                    and leaves[0].shape == leaves[1].shape):
+                return self.batcher.count(program[0], leaves[0], leaves[1])
         return self.runner.count_total_leaves(leaves, program)
 
     # ------------------------------------------------- leaf materialization

@@ -351,6 +351,9 @@ class Handler:
             if residency is not None:
                 snap["deviceResidency"] = residency.snapshot()
             snap["topnRecountRows"] = getattr(ex, "topn_recount_rows", 0)
+            batcher = getattr(ex, "batcher", None)
+            if batcher is not None:
+                snap["countBatcher"] = batcher.snapshot()
         return self._json(snap)
 
     def get_debug_pprof(self, params, query, body):

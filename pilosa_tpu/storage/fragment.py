@@ -294,15 +294,33 @@ class Fragment:
         base = row_id * SHARD_WIDTH
         return self.storage.count_range(base, base + SHARD_WIDTH)
 
+    def _row_count_direct(self, row_id: int) -> int:
+        """O(keys-per-row) count by probing the row's (container-aligned)
+        key slots directly — no key-space scan."""
+        kpr = SHARD_WIDTH >> 16
+        base = row_id * kpr
+        get = self.storage.containers.get
+        total = 0
+        for j in range(kpr):
+            c = get(base + j)
+            if c is not None:
+                total += c.n
+        return total
+
     def row_counts(self, row_ids) -> np.ndarray:
-        """Vectorized exact counts for many rows — ONE container-key pass
-        builds a row->count map (rows are container-aligned, so a row's
-        count is a plain sum of its containers' cardinalities; lazy
-        containers never parse), cached until the next mutation bumps
-        `generation`. The TopN recount path asks for ~n=1000 winners per
-        query; per-row count_range walks the key space per call."""
+        """Vectorized exact counts for many rows (the TopN recount asks for
+        ~n=1000 winners per query; per-row count_range walks the whole key
+        space per call).
+
+        One container-key pass builds a row->count map (rows are
+        container-aligned, so a row's count is a plain sum of its
+        containers' cardinalities; lazy containers never parse). The map
+        is rebuilt only when a BULK mutation dirties every row; single-bit
+        writes are absorbed by an overlay that re-probes just the mutated
+        rows (per-row generations), so write-heavy workloads never pay a
+        full O(containers) rebuild per query."""
         cached = self._row_counts_cache
-        if cached is None or cached[0] != self.generation:
+        if cached is None or cached[0] != self._bulk_gen:
             kpr = SHARD_WIDTH >> 16  # container keys per row
             items = list(self.storage.containers.items())
             if items:
@@ -317,11 +335,26 @@ class Fragment:
                 m = dict(zip(uids.tolist(), sums.tolist()))
             else:
                 m = {}
-            cached = (self.generation, m)
+            # (bulk gen, generation at build, base map, stale-row overlay)
+            cached = (self._bulk_gen, self.generation, m, {})
             self._row_counts_cache = cached
-        m = cached[1]
-        return np.fromiter((m.get(int(r), 0) for r in row_ids), np.int64,
-                           count=len(row_ids))
+        _, base_gen, m, overlay = cached
+        out = np.empty(len(row_ids), dtype=np.int64)
+        row_gen = self._row_gen.get
+        for x, r in enumerate(row_ids):
+            r = int(r)
+            rg = row_gen(r, 0)
+            if rg > base_gen:  # mutated since the map was built
+                og = overlay.get(r)
+                if og is not None and og[0] == rg:
+                    c = og[1]
+                else:
+                    c = self._row_count_direct(r)
+                    overlay[r] = (rg, c)
+            else:
+                c = m.get(r, 0)
+            out[x] = c
+        return out
 
     def max_row_id(self) -> int:
         m = self.storage.max()

@@ -1,0 +1,186 @@
+"""Continuous count batching (parallel/batcher.py): concurrent simple
+Counts coalesce into single device dispatches."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from pilosa_tpu.parallel.batcher import CountBatcher, _pow2
+
+
+def _leaves(n=4, s=2, w=256, seed=0):
+    import jax
+
+    rng = np.random.default_rng(seed)
+    return [jax.device_put(rng.integers(0, 2**32, size=(s, w),
+                                        dtype=np.uint32))
+            for _ in range(n)]
+
+
+def _expect(op, a, b):
+    a, b = np.asarray(a), np.asarray(b)
+    if op == "and":
+        r = a & b
+    elif op == "or":
+        r = a | b
+    elif op == "xor":
+        r = a ^ b
+    elif op == "andnot":
+        r = a & ~b
+    else:
+        r = a
+    return int(np.bitwise_count(r).sum())
+
+
+def test_single_query_immediate():
+    b = CountBatcher()
+    ls = _leaves(2)
+    got = b.count("and", ls[0], ls[1])
+    assert got == _expect("and", ls[0], ls[1])
+    snap = b.snapshot()
+    assert snap["batches"] == 1 and snap["batched_queries"] == 1
+
+
+@pytest.mark.parametrize("op", ["and", "or", "xor", "andnot"])
+def test_ops_and_leaf_count(op):
+    b = CountBatcher()
+    ls = _leaves(3, seed=op.__hash__() % 100)
+    assert b.count(op, ls[0], ls[1]) == _expect(op, ls[0], ls[1])
+    assert b.count("id", ls[2], None) == _expect("id", ls[2], ls[2])
+
+
+def test_concurrent_batching_correct_and_batched():
+    b = CountBatcher()
+    ls = _leaves(6)
+    n_threads, per = 16, 20
+    results = {}
+    errors = []
+    start = threading.Barrier(n_threads)
+
+    def client(tid):
+        try:
+            start.wait()
+            out = []
+            for i in range(per):
+                x, y = ls[(tid + i) % 6], ls[(tid * 3 + i * 7) % 6]
+                out.append((id(x), id(y), b.count("and", x, y)))
+            results[tid] = out
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [threading.Thread(target=client, args=(t,))
+               for t in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors, errors
+    by_id = {id(x): x for x in ls}
+    for out in results.values():
+        for xa, xb, got in out:
+            assert got == _expect("and", by_id[xa], by_id[xb])
+    snap = b.snapshot()
+    assert snap["batched_queries"] == n_threads * per
+    # batching must actually have happened (fewer dispatches than queries)
+    assert snap["batches"] < n_threads * per, snap
+    assert snap["max_batch_seen"] > 1
+
+
+def test_leadership_handoff_under_load():
+    """A leader serves ONE batch then promotes the queue head — no thread
+    serves strangers after its own query completes."""
+    b = CountBatcher(max_batch=4)
+    ls = _leaves(2)
+    n = 24
+    done = []
+
+    def client(i):
+        done.append((i, b.count("and", ls[0], ls[1])))
+
+    threads = [threading.Thread(target=client, args=(i,)) for i in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    expect = _expect("and", ls[0], ls[1])
+    assert len(done) == n and all(c == expect for _, c in done)
+    assert b.snapshot()["batches"] >= n // 4  # max_batch enforced
+
+
+def test_error_propagates_to_all_waiters(monkeypatch):
+    import pilosa_tpu.parallel.batcher as mod
+
+    b = CountBatcher()
+
+    def boom(*a, **k):
+        raise RuntimeError("kernel exploded")
+
+    monkeypatch.setattr(mod, "_batched_counts", boom)
+    ls = _leaves(2)
+    errs = []
+
+    def client():
+        try:
+            b.count("and", ls[0], ls[1])
+        except RuntimeError as e:
+            errs.append(str(e))
+
+    threads = [threading.Thread(target=client) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(errs) == 8 and all("kernel exploded" in e for e in errs)
+    # batcher stays usable after the failure
+    monkeypatch.undo()
+    assert b.count("and", ls[0], ls[1]) == _expect("and", ls[0], ls[1])
+
+
+def test_pow2():
+    assert [_pow2(n) for n in (1, 2, 3, 5, 8, 9)] == [1, 2, 4, 8, 8, 16]
+
+
+def test_executor_count_uses_batcher(tmp_path):
+    from pilosa_tpu.executor import Executor
+    from pilosa_tpu.models import Holder
+
+    holder = Holder(str(tmp_path)).open()
+    ex = Executor(holder)
+    assert ex.batcher is not None
+    idx = holder.create_index("bt", track_existence=False)
+    f = idx.create_field("f")
+    f.import_bits([0, 0, 1, 1, 1], [1, 5, 5, 9, 2_000_000])
+    (c,) = ex.execute("bt", "Count(Intersect(Row(f=0), Row(f=1)))")
+    assert c == 1
+    (c2,) = ex.execute("bt", "Count(Row(f=1))")
+    assert c2 == 3
+    (c3,) = ex.execute("bt", "Count(Union(Row(f=0), Row(f=1)))")
+    assert c3 == 4
+    (c4,) = ex.execute("bt", "Count(Difference(Row(f=1), Row(f=0)))")
+    assert c4 == 2
+    snap = ex.batcher.snapshot()
+    assert snap["batched_queries"] == 4
+    # Not() compiles to andnot(existence, child) — needs existence tracking;
+    # three-way intersect is NOT batchable and must take the general path
+    (c5,) = ex.execute(
+        "bt", "Count(Intersect(Row(f=0), Row(f=1), Row(f=1)))")
+    assert c5 == 1
+    assert ex.batcher.snapshot()["batched_queries"] == 4  # unchanged
+    holder.close()
+
+
+def test_executor_batcher_disabled(tmp_path, monkeypatch):
+    monkeypatch.setenv("PILOSA_TPU_BATCH", "0")
+    from pilosa_tpu.executor import Executor
+    from pilosa_tpu.models import Holder
+
+    holder = Holder(str(tmp_path)).open()
+    ex = Executor(holder)
+    assert ex.batcher is None
+    idx = holder.create_index("bt2", track_existence=False)
+    f = idx.create_field("f")
+    f.import_bits([0, 1], [3, 3])
+    (c,) = ex.execute("bt2", "Count(Intersect(Row(f=0), Row(f=1)))")
+    assert c == 1
+    holder.close()

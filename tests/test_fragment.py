@@ -342,3 +342,25 @@ def test_snapshot_remaps_and_preserves_laziness(tmp_path):
     frag = Fragment(str(tmp_path / "f"), "i", "f", "standard", 0).open()
     assert frag.contains(0, 1) and frag.row_count(5) == 5000
     frag.close()
+
+
+def test_row_counts_overlay_after_single_bit_writes(tmp_path):
+    """row_counts must absorb single-bit writes via the per-row overlay —
+    and stay exact — without a bulk-generation rebuild."""
+    from pilosa_tpu.storage.fragment import Fragment
+
+    f = Fragment(str(tmp_path / "rc"), "i", "f", "standard", 0).open()
+    f.bulk_import([0, 0, 1, 2], [5, 9, 9, 70000])
+    assert f.row_counts([0, 1, 2, 3]).tolist() == [2, 1, 1, 0]
+    bulk_gen_before = f._row_counts_cache[0]
+    f.set_bit(1, 100)       # single-bit write: overlay, not rebuild
+    f.set_bit(3, 8)
+    f.clear_bit(0, 5)
+    assert f.row_counts([0, 1, 2, 3]).tolist() == [1, 2, 1, 1]
+    assert f._row_counts_cache[0] == bulk_gen_before  # base map reused
+    # repeated query hits the overlay (same generations)
+    assert f.row_counts([1, 3]).tolist() == [2, 1]
+    # a bulk mutation rebuilds the base map
+    f.bulk_import([5], [123])
+    assert f.row_counts([0, 1, 2, 3, 5]).tolist() == [1, 2, 1, 1, 1]
+    f.close()
