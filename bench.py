@@ -550,6 +550,101 @@ def bench_http(tmpdir) -> dict:
         srv.close()
 
 
+DIST_SHARDS = 16
+DIST_THREADS = 8
+DIST_QUERIES = 96
+
+
+def bench_distributed(tmpdir) -> dict:
+    """Config 5: distributed Intersect+Count over a 2-node cluster — the
+    mapReduce fan-out path (executor.go:2183 analog): node 0 executes its
+    own shards locally (device) and scatter-gathers the rest from node 1
+    over HTTP/JSON, merging per-shard counts. Both in-process nodes share
+    the one real chip; the measured delta vs the single-node executor
+    number is the fan-out + wire + remote-re-parse overhead."""
+    import threading
+    import urllib.request
+
+    from pilosa_tpu.server import Server
+
+    servers = [Server(os.path.join(tmpdir, f"dn{i}"), port=0).open()
+               for i in range(2)]
+    try:
+        uris = [s.uri for s in servers]
+        for s in servers:
+            s.cluster_hosts = uris
+            s.refresh_membership()
+
+        def post(uri, path, body):
+            req = urllib.request.Request(uri + path, data=body,
+                                         method="POST")
+            with urllib.request.urlopen(req, timeout=60) as r:
+                return json.loads(r.read())
+
+        post(uris[0], "/index/d", b"{}")
+        post(uris[0], "/index/d/field/f", b"{}")
+        rng = np.random.default_rng(29)
+        n_per = int(SHARD_WIDTH * 0.005)
+        sets = {}
+        row_ids, col_ids = [], []
+        for shard in range(DIST_SHARDS):
+            for row in (0, 1):
+                cols = (rng.choice(SHARD_WIDTH, size=n_per, replace=False)
+                        .astype(np.int64) + shard * SHARD_WIDTH)
+                sets[(row, shard)] = cols
+                row_ids += [row] * n_per
+                col_ids += cols.tolist()
+        # one import POST: the API splits by shard and forwards each batch
+        # to its owning node (api.py forward_import_fn)
+        post(uris[0], "/index/d/field/f/import", json.dumps({
+            "rowIDs": row_ids, "columnIDs": col_ids}).encode())
+
+        q = b"Count(Intersect(Row(f=0), Row(f=1)))"
+        out = post(uris[0], "/index/d/query", q)  # warm + correctness
+        expect = sum(
+            np.intersect1d(sets[(0, s)], sets[(1, s)]).size
+            for s in range(DIST_SHARDS))
+        assert out["results"][0] == expect, (out, expect)
+        # both nodes must answer identically (remote re-parse path)
+        out1 = post(uris[1], "/index/d/query", q)
+        assert out1["results"][0] == expect, out1
+
+        per_thread = DIST_QUERIES // DIST_THREADS
+        errors = []
+
+        def client():
+            try:
+                for _ in range(per_thread):
+                    post(uris[0], "/index/d/query", q)
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        threads = [threading.Thread(target=client)
+                   for _ in range(DIST_THREADS)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        if errors:
+            raise errors[0]
+        per_q = wall / (DIST_THREADS * per_thread)
+        return {
+            "metric": "distributed_count_qps_16shard_2node",
+            "value": round(1.0 / per_q, 2),
+            "unit": "queries/s",
+            "vs_baseline": 0.0,  # overhead metric; no numpy equivalent
+            "tpu_ms_per_query": round(per_q * 1e3, 4),
+            "concurrency": DIST_THREADS,
+            "path": "2-node mapReduce fan-out: local device shards + "
+                    "HTTP scatter-gather (executor.go:2183 analog)",
+        }
+    finally:
+        for s in servers:
+            s.close()
+
+
 def worker() -> None:
     """Full measurement (runs in a subprocess; may hang — parent enforces
     the deadline). Prints the final JSON line on success."""
@@ -613,6 +708,7 @@ def worker() -> None:
         staged("bsi", lambda: (ex, build_bsi_index(holder)), bench_bsi)
         holder.close()
         stage("http", bench_http, tmp)
+        stage("distributed", bench_distributed, tmp)
     finally:
         shutil.rmtree(tmp, ignore_errors=True)
 
