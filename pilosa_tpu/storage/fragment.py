@@ -106,6 +106,8 @@ class Fragment:
         self._block_checksums: dict[int, bytes] = {}
         # (generation, {row_id: count}) — see row_counts()
         self._row_counts_cache = None
+        # (generation, ascending distinct row ids) — see row_ids()
+        self._row_ids_cache = None
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -362,18 +364,22 @@ class Fragment:
 
     def row_ids(self, start: int = 0, limit: Optional[int] = None) -> list[int]:
         """Distinct row ids with any set bit, ascending (rows(),
-        fragment.go:2000-2138): walks container keys, not bits."""
-        out: list[int] = []
-        rows_per_shift = SHARD_WIDTH >> 16  # container keys per row
-        last = -1
-        for key in sorted(self.storage.containers):
-            rid = key // rows_per_shift
-            if rid != last and rid >= start:
-                out.append(rid)
-                last = rid
-                if limit is not None and len(out) >= limit:
-                    break
-        return out
+        fragment.go:2000-2138): walks container keys, not bits. The full
+        ascending list is cached per generation — Rows/GroupBy call this
+        per shard per query, and the dict store pays a full key sort per
+        walk otherwise."""
+        from bisect import bisect_left
+
+        cached = self._row_ids_cache
+        if cached is None or cached[0] != self.generation:
+            kpr = SHARD_WIDTH >> 16  # container keys per row
+            cached = (self.generation,
+                      sorted({key // kpr for key in self.storage.containers}))
+            self._row_ids_cache = cached
+        ids = cached[1]
+        if start:
+            ids = ids[bisect_left(ids, start):]
+        return ids[:limit] if limit is not None else list(ids)
 
     def rows_for_column(self, column: int) -> list[int]:
         """Row ids with this column's bit set — the reference's mutex column

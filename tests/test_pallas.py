@@ -27,6 +27,17 @@ def test_program_count_nested():
     np.testing.assert_array_equal(got, expect)
 
 
+def test_program_count_not_with_shard_padding():
+    """Not-rooted programs complement the zero padding to all-ones; the
+    padded shards' counts must be sliced off, never summed in."""
+    for s in (3, 5):  # forces _pad_shards
+        leaves = RNG.integers(0, 2**32, size=(1, s, W), dtype=np.uint32)
+        got = np.asarray(pk.program_count(leaves, ("not", ("leaf", 0))))
+        assert got.shape == (s,)
+        expect = np.bitwise_count(~leaves[0]).sum(axis=1).astype(np.int32)
+        np.testing.assert_array_equal(got, expect)
+
+
 def test_pair_stream_counts_matches_numpy():
     """Scalar-prefetch query stream: data-dependent row gathers via
     PrefetchScalarGridSpec, per-query accumulation over shard blocks."""
