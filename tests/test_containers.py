@@ -206,6 +206,28 @@ def test_bitmap_min_max_keys_in_btree_paths():
     assert bm._keys_in(10, 10) == []
 
 
+def test_fragment_lifecycle_under_btree_store(tmp_path, monkeypatch):
+    """Full fragment lifecycle (open -> import -> single-bit WAL writes ->
+    reopen-without-close replay) with the btree store selected process-wide
+    — the enterprise-build-tag usage shape."""
+    monkeypatch.setenv("PILOSA_TPU_CONTAINER_STORE", "btree")
+    from pilosa_tpu.storage.fragment import Fragment
+
+    f = Fragment(str(tmp_path / "bt"), "i", "f", "standard", 0).open()
+    assert isinstance(f.storage.containers, BTreeContainers)
+    f.bulk_import([0, 0, 1], [5, 9, 9])
+    f.set_bit(2, 123)
+    f.set_bit(2, 124)
+    assert f.row_counts([0, 1, 2]).tolist() == [2, 1, 2]
+    f.close()  # crash-shaped reopen is covered by test_fragment's WAL tests
+    f2 = Fragment(str(tmp_path / "bt"), "i", "f", "standard", 0)
+    f2.open()
+    assert isinstance(f2.storage.containers, BTreeContainers)
+    assert f2.row_counts([0, 1, 2]).tolist() == [2, 1, 2]
+    assert sorted(f2.row_columns(2)) == [123, 124]
+    f2.close()
+
+
 def test_bitmap_btree_store_env(monkeypatch):
     monkeypatch.setenv("PILOSA_TPU_CONTAINER_STORE", "btree")
     bm = Bitmap(np.array([1, 2, 3], dtype=np.uint64))
