@@ -1,18 +1,25 @@
 """Benchmarks: the REAL engine on TPU vs CPU-numpy baselines.
 
-Five measurements (BASELINE.md configs), all through production code paths:
+Seven measurements (BASELINE.md configs), all through production code paths:
 
-1. kernel    — raw fused and+popcount query stream on a 1.07B-column
-               resident slab (config 2's kernel ceiling; regression metric).
-2. executor  — Executor.execute("Count(Intersect(Row,Row))") end to end:
-               parse -> compile -> HBM residency (warm) -> device program ->
-               host merge (executor.go:1208,1521 analog).
-3. topn      — TopN(n=1000) over a ranked-cache field through the executor's
-               two-phase threshold walk (config 3; fragment.go:1018-1150).
-4. bsi       — Sum(Range(v > x)) through the BSI plane kernels (config 4;
-               fragment.go:718-985, executor.go:363).
-5. http      — end-to-end HTTP loopback QPS against a real Server (config 1:
-               the wire + parse + execute serving path).
+1. kernel      — raw fused and+popcount query stream on a 1.07B-column
+                 resident slab, K queries batched per dispatch (config 2's
+                 kernel ceiling; regression metric).
+2. executor    — Executor.execute("Count(Intersect(Row,Row))") end to end
+                 under concurrent clients: parse -> compile -> HBM residency
+                 (warm) -> continuous-batched device dispatch -> host merge
+                 (executor.go:1208,1521 analog).
+3. topn        — TopN(n=1000) over a ranked-cache field through the
+                 executor's two-phase threshold walk (config 3;
+                 fragment.go:1018-1150).
+4. groupby     — GroupBy cross product via device-batched fused counts
+                 (executor.go:897-1090).
+5. bsi         — Sum(Range(v > x)) through the device-composed BSI plane
+                 kernels (config 4; fragment.go:718-985, executor.go:363).
+6. http        — end-to-end HTTP loopback QPS against a real Server under
+                 concurrent clients (config 1: wire + parse + execute).
+7. distributed — 2-node cluster mapReduce fan-out Count over 16 shards
+                 (config 5; executor.go:2183 analog).
 
 The CPU baseline for each is the same logical work in vectorized numpy —
 an upper bound on the reference's single-node Go throughput for dense data
@@ -87,6 +94,35 @@ def _apply_platform() -> None:
         import jax
 
         jax.config.update("jax_platforms", PLATFORM)
+
+
+def _concurrent_seconds_per_query(n_threads: int, per_thread: int,
+                                  run_query) -> float:
+    """Aggregate serving rate under concurrent clients: n_threads each
+    issue per_thread queries via run_query(thread_id, i); returns wall
+    seconds per query. First client error re-raises."""
+    import threading
+
+    errors = []
+
+    def client(tid):
+        try:
+            for i in range(per_thread):
+                run_query(tid, i)
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [threading.Thread(target=client, args=(t,))
+               for t in range(n_threads)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    if errors:
+        raise errors[0]
+    return wall / (n_threads * per_thread)
 
 
 def _init_backend_with_retry(deadline: float):
@@ -231,8 +267,6 @@ def build_exec_index(holder):
 
 
 def bench_executor(ex, row_bits) -> dict:
-    import threading
-
     qs = [f"Count(Intersect(Row(f={i % EXEC_ROWS}), Row(f={(i * 3 + 1) % EXEC_ROWS})))"
           for i in range(ENGINE_QUERIES)]
     # warmup: residency fill (host->HBM through the tunnel, one-time) +
@@ -255,27 +289,9 @@ def bench_executor(ex, row_bits) -> dict:
     # concurrent throughput: EXEC_THREADS client threads, the serving QPS
     # analog of the reference's concurrent query benchmarks (dispatches
     # and fetches from different queries overlap on the link)
-    per_thread = max(8, ENGINE_QUERIES // 4)
-    errors = []
-
-    def client(tid):
-        try:
-            for i in range(per_thread):
-                ex.execute("b", qs[(tid * 7 + i) % len(qs)])
-        except Exception as e:  # noqa: BLE001
-            errors.append(e)
-
-    threads = [threading.Thread(target=client, args=(t,))
-               for t in range(EXEC_THREADS)]
-    t0 = time.perf_counter()
-    for t in threads:
-        t.start()
-    for t in threads:
-        t.join()
-    wall = time.perf_counter() - t0
-    if errors:
-        raise errors[0]
-    tpu_s = wall / (EXEC_THREADS * per_thread)
+    tpu_s = _concurrent_seconds_per_query(
+        EXEC_THREADS, max(8, ENGINE_QUERIES // 4),
+        lambda tid, i: ex.execute("b", qs[(tid * 7 + i) % len(qs)]))
 
     # CPU baseline: the same dense AND+popcount work in numpy (per query:
     # two [S, W] operands), scaled from a slice
@@ -512,29 +528,9 @@ def bench_http(tmpdir) -> dict:
         single_s = (time.perf_counter() - t0) / 10
 
         # concurrent clients (the threaded server's actual serving mode)
-        import threading
-
-        per_thread = HTTP_QUERIES // HTTP_THREADS
-        errors = []
-
-        def client():
-            try:
-                for _ in range(per_thread):
-                    post("/index/h/query", q)
-            except Exception as e:  # noqa: BLE001
-                errors.append(e)
-
-        threads = [threading.Thread(target=client)
-                   for _ in range(HTTP_THREADS)]
-        t0 = time.perf_counter()
-        for t in threads:
-            t.start()
-        for t in threads:
-            t.join()
-        wall = time.perf_counter() - t0
-        if errors:
-            raise errors[0]
-        per_q = wall / (HTTP_THREADS * per_thread)
+        per_q = _concurrent_seconds_per_query(
+            HTTP_THREADS, HTTP_QUERIES // HTTP_THREADS,
+            lambda tid, i: post("/index/h/query", q))
         return {
             "metric": "http_count_qps",
             "value": round(1.0 / per_q, 2),
@@ -562,7 +558,6 @@ def bench_distributed(tmpdir) -> dict:
     over HTTP/JSON, merging per-shard counts. Both in-process nodes share
     the one real chip; the measured delta vs the single-node executor
     number is the fan-out + wire + remote-re-parse overhead."""
-    import threading
     import urllib.request
 
     from pilosa_tpu.server import Server
@@ -609,27 +604,9 @@ def bench_distributed(tmpdir) -> dict:
         out1 = post(uris[1], "/index/d/query", q)
         assert out1["results"][0] == expect, out1
 
-        per_thread = DIST_QUERIES // DIST_THREADS
-        errors = []
-
-        def client():
-            try:
-                for _ in range(per_thread):
-                    post(uris[0], "/index/d/query", q)
-            except Exception as e:  # noqa: BLE001
-                errors.append(e)
-
-        threads = [threading.Thread(target=client)
-                   for _ in range(DIST_THREADS)]
-        t0 = time.perf_counter()
-        for t in threads:
-            t.start()
-        for t in threads:
-            t.join()
-        wall = time.perf_counter() - t0
-        if errors:
-            raise errors[0]
-        per_q = wall / (DIST_THREADS * per_thread)
+        per_q = _concurrent_seconds_per_query(
+            DIST_THREADS, DIST_QUERIES // DIST_THREADS,
+            lambda tid, i: post(uris[0], "/index/d/query", q))
         return {
             "metric": "distributed_count_qps_16shard_2node",
             "value": round(1.0 / per_q, 2),

@@ -315,35 +315,35 @@ class DeviceRunner:
         return (1 if self.mesh is None
                 else self.mesh.shape.get(REPLICA_AXIS, 1))
 
+    def _put_shard_padded(self, arr: np.ndarray, shard_axis: int) -> jax.Array:
+        """Pad `shard_axis` to a multiple of the shard slots and place on
+        device(s): that axis shards over the mesh, every other axis (and
+        the replica axis) replicates."""
+        pad = (-arr.shape[shard_axis]) % self.n_shard_slots
+        if pad:
+            widths = [(0, 0)] * arr.ndim
+            widths[shard_axis] = (0, pad)
+            arr = np.pad(arr, widths)
+        arr = np.ascontiguousarray(arr)
+        if self.mesh is None:
+            return jax.device_put(arr)
+        spec = [None] * arr.ndim
+        spec[shard_axis] = SHARD_AXIS
+        return jax.device_put(arr, NamedSharding(self.mesh, P(*spec)))
+
     def put_leaf(self, rows: np.ndarray) -> jax.Array:
         """Place one leaf [S, W] on device(s), padded to a multiple of the
         shard-axis size and sharded over it — the unit cached by the HBM
         residency manager (parallel/residency.py). On a replica×shard mesh
         the unmentioned replica axis replicates: every replica slice holds
         a full copy of the leaf (ReplicaN on-mesh, SURVEY §2.9)."""
-        s = rows.shape[0]
-        pad = (-s) % self.n_shard_slots
-        if pad:
-            rows = np.pad(rows, ((0, pad), (0, 0)))
-        rows = np.ascontiguousarray(rows)
-        if self.mesh is None:
-            return jax.device_put(rows)
-        return jax.device_put(
-            rows, NamedSharding(self.mesh, P(SHARD_AXIS, None)))
+        return self._put_shard_padded(rows, 0)
 
     def put_plane_slab(self, planes: np.ndarray) -> jax.Array:
         """Place a [depth, S, W] BSI plane slab on device(s), shard-axis
         padded and sharded like a batch of leaves (every plane partitioned
         over the same shard slots, replicated over the replica axis)."""
-        s = planes.shape[1]
-        pad = (-s) % self.n_shard_slots
-        if pad:
-            planes = np.pad(planes, ((0, 0), (0, pad), (0, 0)))
-        planes = np.ascontiguousarray(planes)
-        if self.mesh is None:
-            return jax.device_put(planes)
-        return jax.device_put(
-            planes, NamedSharding(self.mesh, P(None, SHARD_AXIS, None)))
+        return self._put_shard_padded(planes, 1)
 
     # -- leaf-list evaluation (HBM-resident leaves, no per-query restack) ---
     # `leaves` is a Python list of [S, W] device arrays (a jit pytree arg):

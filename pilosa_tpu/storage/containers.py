@@ -69,8 +69,8 @@ class BTreeContainers(MutableMapping):
         self._root: Any = _Leaf()
         self._len = 0
         if items is not None:
-            src = items.items() if isinstance(items, (dict, MutableMapping)) \
-                else items
+            # a mapping (dict registers as MutableMapping) or (k, v) pairs
+            src = items.items() if isinstance(items, MutableMapping) else items
             for k, v in src:
                 self[k] = v
 

@@ -35,6 +35,7 @@ from typing import Iterable, Optional
 import numpy as np
 
 from pilosa_tpu.constants import (
+    CONTAINERS_PER_SHARD,
     HASH_BLOCK_SIZE,
     MAX_OP_N,
     SHARD_WIDTH,
@@ -299,7 +300,7 @@ class Fragment:
     def _row_count_direct(self, row_id: int) -> int:
         """O(keys-per-row) count by probing the row's (container-aligned)
         key slots directly — no key-space scan."""
-        kpr = SHARD_WIDTH >> 16
+        kpr = CONTAINERS_PER_SHARD
         base = row_id * kpr
         get = self.storage.containers.get
         total = 0
@@ -323,7 +324,7 @@ class Fragment:
         full O(containers) rebuild per query."""
         cached = self._row_counts_cache
         if cached is None or cached[0] != self._bulk_gen:
-            kpr = SHARD_WIDTH >> 16  # container keys per row
+            kpr = CONTAINERS_PER_SHARD  # container keys per row
             items = list(self.storage.containers.items())
             if items:
                 keys = np.fromiter((k for k, _ in items), np.int64,
@@ -372,7 +373,7 @@ class Fragment:
 
         cached = self._row_ids_cache
         if cached is None or cached[0] != self.generation:
-            kpr = SHARD_WIDTH >> 16  # container keys per row
+            kpr = CONTAINERS_PER_SHARD  # container keys per row
             cached = (self.generation,
                       sorted({key // kpr for key in self.storage.containers}))
             self._row_ids_cache = cached
@@ -389,7 +390,7 @@ class Fragment:
         one membership test per *existing* candidate container instead of a
         full per-row scan over every row id."""
         col = column % SHARD_WIDTH
-        keys_per_row = SHARD_WIDTH >> 16
+        keys_per_row = CONTAINERS_PER_SHARD
         sub, low = col >> 16, col & 0xFFFF
         out: list[int] = []
         for key in self.storage.containers:
