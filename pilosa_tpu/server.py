@@ -319,7 +319,10 @@ class Server:
             try:
                 self.client.status(node.uri, timeout=self.probe_timeout)
                 return True
-            except ClientError:
+            except Exception:  # noqa: BLE001 — ANY probe failure means
+                # not-alive (ClientError, socket teardown mid-close, ...);
+                # an escaping exception would kill the probe thread and
+                # count as dead anyway, minus the noise
                 return False
 
         results: dict[str, bool] = {}
