@@ -113,3 +113,89 @@ def test_fuzz_counts_match_rows(world):
         expected = len(eval_model(tree, model, exists))
         got = ex.execute("i", f"Count({pql})")[0]
         assert got == expected, f"iteration {i}: Count({pql})"
+
+
+# --------------------------------------------------------------- BSI fuzz
+
+
+@pytest.fixture(scope="module")
+def bsi_world(tmp_path_factory):
+    """(executor, values, row_model): an int field over random columns plus
+    one set field for Intersect composition."""
+    from pilosa_tpu.models import FieldOptions, FieldType
+
+    rng = random.Random(0xB51)
+    tmp = tmp_path_factory.mktemp("fuzz_bsi")
+    h = Holder(str(tmp / "data")).open()
+    idx = h.create_index("b", track_existence=False)
+    v = idx.create_field("v", FieldOptions(type=FieldType.INT,
+                                           min=-50, max=200))
+    f = idx.create_field("f")
+    n_cols = 2 * SHARD_WIDTH
+    values: dict[int, int] = {}
+    cols = rng.sample(range(n_cols), 600)
+    vals = [rng.randrange(-50, 201) for _ in cols]
+    for c, val in zip(cols, vals):
+        values[c] = val
+    v.import_values(cols, vals)
+    rows: dict[int, set[int]] = {}
+    for r in range(3):
+        rc = set(rng.sample(range(n_cols), 300)) | \
+            set(rng.sample(cols, 50))  # overlap with valued columns
+        rows[r] = rc
+        f.import_bits([r] * len(rc), sorted(rc))
+    ex = Executor(h)
+    yield ex, values, rows
+    h.close()
+
+
+def _bsi_model(values, op, x, y=None):
+    if op == "><":
+        return {c for c, val in values.items() if x <= val <= y}
+    import operator
+
+    f = {"<": operator.lt, "<=": operator.le, ">": operator.gt,
+         ">=": operator.ge, "==": operator.eq, "!=": operator.ne}[op]
+    return {c for c, val in values.items() if f(val, x)}
+
+
+def test_fuzz_bsi_conditions(bsi_world):
+    """Random comparison sweeps (incl. values at/past the field bounds and
+    the a < v < b form) vs a dict model — the borrow/carry compare kernels
+    and base-offset clamps (fragment.go:808-985, field.go:1385-1430)."""
+    ex, values, rows = bsi_world
+    rng = random.Random(0x5EED)
+    ops = ["<", "<=", ">", ">=", "==", "!="]
+    for i in range(50):
+        if rng.random() < 0.25:
+            a = rng.randrange(-60, 211)
+            b = a + rng.randrange(0, 80)
+            pql = f"Range({a} < v < {b})"
+            expected = _bsi_model(values, "><", a + 1, b - 1)
+        else:
+            op = rng.choice(ops)
+            x = rng.randrange(-60, 211)  # may exceed [min, max]
+            pql = f"Range(v {op} {x})"
+            expected = _bsi_model(values, op, x)
+        got = set(ex.execute("b", pql)[0].columns().tolist())
+        assert got == expected, f"iteration {i}: {pql}"
+        # Count() takes the 1-leaf batcher path
+        got_n = ex.execute("b", f"Count({pql})")[0]
+        assert got_n == len(expected), f"iteration {i}: Count({pql})"
+
+
+def test_fuzz_bsi_intersect_and_sum(bsi_world):
+    """Range composed under Intersect, and Sum over a filtered Range."""
+    ex, values, rows = bsi_world
+    rng = random.Random(0xFACE)
+    for i in range(25):
+        r = rng.randrange(3)
+        x = rng.randrange(-50, 201)
+        pql = f"Intersect(Row(f={r}), Range(v >= {x}))"
+        expected = rows[r] & _bsi_model(values, ">=", x)
+        got = set(ex.execute("b", pql)[0].columns().tolist())
+        assert got == expected, f"iteration {i}: {pql}"
+        vc = ex.execute("b", f"Sum(Range(v >= {x}), field=v)")[0]
+        keep = _bsi_model(values, ">=", x)
+        assert vc.count == len(keep) and \
+            vc.val == sum(values[c] for c in keep), f"iteration {i}: Sum"
