@@ -123,10 +123,13 @@ class Executor:
         # continuous batching of concurrent simple Counts into single
         # device dispatches (parallel/batcher.py); PILOSA_TPU_BATCH=0
         # falls back to one dispatch per query
-        from pilosa_tpu.parallel.batcher import CountBatcher
-        self.batcher = (CountBatcher()
-                        if os.environ.get("PILOSA_TPU_BATCH", "1") != "0"
-                        else None)
+        from pilosa_tpu.parallel.batcher import CountBatcher, PlaneSumBatcher
+        if os.environ.get("PILOSA_TPU_BATCH", "1") != "0":
+            self.batcher = CountBatcher()
+            self.sum_batcher = PlaneSumBatcher()
+        else:
+            self.batcher = None
+            self.sum_batcher = None
 
     def clear_caches(self) -> None:
         """Drop the host row cache and all HBM-resident leaves. Called on
@@ -561,11 +564,17 @@ class Executor:
         filt = self._bsi_filter(index, call, shards)
         if filt is not None:
             exists = jnp.bitwise_and(exists, filt)
-        # one dispatch + one fetch: per-plane counts with the exists count
-        # packed as the last row (bsi_ops.sum_counts)
-        packed = np.asarray(bsi_ops.sum_counts(planes, exists))  # [depth+1, S']
-        counts, n = packed[:-1], int(packed[-1].sum())
-        raw_sum = bsi_ops.counts_to_sum(counts.sum(axis=1))
+        if self.sum_batcher is not None:
+            # concurrent Sums sharing this plane slab coalesce into one
+            # vmapped dispatch (parallel/batcher.py PlaneSumBatcher)
+            totals = self.sum_batcher.plane_sums(planes, exists)  # [depth+1]
+            counts_per_plane, n = totals[:-1], int(totals[-1])
+        else:
+            # one dispatch + one fetch: per-plane counts with the exists
+            # count packed as the last row (bsi_ops.sum_counts)
+            packed = np.asarray(bsi_ops.sum_counts(planes, exists))
+            counts_per_plane, n = packed[:-1].sum(axis=1), int(packed[-1].sum())
+        raw_sum = bsi_ops.counts_to_sum(counts_per_plane)
         # add base back per counted value (val = raw + base*count)
         return ValCount(val=raw_sum + f.base * n, count=n)
 

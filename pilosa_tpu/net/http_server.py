@@ -354,6 +354,9 @@ class Handler:
             batcher = getattr(ex, "batcher", None)
             if batcher is not None:
                 snap["countBatcher"] = batcher.snapshot()
+            sum_batcher = getattr(ex, "sum_batcher", None)
+            if sum_batcher is not None:
+                snap["planeSumBatcher"] = sum_batcher.snapshot()
         return self._json(snap)
 
     def get_debug_pprof(self, params, query, body):

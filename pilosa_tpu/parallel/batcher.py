@@ -1,24 +1,26 @@
-"""Continuous batching of concurrent count queries into single dispatches.
+"""Continuous batching of concurrent device queries into single dispatches.
 
-The dominant serving workload — Count over a 1- or 2-leaf bitmap program
-(executor.go:1521 executeCount of Row/Intersect/Union/...) — dispatches one
-tiny device program per query. Each dispatch pays fixed launch overhead
-(and, over a tunneled link, a full round trip), so concurrent serving
-throughput is launch-bound long before the chip is busy.
+The dominant serving workloads — Count over a 1- or 2-leaf bitmap program
+(executor.go:1521 executeCount of Row/Intersect/Union/...) and BSI plane
+aggregations (executor.go:363 executeSum) — dispatch one tiny device
+program per query. Each dispatch pays fixed launch overhead (and, over a
+tunneled link, a full round trip), so concurrent serving throughput is
+launch-bound long before the chip is busy.
 
 This is the TPU answer to the reference's goroutine-per-shard fan-out
 (executor.go:2283): instead of more host threads, coalesce the queries
-themselves. A leader thread grabs every compatible pending query, dedups
-their HBM-resident leaves into one slab, and runs ONE `lax.scan` kernel
-computing all K counts (each step a fused gather+op+popcount straight from
-HBM — the same kernel shape as mesh.count_pair_stream), then distributes
-results. Batches form *while the previous dispatch executes* — continuous
-batching: a lone query runs immediately (zero added latency, no timers),
-and under concurrency the batch size adapts to the arrival rate.
+themselves. A leader thread grabs every compatible pending query, runs ONE
+kernel computing all K results, and distributes them. Batches form *while
+the previous dispatch executes* — continuous batching: a lone query runs
+immediately (zero added latency, no timers), and under concurrency the
+batch size adapts to the arrival rate.
 
-Batch compatibility key = (op, leaf shape, dtype): queries on different
-shard widths or different operators never mix. K and the deduped leaf
-count are padded to power-of-two buckets so the jit cache stays small.
+Leadership protocol (shared by all batchers): the first arrival for a
+compatibility key becomes leader and serves exactly ONE batch — its own
+request is the queue head — then promotes the next queued request to
+leader (or releases leadership if the queue drained). One batch per leader
+keeps tail latency fair: no thread serves strangers after its own query is
+answered. Errors wake every waiter in the failed batch.
 """
 
 from __future__ import annotations
@@ -45,6 +47,106 @@ _OPS = {
 }
 
 
+def _pow2(n: int) -> int:
+    p = 1
+    while p < n:
+        p <<= 1
+    return p
+
+
+class _Req:
+    __slots__ = ("payload", "event", "result", "exc", "promoted")
+
+    def __init__(self, payload):
+        self.payload = payload
+        self.event = threading.Event()
+        self.result = None
+        self.exc: Optional[BaseException] = None
+        self.promoted = False  # woken to take over leadership, not served
+
+
+class ContinuousBatcher:
+    """Leadership/queue machinery; subclasses implement _compute."""
+
+    def __init__(self, max_batch: int = MAX_BATCH):
+        self.max_batch = max_batch
+        self._lock = threading.Lock()
+        self._pending: dict[tuple, list[_Req]] = defaultdict(list)
+        self._leaders: set[tuple] = set()
+        # observability (surfaced via /debug/vars through executor stats)
+        self.batches = 0
+        self.batched_queries = 0
+        self.max_batch_seen = 0
+
+    def submit(self, key: tuple, payload):
+        """Enqueue one query under compatibility `key`; blocks until a
+        batch containing it executes; returns its result."""
+        req = _Req(payload)
+        with self._lock:
+            self._pending[key].append(req)
+            lead = key not in self._leaders
+            if lead:
+                self._leaders.add(key)
+        if not lead:
+            req.event.wait()
+            if not req.promoted:
+                if req.exc is not None:
+                    raise req.exc
+                return req.result
+            # promoted: the previous leader finished its batch with this
+            # request still queued — take over and serve the next batch
+            # (which contains this request)
+        self._serve_one_batch(key)
+        if req.exc is not None:
+            raise req.exc
+        return req.result
+
+    def _serve_one_batch(self, key: tuple) -> None:
+        with self._lock:
+            q = self._pending[key]
+            batch, q[:] = q[:self.max_batch], q[self.max_batch:]
+        if batch:
+            self._run(key, batch)
+        with self._lock:
+            q = self._pending[key]
+            if q:
+                q[0].promoted = True
+                q[0].event.set()  # leadership stays marked; they continue
+            else:
+                self._leaders.discard(key)
+                # drop the drained queue entry: id()-based keys (plane
+                # slabs) are unbounded over a server's life, and a retired
+                # slab's key would otherwise linger forever
+                del self._pending[key]
+
+    def _run(self, key: tuple, batch: list[_Req]) -> None:
+        try:
+            results = self._compute(key, [r.payload for r in batch])
+            with self._lock:
+                self.batches += 1
+                self.batched_queries += len(batch)
+                self.max_batch_seen = max(self.max_batch_seen, len(batch))
+            for r, res in zip(batch, results):
+                r.result = res
+                r.event.set()
+        except BaseException as e:  # noqa: BLE001 — waiters must wake
+            for r in batch:
+                r.exc = e
+                r.event.set()
+
+    def _compute(self, key: tuple, payloads: list) -> list:
+        raise NotImplementedError
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {"batches": self.batches,
+                    "batched_queries": self.batched_queries,
+                    "max_batch_seen": self.max_batch_seen}
+
+
+# ------------------------------------------------------------------ counts
+
+
 @functools.partial(jax.jit, static_argnames=("op",))
 def _batched_counts(leaves: tuple, ii: jax.Array, jj: jax.Array,
                     op: str) -> jax.Array:
@@ -66,124 +168,100 @@ def _batched_counts(leaves: tuple, ii: jax.Array, jj: jax.Array,
     return counts
 
 
-def _pow2(n: int) -> int:
-    p = 1
-    while p < n:
-        p <<= 1
-    return p
-
-
-class _Req:
-    __slots__ = ("a", "b", "event", "result", "exc", "promoted")
-
-    def __init__(self, a, b):
-        self.a = a
-        self.b = b
-        self.event = threading.Event()
-        self.result: Optional[int] = None
-        self.exc: Optional[BaseException] = None
-        self.promoted = False  # woken to take over leadership, not served
-
-
-class CountBatcher:
-    """Thread-safe continuous batcher. One instance per DeviceRunner."""
-
-    def __init__(self, max_batch: int = MAX_BATCH):
-        self.max_batch = max_batch
-        self._lock = threading.Lock()
-        self._pending: dict[tuple, list[_Req]] = defaultdict(list)
-        self._leaders: set[tuple] = set()
-        # observability (surfaced via /debug/vars through executor stats)
-        self.batches = 0
-        self.batched_queries = 0
-        self.max_batch_seen = 0
+class CountBatcher(ContinuousBatcher):
+    """Batches Count over 1-/2-leaf bitmap programs. Compatibility key =
+    (op, leaf shape, dtype); K and the deduped leaf count pad to pow2
+    buckets so the jit cache stays small."""
 
     def count(self, op: str, a: jax.Array, b: Optional[jax.Array]) -> int:
-        """Count of op(a, b) — blocks until a batch containing this query
-        executes. `b=None` counts a single leaf (op "id")."""
         if b is None:
             op, b = "id", a
-        req = _Req(a, b)
-        key = (op, tuple(a.shape), str(a.dtype))
-        with self._lock:
-            self._pending[key].append(req)
-            lead = key not in self._leaders
-            if lead:
-                self._leaders.add(key)
-        if not lead:
-            req.event.wait()
-            if not req.promoted:
-                if req.exc is not None:
-                    raise req.exc
-                return req.result
-            # promoted: the previous leader finished its batch with this
-            # request still queued — take over and serve the next batch
-            # (which contains this request)
-        self._serve_one_batch(key)
-        if req.exc is not None:
-            raise req.exc
-        return req.result
+        return self.submit((op, tuple(a.shape), str(a.dtype)), (a, b))
 
-    def _serve_one_batch(self, key: tuple) -> None:
-        """Leader duty: run ONE batch (the caller's request is at the queue
-        head — it was enqueued before election/promotion), then either hand
-        leadership to the next queued request or release it. One batch per
-        leader keeps latency fair under sustained load: no thread serves
-        strangers after its own query is answered."""
-        with self._lock:
-            q = self._pending[key]
-            batch, q[:] = q[:self.max_batch], q[self.max_batch:]
-        if batch:
-            self._run(key[0], batch)
-        with self._lock:
-            q = self._pending[key]
-            if q:
-                q[0].promoted = True
-                q[0].event.set()  # leadership stays marked; they continue
-            else:
-                self._leaders.discard(key)
+    def _compute(self, key: tuple, payloads: list) -> list:
+        op = key[0]
+        slots: dict[int, int] = {}
+        leaves: list = []
 
-    def _run(self, op: str, batch: list[_Req]) -> None:
-        try:
-            slots: dict[int, int] = {}
-            leaves: list = []
+        def slot(arr) -> int:
+            s = slots.get(id(arr))
+            if s is None:
+                s = len(leaves)
+                slots[id(arr)] = s
+                leaves.append(arr)
+            return s
 
-            def slot(arr) -> int:
-                s = slots.get(id(arr))
-                if s is None:
-                    s = len(leaves)
-                    slots[id(arr)] = s
-                    leaves.append(arr)
-                return s
+        ii = np.array([slot(a) for a, _ in payloads], dtype=np.int32)
+        jj = np.array([slot(b) for _, b in payloads], dtype=np.int32)
+        # pow2 buckets bound the jit cache: pad queries by repeating
+        # query 0 (dropped on unpack) and leaves by repeating leaf 0
+        # (never indexed by real queries)
+        k = len(payloads)
+        kp = _pow2(k)
+        if kp > k:
+            ii = np.concatenate([ii, np.zeros(kp - k, np.int32)])
+            jj = np.concatenate([jj, np.zeros(kp - k, np.int32)])
+        lp = _pow2(len(leaves))
+        leaves = leaves + [leaves[0]] * (lp - len(leaves))
+        counts = np.asarray(_batched_counts(tuple(leaves), ii, jj, op))
+        return [int(c) for c in counts[:k]]
 
-            ii = np.array([slot(r.a) for r in batch], dtype=np.int32)
-            jj = np.array([slot(r.b) for r in batch], dtype=np.int32)
-            # pow2 buckets bound the jit cache: pad queries by repeating
-            # query 0 (dropped on unpack) and leaves by repeating leaf 0
-            # (never indexed by real queries)
-            k = len(batch)
-            kp = _pow2(k)
-            if kp > k:
-                ii = np.concatenate([ii, np.zeros(kp - k, np.int32)])
-                jj = np.concatenate([jj, np.zeros(kp - k, np.int32)])
-            lp = _pow2(len(leaves))
-            leaves = leaves + [leaves[0]] * (lp - len(leaves))
-            counts = np.asarray(
-                _batched_counts(tuple(leaves), ii, jj, op))
-            with self._lock:
-                self.batches += 1
-                self.batched_queries += k
-                self.max_batch_seen = max(self.max_batch_seen, k)
-            for r, c in zip(batch, counts[:k]):
-                r.result = int(c)
-                r.event.set()
-        except BaseException as e:  # noqa: BLE001 — waiters must wake
-            for r in batch:
-                r.exc = e
-                r.event.set()
 
-    def snapshot(self) -> dict:
-        with self._lock:
-            return {"batches": self.batches,
-                    "batched_queries": self.batched_queries,
-                    "max_batch_seen": self.max_batch_seen}
+# -------------------------------------------------------------- BSI sums
+
+
+# shard chunk for the device-side partial reduction: each chunk's total is
+# < 2047 shards x 2^20 bits < 2^31, so int32 partials cannot wrap; the host
+# finishes the reduction in int64 (the exactness invariant of the BSI
+# protocol — see ops/bsi.py "Numeric protocol")
+_SUM_SHARD_CHUNK = 2016
+
+
+@jax.jit
+def _batched_plane_sums(planes: jax.Array, masks: tuple) -> jax.Array:
+    """Per-query per-plane filtered popcounts with the mask's own count
+    appended -> int32[K, depth + 1, C] shard-chunk partials (one dispatch,
+    one small fetch for the whole batch; C = ceil(S' / 2016) is 1 for any
+    realistic residency)."""
+    ex = jnp.stack(masks)  # [K, S', W]
+    pc = popcount(jnp.bitwise_and(planes[None], ex[:, None]))  # [K, D, S']
+    n = popcount(ex)  # [K, S']
+    both = jnp.concatenate([pc, n[:, None]], axis=1)  # [K, D+1, S']
+    k, d1, s = both.shape
+    pad = (-s) % _SUM_SHARD_CHUNK
+    if pad:
+        both = jnp.pad(both, ((0, 0), (0, 0), (0, pad)))
+    return both.reshape(k, d1, -1, _SUM_SHARD_CHUNK).sum(axis=-1)
+
+
+class PlaneSumBatcher(ContinuousBatcher):
+    """Batches BSI Sum aggregations that share a plane slab (same field +
+    shard set): concurrent dashboards issuing Sum(Range(v > x)) with
+    varying thresholds coalesce into one vmapped dispatch. Compatibility
+    key = identity of the residency-cached plane slab."""
+
+    def plane_sums(self, planes: jax.Array, mask: jax.Array) -> np.ndarray:
+        """[depth + 1] int64 totals for popcount(planes & mask) + count."""
+        return self.submit((id(planes), tuple(planes.shape)),
+                           (planes, mask))
+
+    def _compute(self, key: tuple, payloads: list) -> list:
+        planes = payloads[0][0]
+        # dedup identical mask objects (concurrent unfiltered Sums all
+        # pass the same residency-cached exists array)
+        slots: dict[int, int] = {}
+        masks: list = []
+        idx = []
+        for _, m in payloads:
+            s = slots.get(id(m))
+            if s is None:
+                s = len(masks)
+                slots[id(m)] = s
+                masks.append(m)
+            idx.append(s)
+        kp = _pow2(len(masks))
+        masks = masks + [masks[0]] * (kp - len(masks))
+        out = np.asarray(_batched_plane_sums(planes, tuple(masks)))
+        # finish the shard-chunk reduction in int64 (exact)
+        totals = out.astype(np.int64).sum(axis=-1)  # [kp, depth+1]
+        return [totals[i] for i in idx]

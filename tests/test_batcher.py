@@ -170,6 +170,80 @@ def test_executor_count_uses_batcher(tmp_path):
     holder.close()
 
 
+def test_plane_sum_batcher_concurrent():
+    """Concurrent Sums sharing a plane slab coalesce; per-query totals
+    match serial sum_counts exactly."""
+    import jax
+
+    from pilosa_tpu.parallel.batcher import PlaneSumBatcher
+
+    rng = np.random.default_rng(31)
+    depth, s, w = 5, 4, 256
+    planes = jax.device_put(
+        rng.integers(0, 2**32, size=(depth, s, w), dtype=np.uint32))
+    masks = [jax.device_put(
+        rng.integers(0, 2**32, size=(s, w), dtype=np.uint32))
+        for _ in range(6)]
+    b = PlaneSumBatcher()
+
+    def expect(mask):
+        p, m = np.asarray(planes), np.asarray(mask)
+        per_plane = [int(np.bitwise_count(p[i] & m).sum())
+                     for i in range(depth)]
+        return per_plane + [int(np.bitwise_count(m).sum())]
+
+    results = {}
+    start = threading.Barrier(24)  # force overlap: coalescing must happen
+
+    def worker(i):
+        start.wait()
+        results[i] = b.plane_sums(planes, masks[i % 6])
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(24)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    for i, got in results.items():
+        assert got.tolist() == expect(masks[i % 6]), i
+    snap = b.snapshot()
+    assert snap["batched_queries"] == 24
+    assert snap["batches"] < 24  # coalescing happened
+
+
+def test_executor_concurrent_sums_batch(tmp_path):
+    from pilosa_tpu.executor import Executor
+    from pilosa_tpu.models import FieldOptions, FieldType, Holder
+
+    holder = Holder(str(tmp_path)).open()
+    ex = Executor(holder)
+    idx = holder.create_index("sb", track_existence=False)
+    v = idx.create_field("v", FieldOptions(type=FieldType.INT,
+                                           min=0, max=255))
+    rng = np.random.default_rng(7)
+    cols = np.arange(5000, dtype=np.uint64)
+    vals = rng.integers(0, 256, size=5000, dtype=np.int64)
+    v.import_values(cols, vals)
+    thresholds = [32 * i for i in range(8)]
+    expected = {t: (int(vals[vals > t].sum()), int((vals > t).sum()))
+                for t in thresholds}
+    ex.execute("sb", "Sum(Range(v > 0), field=v)")  # warm residency
+    results = {}
+    threads = [threading.Thread(
+        target=lambda t=t: results.__setitem__(
+            t, ex.execute("sb", f"Sum(Range(v > {t}), field=v)")[0]))
+        for t in thresholds for _ in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    for t, vc in results.items():
+        assert (vc.val, vc.count) == expected[t], t
+    assert ex.sum_batcher.snapshot()["batched_queries"] >= 8
+    holder.close()
+
+
 def test_executor_batcher_disabled(tmp_path, monkeypatch):
     monkeypatch.setenv("PILOSA_TPU_BATCH", "0")
     from pilosa_tpu.executor import Executor
