@@ -906,3 +906,27 @@ def test_cluster_options_exclude_flags(cluster3):
     _, out = jpost(cluster3[2].uri, "/index/i/query",
                    raw=b"Options(Row(f=1), excludeRowAttrs=true)")
     assert out["results"][0] == {"columns": [5], "attrs": {}}
+
+
+def test_debug_vars_surfaces_engine_stats(server):
+    """/debug/vars carries residency, TopN, and batcher observability
+    (stats/stats.go Expvar analog, http/handler.go:243)."""
+    jpost(server.uri, "/index/dv", {})
+    jpost(server.uri, "/index/dv/field/f", {})
+    jpost(server.uri, "/index/dv/field/v",
+          {"options": {"type": "int", "min": 0, "max": 100}})
+    jpost(server.uri, "/index/dv/query", raw=b"Set(1, f=0)")
+    jpost(server.uri, "/index/dv/query", raw=b"Set(2, f=1)")
+    jpost(server.uri, "/index/dv/query", raw=b"Set(1, v=7)")
+    _, out = jpost(server.uri, "/index/dv/query",
+                   raw=b"Count(Intersect(Row(f=0), Row(f=1)))")
+    assert out["results"] == [0]
+    _, out = jpost(server.uri, "/index/dv/query", raw=b"Sum(field=v)")
+    assert out["results"][0] == {"value": 7, "count": 1}
+    status, body = http("GET", server.uri, "/debug/vars")
+    assert status == 200
+    d = json.loads(body)
+    assert d["deviceResidency"]["entries"] > 0
+    assert d["countBatcher"]["batched_queries"] >= 1
+    assert d["planeSumBatcher"]["batched_queries"] >= 1
+    assert "topnRecountRows" in d
