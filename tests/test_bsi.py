@@ -104,3 +104,33 @@ def test_value_bits_roundtrip():
         assert bsi.bits_to_value(bsi.value_to_bits(v, 48)) == v
     with pytest.raises(ValueError):
         bsi.value_to_bits(-1, 8)
+
+
+def test_plane_slab_residency_reuse(tmp_path):
+    """The stacked [depth, S', W] plane slab is residency-cached by plane
+    generations: repeat aggregations must not re-miss, and a write must
+    invalidate (new key -> one new miss)."""
+    import numpy as np
+
+    from pilosa_tpu.executor import Executor
+    from pilosa_tpu.models import FieldOptions, FieldType, Holder
+
+    h = Holder(str(tmp_path)).open()
+    ex = Executor(h)
+    idx = h.create_index("ps", track_existence=False)
+    v = idx.create_field("v", FieldOptions(type=FieldType.INT, min=0, max=63))
+    v.import_values(np.arange(100, dtype=np.uint64),
+                    np.arange(100, dtype=np.int64) % 64)
+    ex.execute("ps", "Sum(field=v)")
+    misses0 = ex.residency.misses
+    for _ in range(3):
+        ex.execute("ps", "Sum(field=v)")
+        ex.execute("ps", "Min(field=v)")
+    assert ex.residency.misses == misses0  # warm: no new uploads or stacks
+    (vc,) = ex.execute("ps", "Sum(field=v)")
+    assert vc.count == 100
+    ex.execute("ps", "Set(7, v=5)")  # mutation bumps plane generations
+    (vc2,) = ex.execute("ps", "Sum(field=v)")
+    assert vc2.val == vc.val - (7 % 64) + 5
+    assert ex.residency.misses > misses0  # slab re-keyed and rebuilt
+    h.close()
