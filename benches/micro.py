@@ -109,11 +109,35 @@ def bench_fragment(quick):
         frag.close()
 
 
+def bench_container_stores(quick):
+    """dict vs B+Tree container stores (storage/containers.py — the
+    sliceContainers vs enterprise/b comparison): point ops and the ordered
+    walks the ordered store exists for."""
+    rng = np.random.default_rng(5)
+    size = 100_000 if quick else 1_000_000
+    # sparse high-48-bit key space: the memory-lean-sparse-fragment shape
+    vals = np.unique(
+        rng.integers(0, 1 << 40, size).astype(np.uint64) << np.uint64(16))
+    for store in ("dict", "btree"):
+        b = Bitmap(store=store)
+        t0 = time.perf_counter()
+        b.add_many(vals)
+        emit(f"store_{store}_build", time.perf_counter() - t0,
+             unit="keys/s", scale=len(b.containers))
+        lo = int(vals[vals.size // 4])
+        hi = int(vals[3 * vals.size // 4])
+        dt = timeit(lambda: b._keys_in(lo, hi))
+        emit(f"store_{store}_range_keys", dt, unit="walks/s", scale=1)
+        dt = timeit(lambda: (b.min(), b.max()))
+        emit(f"store_{store}_min_max", dt, unit="calls/s", scale=2)
+
+
 def main():
     quick = "--quick" in sys.argv
     bench_container_ops(quick)
     bench_bitmap(quick)
     bench_fragment(quick)
+    bench_container_stores(quick)
 
 
 if __name__ == "__main__":
