@@ -193,3 +193,35 @@ def test_http_server_concurrent_clients(tmp_path):
         assert out["results"] == [120]
     finally:
         srv.close()
+
+
+def test_executor_sums_vs_value_writes(tmp_path):
+    """Batched BSI Sums racing SetValue writes on fresh columns: sums are
+    append-only so both val and count must be monotone, and the plane-slab
+    residency cache must never serve a torn slab."""
+    from pilosa_tpu.models import FieldOptions, FieldType
+
+    holder = Holder(str(tmp_path / "d")).open()
+    ex = Executor(holder)
+    idx = holder.create_index("sv", track_existence=False)
+    idx.create_field("v", FieldOptions(type=FieldType.INT, min=0, max=15))
+    ex.execute("sv", "Set(0, v=3)")
+
+    def writer():
+        for k in range(N_WRITER_OPS):
+            ex.execute("sv", f"Set({k + 1}, v={(k % 15) + 1})")
+
+    def sum_reader():
+        last_val = last_n = 0
+        for _ in range(N_READER_OPS):
+            (vc,) = ex.execute("sv", "Sum(field=v)")
+            assert vc.val >= last_val and vc.count >= last_n, \
+                (vc, last_val, last_n)
+            last_val, last_n = vc.val, vc.count
+
+    run_threads(writer, sum_reader, sum_reader, sum_reader)
+    (vc,) = ex.execute("sv", "Sum(field=v)")
+    assert vc.count == N_WRITER_OPS + 1
+    assert vc.val == 3 + sum((k % 15) + 1 for k in range(N_WRITER_OPS))
+    assert ex.sum_batcher.snapshot()["batched_queries"] >= 3
+    holder.close()
