@@ -294,7 +294,10 @@ def bench_executor(ex, row_bits) -> dict:
         lambda tid, i: ex.execute("b", qs[(tid * 7 + i) % len(qs)]))
 
     # CPU baseline: the same dense AND+popcount work in numpy (per query:
-    # two [S, W] operands), scaled from a slice
+    # two [S, W] operands), scaled from a slice. Measured BOTH single-core
+    # and under the same client concurrency (numpy ufuncs release the GIL,
+    # so this is the all-cores Go-server analog); the stronger one is the
+    # baseline.
     small = min(16, EXEC_SHARDS)
     rng = np.random.default_rng(5)
     a = rng.integers(0, 2**32, size=(small, WORDS_PER_SHARD), dtype=np.uint32)
@@ -304,20 +307,27 @@ def bench_executor(ex, row_bits) -> dict:
     for _ in range(5):
         np.bitwise_count(a & b).sum()
     cpu_s = (time.perf_counter() - t0) / 5 * (EXEC_SHARDS / small)
+    cpu_conc_s = _concurrent_seconds_per_query(
+        EXEC_THREADS, 3,
+        lambda tid, i: np.bitwise_count(a & b).sum(),
+    ) * (EXEC_SHARDS / small)
+    cpu_best_s = min(cpu_s, cpu_conc_s)
 
     return {
         "metric": METRIC,
         "value": round(1.0 / tpu_s, 2),
         "unit": "queries/s/chip",
-        "vs_baseline": round(cpu_s / tpu_s, 2),
+        "vs_baseline": round(cpu_best_s / tpu_s, 2),
         "tpu_ms_per_query": round(tpu_s * 1e3, 4),
         "single_stream_ms_per_query": round(single_s * 1e3, 4),
         "concurrency": EXEC_THREADS,
         "cpu_numpy_ms_per_query": round(cpu_s * 1e3, 4),
+        "cpu_numpy_concurrent_ms_per_query": round(cpu_conc_s * 1e3, 4),
         "columns_per_operand": EXEC_SHARDS * SHARD_WIDTH,
         "path": "Executor.execute (parse+compile+residency+device+merge), "
-                f"{EXEC_THREADS} concurrent clients; baseline is "
-                "single-core numpy on the same dense work",
+                f"{EXEC_THREADS} concurrent clients; baseline is the "
+                "BEST of single-core and same-concurrency numpy on the "
+                "same dense work",
     }
 
 
