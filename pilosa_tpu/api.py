@@ -230,17 +230,17 @@ class API:
         if isinstance(result, ValCount):
             return result.to_json_dict()
         if isinstance(result, Pairs):
-            if result.keys is not None:
+            if result.row_keys is not None:
                 # keyed field: Pair.Key replaces the id (cache.go:317-321,
                 # key has json omitempty but id is always present in the Go
                 # struct; the reference emits id=0 alongside key)
                 return [{"id": int(i), "key": k, "count": c}
-                        for (i, c), k in zip(result, result.keys)]
+                        for (i, c), k in zip(result, result.row_keys)]
             return [{"id": i, "count": c} for i, c in result]
         if isinstance(result, RowIdentifiers):
-            if result.keys is not None:
+            if result.row_keys is not None:
                 # keyed: Rows is nil in the reference (executor.go:2570)
-                return {"rows": None, "keys": list(result.keys)}
+                return {"rows": None, "keys": list(result.row_keys)}
             return {"rows": list(result)}
         if isinstance(result, GroupCounts):
             return list(result)

@@ -78,10 +78,10 @@ def _encode_result(result) -> pb.QueryResult:
         r.Row.Attrs.extend(_encode_attrs(result.attrs))
     elif isinstance(result, Pairs):
         r.Type = RESULT_PAIRS
-        if result.keys is not None:
+        if result.row_keys is not None:
             r.Pairs.extend(
                 pb.Pair(ID=int(i), Key=k, Count=int(c))
-                for (i, c), k in zip(result, result.keys))
+                for (i, c), k in zip(result, result.row_keys))
         else:
             r.Pairs.extend(pb.Pair(ID=int(i), Count=int(c)) for i, c in result)
     elif isinstance(result, ValCount):
@@ -90,8 +90,8 @@ def _encode_result(result) -> pb.QueryResult:
         r.ValCount.Count = int(result.count)
     elif isinstance(result, RowIdentifiers):
         r.Type = RESULT_ROWIDENTIFIERS
-        if result.keys is not None:
-            r.RowIdentifiers.Keys.extend(result.keys)
+        if result.row_keys is not None:
+            r.RowIdentifiers.Keys.extend(result.row_keys)
         else:
             r.RowIdentifiers.Rows.extend(int(x) for x in result)
     elif isinstance(result, GroupCounts):
@@ -127,7 +127,7 @@ def decode_result(r: pb.QueryResult):
     if r.Type == RESULT_PAIRS:
         pairs = Pairs((p.ID, p.Count) for p in r.Pairs)
         if any(p.Key for p in r.Pairs):
-            pairs.keys = [p.Key for p in r.Pairs]
+            pairs.row_keys = [p.Key for p in r.Pairs]
         return pairs
     if r.Type == RESULT_VALCOUNT:
         return ValCount(r.ValCount.Val, r.ValCount.Count)
@@ -138,7 +138,7 @@ def decode_result(r: pb.QueryResult):
     if r.Type == RESULT_ROWIDENTIFIERS:
         if r.RowIdentifiers.Keys:
             out = RowIdentifiers()
-            out.keys = list(r.RowIdentifiers.Keys)
+            out.row_keys = list(r.RowIdentifiers.Keys)
             return out
         return RowIdentifiers(r.RowIdentifiers.Rows)
     if r.Type == RESULT_GROUPCOUNTS:

@@ -55,18 +55,20 @@ class ExecutionError(ValueError):
 
 class Pairs(list):
     """TopN result: [(row_id, count)] (reference Pairs, cache.go:317).
-    `keys` holds the translated row keys, index-aligned with the pairs,
-    when the field is keyed (Pair.Key, cache.go:319)."""
+    `row_keys` holds the translated row keys, index-aligned with the
+    pairs, when the field is keyed (Pair.Key, cache.go:319). NOT named
+    `keys`: a `keys` attribute makes dict() treat the list as a mapping
+    and call it (the mapping protocol) — dict(pairs) must keep working."""
 
-    keys: Optional[list] = None
+    row_keys: Optional[list] = None
 
 
 class RowIdentifiers(list):
     """Rows result: sorted row ids (reference RowIdentifiers,
-    executor.go:858-861). `keys` holds translated row keys on keyed
-    fields (RowIdentifiers.Keys)."""
+    executor.go:858-861). `row_keys` holds translated row keys on keyed
+    fields (RowIdentifiers.Keys); see Pairs for why it isn't `keys`."""
 
-    keys: Optional[list] = None
+    row_keys: Optional[list] = None
 
 
 class GroupCounts(list):
@@ -115,6 +117,10 @@ class Executor:
         # rows materialized for TopN recounts — observability for the
         # threshold-pruning walk (tests assert ≪ total rows; /debug/vars)
         self.topn_recount_rows = 0
+        # (index, field, shards) -> (cache versions, merged ids, counts):
+        # the cross-shard TopN candidate merge memo (see
+        # _topn_candidate_arrays)
+        self._topn_merge_memo: dict[tuple, tuple] = {}
         # HBM residency manager: query leaves cached as device arrays keyed
         # by content generation; repeat queries run without host->HBM
         # transfers (parallel/residency.py)
@@ -704,6 +710,8 @@ class Executor:
         """Merged (ids, cached_counts) int64 arrays from per-shard rank
         caches, count-desc — all-numpy (memoized per-cache rank order +
         vectorized reduce; the pure-Python tuple walk dominated TopN p50).
+        The cross-shard MERGE is additionally memoized on the per-cache
+        versions, so a repeat TopN over unchanged caches is a dict hit.
         A ranked field's missing/empty cache is rebuilt in place
         (guaranteed-present); a cache-less field yields NO candidates,
         matching the reference's nopCache (cache.go:461-481) — the round-1
@@ -714,6 +722,7 @@ class Executor:
         if view is None:
             return np.empty(0, np.int64), np.empty(0, np.int64)
         per_shard = []
+        versions = []
         for s in shards:
             cache = view.rank_caches.get(s)
             if (cache is None or not len(cache)) and view.track_rank:
@@ -722,8 +731,20 @@ class Executor:
                     view.refresh_rank_cache(s)
                     cache = view.rank_caches.get(s)
             if cache is not None and len(cache):
+                # version read BEFORE top_arrays(): a racing write makes
+                # the tag stale, never the data sticky (cache.py pattern)
+                versions.append((s, cache._version))
                 per_shard.append(cache.top_arrays())
-        return merge_pair_arrays(per_shard)
+        key = (index.name, f.name, tuple(shards))
+        memo = self._topn_merge_memo.get(key)
+        vt = tuple(versions)
+        if memo is not None and memo[0] == vt:
+            return memo[1], memo[2]
+        ids, counts = merge_pair_arrays(per_shard)
+        if len(self._topn_merge_memo) > 256:  # ad-hoc shard subsets bound
+            self._topn_merge_memo.clear()
+        self._topn_merge_memo[key] = (vt, ids, counts)
+        return ids, counts
 
     def _topn_src_walk(self, index: Index, f, shards,
                        pairs: list[tuple[int, int]], src_dense, n,
@@ -1016,12 +1037,12 @@ class Executor:
             fname = call.args.get("_field")
             f = index.field(fname) if fname else None
             if f is not None and f.options.keys:
-                result.keys = [row_key(fname, rid) for rid, _ in result]
+                result.row_keys = [row_key(fname, rid) for rid, _ in result]
         elif isinstance(result, RowIdentifiers):
             fname = call.args.get("_field") or call.args.get("field")
             f = index.field(fname) if fname else None
             if f is not None and f.options.keys:
-                result.keys = [row_key(fname, rid) for rid in result]
+                result.row_keys = [row_key(fname, rid) for rid in result]
         elif isinstance(result, GroupCounts):
             for gc in result:
                 for fr in gc["group"]:

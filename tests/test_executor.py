@@ -440,3 +440,18 @@ def test_residency_delete_recreate_invalidates(tmp_path):
     row = api.query_results("i", "Row(f=1)")[0]
     assert row.columns().tolist() == [9]
     h.close()
+
+
+def test_topn_result_is_dictable():
+    """Pairs/RowIdentifiers must behave as plain lists: a `keys` attribute
+    would make dict() take the mapping branch and call it (regression: the
+    key-translation attribute was named `keys` and dict(pairs) raised
+    \"'NoneType' object is not callable\")."""
+    from pilosa_tpu.executor import Pairs, RowIdentifiers
+
+    p = Pairs([(1, 10), (2, 5)])
+    assert dict(p) == {1: 10, 2: 5}
+    p.row_keys = ["a", "b"]
+    assert dict(p) == {1: 10, 2: 5}  # still a list, even when keyed
+    r = RowIdentifiers([3, 1])
+    assert list(r) == [3, 1] and not hasattr(r, "keys")

@@ -59,17 +59,17 @@ def test_keyed_result_roundtrip(ser):
     """Pair.Key / RowIdentifiers.Keys / FieldRow.RowKey survive the wire
     (internal/public.proto Pair; executor.go:2497-2590 translateResult)."""
     pairs = Pairs([(10, 100), (20, 50)])
-    pairs.keys = ["hot", "cold"]
+    pairs.row_keys = ["hot", "cold"]
     rows = RowIdentifiers()
-    rows.keys = ["a", "b"]
+    rows.row_keys = ["a", "b"]
     gcs = GroupCounts([
         {"group": [{"field": "f", "rowKey": "hot"},
                    {"field": "g", "rowID": 2}], "count": 9}])
     data = ser.encode_query_response([pairs, rows, gcs])
     dec = ser.decode_query_response(data)["results"]
     assert dec[0] == [(10, 100), (20, 50)]
-    assert dec[0].keys == ["hot", "cold"]
-    assert dec[1] == [] and dec[1].keys == ["a", "b"]
+    assert dec[0].row_keys == ["hot", "cold"]
+    assert dec[1] == [] and dec[1].row_keys == ["a", "b"]
     assert dec[2] == [{"group": [{"field": "f", "rowKey": "hot"},
                                  {"field": "g", "rowID": 2}], "count": 9}]
 
