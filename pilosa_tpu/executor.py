@@ -129,13 +129,19 @@ class Executor:
         # continuous batching of concurrent simple Counts into single
         # device dispatches (parallel/batcher.py); PILOSA_TPU_BATCH=0
         # falls back to one dispatch per query
-        from pilosa_tpu.parallel.batcher import CountBatcher, PlaneSumBatcher
+        from pilosa_tpu.parallel.batcher import (
+            CountBatcher,
+            MinMaxBatcher,
+            PlaneSumBatcher,
+        )
         if os.environ.get("PILOSA_TPU_BATCH", "1") != "0":
             self.batcher = CountBatcher()
             self.sum_batcher = PlaneSumBatcher()
+            self.minmax_batcher = MinMaxBatcher()
         else:
             self.batcher = None
             self.sum_batcher = None
+            self.minmax_batcher = None
 
     def clear_caches(self) -> None:
         """Drop the host row cache and all HBM-resident leaves. Called on
@@ -601,8 +607,13 @@ class Executor:
         filt = self._bsi_filter(index, call, shards)
         if filt is not None:
             exists = jnp.bitwise_and(exists, filt)
-        fn = bsi_ops.bsi_min_packed if is_min else bsi_ops.bsi_max_packed
-        packed = np.asarray(fn(planes, exists))  # [depth+1, S'] one fetch
+        if self.minmax_batcher is not None:
+            # concurrent Min/Max descents sharing this slab coalesce into
+            # one vmapped dispatch (parallel/batcher.py MinMaxBatcher)
+            packed = self.minmax_batcher.packed(planes, exists, is_min)
+        else:
+            fn = bsi_ops.bsi_min_packed if is_min else bsi_ops.bsi_max_packed
+            packed = np.asarray(fn(planes, exists))  # [depth+1, S'] 1 fetch
         bits, cnt = packed[:-1], packed[-1]
         best_val, best_cnt = None, 0
         for i in range(len(shards)):

@@ -234,6 +234,47 @@ def _batched_plane_sums(planes: jax.Array, masks: tuple) -> jax.Array:
     return both.reshape(k, d1, -1, _SUM_SHARD_CHUNK).sum(axis=-1)
 
 
+@functools.partial(jax.jit, static_argnames=("is_min",))
+def _batched_min_max(planes: jax.Array, masks: tuple,
+                     is_min: bool) -> jax.Array:
+    """vmapped packed greedy bit descent: int32[K, depth + 1, S'] (bits
+    rows 0..depth-1, attaining-count row depth; per-shard, the host picks
+    the cross-shard winner exactly as the single-query path does)."""
+    from pilosa_tpu.ops.bsi import bsi_max_packed, bsi_min_packed
+
+    fn = bsi_min_packed if is_min else bsi_max_packed
+    return jax.vmap(lambda m: fn(planes, m))(jnp.stack(masks))
+
+
+class MinMaxBatcher(ContinuousBatcher):
+    """Batches BSI Min/Max descents sharing a plane slab. Compatibility
+    key = (slab identity, is_min)."""
+
+    def packed(self, planes: jax.Array, mask: jax.Array,
+               is_min: bool) -> np.ndarray:
+        """[depth + 1, S'] int64 packed bits + count for one query."""
+        return self.submit((id(planes), tuple(planes.shape), is_min),
+                           (planes, mask))
+
+    def _compute(self, key: tuple, payloads: list) -> list:
+        planes, is_min = payloads[0][0], key[2]
+        slots: dict[int, int] = {}
+        masks: list = []
+        idx = []
+        for _, m in payloads:
+            s = slots.get(id(m))
+            if s is None:
+                s = len(masks)
+                slots[id(m)] = s
+                masks.append(m)
+            idx.append(s)
+        kp = _pow2(len(masks))
+        masks = masks + [masks[0]] * (kp - len(masks))
+        out = np.asarray(_batched_min_max(planes, tuple(masks), is_min))
+        out = out.astype(np.int64)
+        return [out[i] for i in idx]
+
+
 class PlaneSumBatcher(ContinuousBatcher):
     """Batches BSI Sum aggregations that share a plane slab (same field +
     shard set): concurrent dashboards issuing Sum(Range(v > x)) with

@@ -258,3 +258,41 @@ def test_executor_batcher_disabled(tmp_path, monkeypatch):
     (c,) = ex.execute("bt2", "Count(Intersect(Row(f=0), Row(f=1)))")
     assert c == 1
     holder.close()
+
+
+def test_executor_concurrent_min_max_batch(tmp_path):
+    from pilosa_tpu.executor import Executor
+    from pilosa_tpu.models import FieldOptions, FieldType, Holder
+
+    holder = Holder(str(tmp_path)).open()
+    ex = Executor(holder)
+    idx = holder.create_index("mm", track_existence=False)
+    v = idx.create_field("v", FieldOptions(type=FieldType.INT,
+                                           min=-20, max=500))
+    rng = np.random.default_rng(9)
+    n = 4000
+    vals = rng.integers(-20, 501, size=n, dtype=np.int64)
+    v.import_values(np.arange(n, dtype=np.uint64), vals)
+    ex.execute("mm", "Min(field=v)")  # warm residency
+    results = {}
+    start = threading.Barrier(12)
+
+    def worker(i):
+        start.wait()
+        q = "Min(field=v)" if i % 2 == 0 else "Max(field=v)"
+        results[i] = ex.execute("mm", q)[0]
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(12)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    mn, mx = int(vals.min()), int(vals.max())
+    for i, vc in results.items():
+        if i % 2 == 0:
+            assert vc.val == mn and vc.count == int((vals == mn).sum()), vc
+        else:
+            assert vc.val == mx and vc.count == int((vals == mx).sum()), vc
+    snap = ex.minmax_batcher.snapshot()
+    assert snap["batched_queries"] == 13  # 12 concurrent + the warm-up Min
+    holder.close()
