@@ -357,6 +357,9 @@ class Handler:
             sum_batcher = getattr(ex, "sum_batcher", None)
             if sum_batcher is not None:
                 snap["planeSumBatcher"] = sum_batcher.snapshot()
+            mm = getattr(ex, "minmax_batcher", None)
+            if mm is not None:
+                snap["minMaxBatcher"] = mm.snapshot()
         return self._json(snap)
 
     def get_debug_pprof(self, params, query, body):
