@@ -455,3 +455,36 @@ def test_topn_result_is_dictable():
     assert dict(p) == {1: 10, 2: 5}  # still a list, even when keyed
     r = RowIdentifiers([3, 1])
     assert list(r) == [3, 1] and not hasattr(r, "keys")
+
+
+def test_groupby_rows_paging_and_limit(executor_world=None, tmp_path=None):
+    """GroupBy children accept the Rows paging args (previous/limit) —
+    the reference's GroupBy paging shape (executor.go:897-1090)."""
+    import tempfile
+
+    from pilosa_tpu.executor import Executor
+    from pilosa_tpu.models import Holder
+
+    tmp = tempfile.mkdtemp()
+    h = Holder(tmp).open()
+    ex = Executor(h)
+    idx = h.create_index("gp", track_existence=False)
+    g1 = idx.create_field("g1")
+    g2 = idx.create_field("g2")
+    # rows 0..4 in g1, rows 0..1 in g2; all share columns 0..9
+    cols = list(range(10))
+    for r in range(5):
+        g1.import_bits([r] * 10, cols)
+    for r in range(2):
+        g2.import_bits([r] * 10, cols)
+    (all_groups,) = ex.execute("gp", "GroupBy(Rows(field=g1), Rows(field=g2))")
+    assert len(all_groups) == 10  # 5 x 2
+    (paged,) = ex.execute(
+        "gp", "GroupBy(Rows(field=g1, previous=2), Rows(field=g2))")
+    assert [g["group"][0]["rowID"] for g in paged] == [3, 3, 4, 4]
+    (limited,) = ex.execute(
+        "gp", "GroupBy(Rows(field=g1, limit=2), Rows(field=g2), limit=3)")
+    assert len(limited) == 3
+    assert all(g["group"][0]["rowID"] <= 1 for g in limited)
+    assert all(g["count"] == 10 for g in all_groups)
+    h.close()
