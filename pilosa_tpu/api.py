@@ -333,7 +333,8 @@ class API:
     def import_bits(self, index_name: str, field_name: str,
                     row_ids=None, column_ids=None,
                     row_keys=None, column_keys=None,
-                    timestamps=None, remote: bool = False) -> None:
+                    timestamps=None, remote: bool = False,
+                    clear: bool = False) -> None:
         self._validate("write")
         index = self.holder.index(index_name)
         if index is None:
@@ -357,7 +358,8 @@ class API:
                 for t in timestamps]
         if not remote:
             row_ids, column_ids, timestamps = self._route_import(
-                index_name, field_name, row_ids, column_ids, timestamps)
+                index_name, field_name, row_ids, column_ids, timestamps,
+                clear=clear)
             if not column_ids:
                 return
         ts = None
@@ -368,8 +370,11 @@ class API:
                   and t else
                   (t if isinstance(t, datetime) else None)
                   for t in timestamps]
-        f.import_bits(row_ids, column_ids, ts)
-        self._import_existence(index, column_ids)
+        f.import_bits(row_ids, column_ids, ts, clear=clear)
+        if not clear:
+            # clears do NOT retract existence: other fields may still hold
+            # the column (the reference also only imports existence on set)
+            self._import_existence(index, column_ids)
 
     def _live_shard_owners(self, index_name: str, shard: int) -> list:
         """Owning replicas minus probe-detected-down nodes — the shared
@@ -384,7 +389,7 @@ class API:
 
     def _route_import(self, index_name: str, field_name: str,
                       a_ids: list, column_ids: list, extra,
-                      values: bool = False):
+                      values: bool = False, clear: bool = False):
         """Split an import by shard and forward each shard's batch to every
         owning replica; returns the locally-owned remainder (possibly empty
         lists). a_ids is rowIDs (set import) or the values list (see
@@ -418,6 +423,8 @@ class API:
                            "remote": True}
                 if extra:
                     payload["timestamps"] = [extra[i] for i in sel]
+                if clear:
+                    payload["clear"] = True
             try:
                 self.forward_import_fn(group["uri"], index_name, field_name,
                                        payload)

@@ -48,6 +48,8 @@ def build_parser() -> argparse.ArgumentParser:
     ip.add_argument("--create", action="store_true",
                     help="create index/field if missing")
     ip.add_argument("--batch-size", type=int, default=100000)
+    ip.add_argument("--clear", action="store_true",
+                    help="clear the imported bits instead of setting them")
     ip.add_argument("--min", type=int, default=0)
     ip.add_argument("--max", type=int, default=0)
     ip.add_argument("files", nargs="+")
@@ -180,6 +182,8 @@ def cmd_import(args) -> int:
             payload = {"columnIDs": batch_a, "values": batch_b}
         else:
             payload = {"rowIDs": batch_a, "columnIDs": batch_b}
+            if args.clear:
+                payload["clear"] = True
         _post(args.host, f"/index/{args.index}/field/{args.field}/import", payload)
         total += len(batch_a)
         batch_a.clear()

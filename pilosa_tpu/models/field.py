@@ -258,7 +258,10 @@ class Field:
     # -- bulk import (field.go:1058-1214) -----------------------------------
 
     def import_bits(self, row_ids: Iterable[int], columns: Iterable[int],
-                    timestamps: Optional[Iterable[Optional[datetime]]] = None) -> None:
+                    timestamps: Optional[Iterable[Optional[datetime]]] = None,
+                    clear: bool = False) -> None:
+        """Bulk import; clear=True removes the bits instead (the import
+        endpoint's clear mode, http/handler.go:1002-1004)."""
         rows = list(row_ids)
         cols = list(columns)
         tss = list(timestamps) if timestamps is not None else [None] * len(rows)
@@ -276,7 +279,9 @@ class Field:
         for (vname, shard), (grows, gcols) in groups.items():
             view = self.create_view_if_not_exists(vname)
             frag = view.create_fragment_if_not_exists(shard)
-            if mutex:
+            if clear:
+                frag.bulk_clear(grows, gcols)
+            elif mutex:
                 frag.bulk_import_mutex(grows, gcols)
             else:
                 frag.bulk_import(grows, gcols)

@@ -301,11 +301,15 @@ class Handler:
                 column_ids=req.get("columnIDs"), values=req.get("values"),
                 column_keys=req.get("columnKeys"), remote=remote)
         else:
+            # clear=true (query param or body) treats the import as
+            # clear-bits (handler.go:184, :1002-1004)
+            clear = (self._arg(query, "clear") == "true"
+                     or bool(req.get("clear", False)))
             self.api.import_bits(
                 params["index"], params["field"],
                 row_ids=req.get("rowIDs"), column_ids=req.get("columnIDs"),
                 row_keys=req.get("rowKeys"), column_keys=req.get("columnKeys"),
-                timestamps=req.get("timestamps"), remote=remote)
+                timestamps=req.get("timestamps"), remote=remote, clear=clear)
         return self._json({})
 
     def post_import_roaring(self, params, query, body):

@@ -420,6 +420,21 @@ class Fragment:
         self.snapshot()
 
     @_locked
+    def bulk_clear(self, row_ids: Iterable[int], columns: Iterable[int]) -> None:
+        """Bulk CLEAR path — the import endpoint's clear=true mode
+        (handler.go:1002-1004 doClear -> ImportOptionsClear): remove the
+        given bits, one snapshot at the end."""
+        rows = np.asarray(list(row_ids), dtype=np.uint64)
+        cols = np.asarray(list(columns), dtype=np.uint64)
+        if rows.size != cols.size:
+            raise ValueError("row/column length mismatch")
+        positions = rows * np.uint64(SHARD_WIDTH) + cols % np.uint64(SHARD_WIDTH)
+        self.storage.remove_many(positions)
+        for rid in np.unique(rows).tolist():
+            self._touch(int(rid))
+        self.snapshot()
+
+    @_locked
     def bulk_import_mutex(self, row_ids: Iterable[int], columns: Iterable[int]) -> None:
         """Mutex bulk set path: last write wins per column, and every other
         row's bit for a written column is cleared — preserving the

@@ -930,3 +930,23 @@ def test_debug_vars_surfaces_engine_stats(server):
     assert d["countBatcher"]["batched_queries"] >= 1
     assert d["planeSumBatcher"]["batched_queries"] >= 1
     assert "topnRecountRows" in d
+
+
+def test_import_clear_mode(server):
+    """clear=true on the import endpoint removes bits instead of setting
+    them (PostImport Optional clear, handler.go:184, :1002-1004)."""
+    jpost(server.uri, "/index/ic", {})
+    jpost(server.uri, "/index/ic/field/f", {})
+    jpost(server.uri, "/index/ic/field/f/import",
+          {"rowIDs": [1, 1, 1, 2], "columnIDs": [10, 11, 12, 10]})
+    _, out = jpost(server.uri, "/index/ic/query", raw=b"Count(Row(f=1))")
+    assert out["results"] == [3]
+    # clear two of row 1's bits via the query param, one via the body flag
+    jpost(server.uri, "/index/ic/field/f/import?clear=true",
+          {"rowIDs": [1, 1], "columnIDs": [10, 11]})
+    jpost(server.uri, "/index/ic/field/f/import",
+          {"rowIDs": [1], "columnIDs": [12], "clear": True})
+    _, out = jpost(server.uri, "/index/ic/query", raw=b"Row(f=1)")
+    assert out["results"][0]["columns"] == []
+    _, out = jpost(server.uri, "/index/ic/query", raw=b"Count(Row(f=2))")
+    assert out["results"] == [1]  # untouched row survives
