@@ -320,10 +320,14 @@ class Handler:
             req = self._body_json(body)
             views = {name: base64.b64decode(data)
                      for name, data in req.get("views", {}).items()}
-        self.api.import_roaring(params["index"], params["field"],
-                                int(params["shard"]), views,
-                                clear=bool(req.get("clear", False)),
-                                remote=bool(req.get("remote", False)))
+        # the reference carries these as URL params (PostImportRoaring
+        # Optional("remote", "clear"), handler.go:185); accept either
+        self.api.import_roaring(
+            params["index"], params["field"], int(params["shard"]), views,
+            clear=(self._arg(query, "clear") == "true"
+                   or bool(req.get("clear", False))),
+            remote=(self._arg(query, "remote") == "true"
+                    or bool(req.get("remote", False))))
         return self._json({})
 
     def get_export(self, params, query, body):
