@@ -1,0 +1,16 @@
+#!/bin/bash
+# Probe the axon TPU tunnel every 120s; log transitions to benches/tpu_watch.log
+while true; do
+  ts=$(date -u +%H:%M:%S)
+  if timeout 75 python -c "
+import jax
+assert jax.default_backend() not in ('cpu',), jax.default_backend()
+import jax.numpy as jnp
+(jnp.ones((8,8))@jnp.ones((8,8))).block_until_ready()
+" >/dev/null 2>&1; then
+    echo "$ts UP" >> /root/repo/benches/tpu_watch.log
+  else
+    echo "$ts down" >> /root/repo/benches/tpu_watch.log
+  fi
+  sleep 120
+done

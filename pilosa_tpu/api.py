@@ -609,19 +609,20 @@ class API:
         rows, cols = frag.block_data(block)
         return {"rowIDs": rows.tolist(), "columnIDs": cols.tolist()}
 
-    def column_attr_diff(self, index_name: str, blocks: list[dict]) -> dict:
+    def column_attr_diff(self, index_name: str, blocks: list[dict],
+                         block_range=None) -> dict:
         """Attrs in blocks whose checksum differs from the caller's
         (api.ColumnAttrDiff — the attr anti-entropy pull, holder.go:726)."""
         index = self.holder.index(index_name)
         if index is None:
             raise NotFoundError(f"index not found: {index_name}")
-        return _attr_diff(index.column_attrs, blocks)
+        return _attr_diff(index.column_attrs, blocks, block_range)
 
     def row_attr_diff(self, index_name: str, field_name: str,
-                      blocks: list[dict]) -> dict:
+                      blocks: list[dict], block_range=None) -> dict:
         """api.RowAttrDiff (holder.go:772 syncField)."""
         f = self._field(index_name, field_name)
-        return _attr_diff(f.row_attrs, blocks)
+        return _attr_diff(f.row_attrs, blocks, block_range)
 
     def fragment_views(self, index_name: str, field_name: str,
                        shard: int) -> list[str]:
@@ -658,12 +659,23 @@ class API:
         return self.translate.log_bytes(offset)
 
 
-def _attr_diff(store, blocks: list[dict]) -> dict:
+def _attr_diff(store, blocks: list[dict], block_range=None) -> dict:
     """Return {id: attrs} for every local block whose checksum differs from
-    the caller's view (attr.go blocks; boltdb/attrstore.go BlockData)."""
+    the caller's view (attr.go blocks; boltdb/attrstore.go BlockData).
+
+    block_range = [lo, hi) restricts the diff to local block ids in that
+    range — the pagination contract: a caller pulling a large store pages
+    through tiling ranges, each request carrying only its range's blocks,
+    and the responses cover exactly the peer's blocks once (hi None =
+    unbounded)."""
+    lo, hi = (block_range if block_range else (None, None))
     remote = {int(b["id"]): b.get("checksum", "") for b in blocks}
     out: dict[int, dict] = {}
     for blk, chk in store.blocks():
+        if lo is not None and blk < lo:
+            continue
+        if hi is not None and blk >= hi:
+            continue
         if remote.get(blk) == chk.hex():
             continue
         out.update(store.block_data(blk))

@@ -118,9 +118,15 @@ class Executor:
         # threshold-pruning walk (tests assert ≪ total rows; /debug/vars)
         self.topn_recount_rows = 0
         # (index, field, shards) -> (cache versions, merged ids, counts):
-        # the cross-shard TopN candidate merge memo (see
+        # the cross-shard TopN candidate merge memo, LRU-bounded so a
+        # server alternating many ad-hoc shard subsets evicts the coldest
+        # entry instead of dropping every memo at once (see
         # _topn_candidate_arrays)
-        self._topn_merge_memo: dict[tuple, tuple] = {}
+        import collections
+        import threading as _threading
+        self._topn_merge_memo: collections.OrderedDict = \
+            collections.OrderedDict()
+        self._topn_memo_lock = _threading.Lock()
         # HBM residency manager: query leaves cached as device arrays keyed
         # by content generation; repeat queries run without host->HBM
         # transfers (parallel/residency.py)
@@ -135,7 +141,10 @@ class Executor:
             PlaneSumBatcher,
         )
         if os.environ.get("PILOSA_TPU_BATCH", "1") != "0":
-            self.batcher = CountBatcher()
+            # runner-aware: on a replica×shard mesh the batch scatters
+            # over replica slices (SURVEY §2.9 strategy 3 in the
+            # PRODUCTION serving path, not just the bench kernels)
+            self.batcher = CountBatcher(runner=self.runner)
             self.sum_batcher = PlaneSumBatcher()
             self.minmax_batcher = MinMaxBatcher()
         else:
@@ -747,14 +756,18 @@ class Executor:
                 versions.append((s, cache._version))
                 per_shard.append(cache.top_arrays())
         key = (index.name, f.name, tuple(shards))
-        memo = self._topn_merge_memo.get(key)
         vt = tuple(versions)
-        if memo is not None and memo[0] == vt:
-            return memo[1], memo[2]
+        with self._topn_memo_lock:
+            memo = self._topn_merge_memo.get(key)
+            if memo is not None and memo[0] == vt:
+                self._topn_merge_memo.move_to_end(key)  # LRU touch
+                return memo[1], memo[2]
         ids, counts = merge_pair_arrays(per_shard)
-        if len(self._topn_merge_memo) > 256:  # ad-hoc shard subsets bound
-            self._topn_merge_memo.clear()
-        self._topn_merge_memo[key] = (vt, ids, counts)
+        with self._topn_memo_lock:
+            self._topn_merge_memo[key] = (vt, ids, counts)
+            self._topn_merge_memo.move_to_end(key)
+            while len(self._topn_merge_memo) > 256:  # evict coldest only
+                self._topn_merge_memo.popitem(last=False)
         return ids, counts
 
     def _topn_src_walk(self, index: Index, f, shards,

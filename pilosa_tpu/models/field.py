@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import json
 import os
+import threading
 from dataclasses import asdict, dataclass, field as dc_field
 from datetime import datetime
 from typing import Iterable, Optional
@@ -70,6 +71,9 @@ class Field:
         self.name = name
         self.options = options or FieldOptions()
         self.views: dict[str, View] = {}
+        # two concurrent first-writes must not both construct a View for
+        # the same name: each would open (flock) the same fragment files
+        self._view_mu = threading.Lock()
         self.available_shards = Bitmap()
         # row attr store (reference: field.go rowAttrStore, boltdb-backed)
         from pilosa_tpu.utils.attrstore import AttrStore
@@ -139,11 +143,16 @@ class Field:
     def _open_view(self, name: str) -> View:
         v = self.views.get(name)
         if v is None:
-            v = View(view_path(self.path, name), self.index, self.name, name,
-                     track_rank=self._track_rank() and not name.startswith(VIEW_BSI_PREFIX),
-                     cache_size=self.options.cache_size,
-                     cache_type=self.options.cache_type).open()
-            self.views[name] = v
+            with self._view_mu:  # double-checked: creation is rare
+                v = self.views.get(name)
+                if v is None:
+                    v = View(view_path(self.path, name), self.index,
+                             self.name, name,
+                             track_rank=self._track_rank()
+                             and not name.startswith(VIEW_BSI_PREFIX),
+                             cache_size=self.options.cache_size,
+                             cache_type=self.options.cache_type).open()
+                    self.views[name] = v
         return v
 
     def view(self, name: str = VIEW_STANDARD) -> Optional[View]:

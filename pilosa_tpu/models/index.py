@@ -36,6 +36,10 @@ class Index:
         self.keys = keys
         self.track_existence = track_existence
         self.fields: dict[str, Field] = {}
+        # guards concurrent field creation (two racing first-imports must
+        # not both construct a Field: duplicate stores + fragment flocks)
+        import threading
+        self._field_mu = threading.Lock()
         self.shard_hook = None
         # column attr store (reference: index.go ColumnAttrStore)
         from pilosa_tpu.utils.attrstore import AttrStore
@@ -91,16 +95,17 @@ class Index:
 
     def create_field(self, name: str, options: Optional[FieldOptions] = None) -> Field:
         validate_name(name)
-        if name in self.fields:
-            raise ValueError(f"field already exists: {name}")
         options = options or FieldOptions()
         options.validate()
-        f = Field(os.path.join(self.path, name), self.name, name, options)
-        f.save_meta()
-        f.open()
-        f.on_shard_added = self.shard_hook
-        self.fields[name] = f
-        return f
+        with self._field_mu:
+            if name in self.fields:
+                raise ValueError(f"field already exists: {name}")
+            f = Field(os.path.join(self.path, name), self.name, name, options)
+            f.save_meta()
+            f.open()
+            f.on_shard_added = self.shard_hook
+            self.fields[name] = f
+            return f
 
     def set_shard_hook(self, fn) -> None:
         self.shard_hook = fn
@@ -112,7 +117,14 @@ class Index:
         existing = self.fields.get(name)
         if existing is not None:
             return existing
-        return self.create_field(name, options)
+        try:
+            return self.create_field(name, options)
+        except ValueError:
+            # lost a creation race: the winner's field is the field
+            existing = self.fields.get(name)
+            if existing is not None:
+                return existing
+            raise
 
     def delete_field(self, name: str) -> None:
         f = self.fields.pop(name, None)

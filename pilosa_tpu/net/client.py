@@ -9,6 +9,7 @@ net/http_server.py.
 from __future__ import annotations
 
 import base64
+import http.client
 import json
 import ssl
 import urllib.error
@@ -76,6 +77,12 @@ class InternalClient:
             raise ClientError(f"{method} {path}: timed out: {e}")
         except urllib.error.URLError as e:
             raise ClientError(f"{method} {path}: {e.reason}")
+        except (OSError, http.client.HTTPException) as e:
+            # raw socket errors (ConnectionResetError mid-response) and
+            # http.client errors (IncompleteRead after headers) escape
+            # urllib's URLError wrapping; peers are unreliable by
+            # contract, so normalize them too
+            raise ClientError(f"{method} {path}: {type(e).__name__}: {e}")
 
     def _json(self, method: str, uri: str, path: str, payload=None) -> dict:
         body = json.dumps(payload).encode() if payload is not None else None
@@ -127,18 +134,25 @@ class InternalClient:
                           f"/internal/fragment/block/data?index={index}&field={field}"
                           f"&view={view}&shard={shard}&block={block}")
 
-    def column_attr_diff(self, uri: str, index: str,
-                         blocks: list[dict]) -> dict[int, dict]:
-        """Pull column attrs whose blocks differ (AttrDiff, client.go:32)."""
+    def column_attr_diff(self, uri: str, index: str, blocks: list[dict],
+                         block_range=None) -> dict[int, dict]:
+        """Pull column attrs whose blocks differ (AttrDiff, client.go:32).
+        block_range=[lo, hi) pages the pull (hi None = unbounded)."""
+        req = {"blocks": blocks}
+        if block_range is not None:
+            req["blockRange"] = list(block_range)
         out = self._json("POST", uri, f"/internal/index/{index}/attr/diff",
-                         {"blocks": blocks})
+                         req)
         return {int(k): v for k, v in out.get("attrs", {}).items()}
 
     def row_attr_diff(self, uri: str, index: str, field: str,
-                      blocks: list[dict]) -> dict[int, dict]:
+                      blocks: list[dict], block_range=None) -> dict[int, dict]:
+        req = {"blocks": blocks}
+        if block_range is not None:
+            req["blockRange"] = list(block_range)
         out = self._json(
             "POST", uri, f"/internal/index/{index}/field/{field}/attr/diff",
-            {"blocks": blocks})
+            req)
         return {int(k): v for k, v in out.get("attrs", {}).items()}
 
     def fragment_views(self, uri: str, index: str, field: str,

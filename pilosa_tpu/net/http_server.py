@@ -474,13 +474,15 @@ class Handler:
     def post_column_attr_diff(self, params, query, body):
         req = self._body_json(body)
         attrs = self.api.column_attr_diff(params["index"],
-                                          req.get("blocks", []))
+                                          req.get("blocks", []),
+                                          req.get("blockRange"))
         return self._json({"attrs": {str(k): v for k, v in attrs.items()}})
 
     def post_row_attr_diff(self, params, query, body):
         req = self._body_json(body)
         attrs = self.api.row_attr_diff(params["index"], params["field"],
-                                       req.get("blocks", []))
+                                       req.get("blocks", []),
+                                       req.get("blockRange"))
         return self._json({"attrs": {str(k): v for k, v in attrs.items()}})
 
     def delete_remote_available_shard(self, params, query, body):

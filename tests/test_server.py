@@ -950,3 +950,93 @@ def test_import_clear_mode(server):
     assert out["results"][0]["columns"] == []
     _, out = jpost(server.uri, "/index/ic/query", raw=b"Count(Row(f=2))")
     assert out["results"] == [1]  # untouched row survives
+
+
+# -- async broadcast plane (SendAsync, broadcast.go:30-36) -------------------
+
+
+def test_hung_peer_adds_no_write_latency(server, tmp_path):
+    """The create-shard announcement fired from inside the first write to a
+    new shard rides the async broadcast queue: a peer that accepts TCP but
+    never answers must add ZERO latency to Set() (the reference sends this
+    over gossip SendAsync; the old sync path added peer-timeout per write)."""
+    import socket
+    import time as _time
+
+    from pilosa_tpu.parallel.cluster import Node
+
+    u = server.uri
+    jpost(u, "/index/hp", {})
+    jpost(u, "/index/hp/field/f", {})
+
+    # a peer that accepts connections and then hangs forever
+    hung = socket.socket()
+    hung.bind(("127.0.0.1", 0))
+    hung.listen(8)
+    hport = hung.getsockname()[1]
+    try:
+        server.cluster.nodes.append(
+            Node(id="hung-node", uri=f"http://127.0.0.1:{hport}"))
+        # write to a shard THIS node owns (adding a peer moved ownership of
+        # ~half the shards to it; a write routed to the hung owner would
+        # legitimately block on forwarding, which is not what's under test)
+        shard = next(
+            s for s in range(64)
+            if all(n.id == server.node_id
+                   for n in server.cluster.shard_nodes("hp", s)))
+        col = shard * SHARD_WIDTH + 3
+        t0 = _time.perf_counter()
+        status, out = jpost(u, "/index/hp/query",
+                            raw=f"Set({col}, f=1)".encode())
+        elapsed = _time.perf_counter() - t0
+        assert status == 200 and out["results"] == [True]
+        # sync-broadcast behavior would block ~30s (client timeout); the
+        # async queue returns immediately — generous bound for slow CI
+        assert elapsed < 2.0, f"Set took {elapsed:.1f}s with a hung peer"
+        # the announcement was not dropped: the broadcast worker actually
+        # dialed the (hung) peer off the write path
+        hung.settimeout(10)
+        conn, _ = hung.accept()
+        conn.close()
+    finally:
+        server.cluster.nodes[:] = [n for n in server.cluster.nodes
+                                   if n.id != "hung-node"]
+        hung.close()
+
+
+def test_broadcast_async_delivers(cluster3):
+    """broadcast_async reaches every healthy peer (delivery happens off the
+    caller's thread; convergence within the wait window)."""
+    s0, s1, s2 = cluster3
+    jpost(s0.uri, "/index/ba", {})
+    jpost(s0.uri, "/index/ba/field/f", {})
+    s0.broadcast_async({"type": "create-shard", "index": "ba",
+                        "field": "f", "shard": 7})
+    assert wait_until(lambda: all(
+        7 in {int(x) for x in
+              s.holder.index("ba").field("f").available_shards.slice()}
+        for s in (s1, s2)), timeout=10)
+
+
+def test_attr_sync_paginates(cluster3):
+    """_sync_attrs pages block diffs: with a 1-block page size every local
+    chunk carries a tiling [lo, hi) range, so peer-only blocks in the gaps
+    and beyond the last local block are still pulled exactly once
+    (holder.go:726-820 attr-block paging analog)."""
+    s0, s1, s2 = cluster3
+    jpost(s0.uri, "/index/pg", {})
+    # peer (s0) attrs spread over blocks 0, 1, 2 and 100 (block = id//100)
+    ca0 = s0.holder.index("pg").column_attrs
+    for cid, val in ((7, "a"), (105, "b"), (250, "c"), (10_050, "d")):
+        ca0.set_attrs(cid, {"v": val})
+    # puller (s1) has its OWN blocks 1 and 3 -> multi-page with gaps: pages
+    # are [0,106)@blk1, [106,None)@blk3; blocks 0/2/100 ride range gaps
+    ca1 = s1.holder.index("pg").column_attrs
+    ca1.set_attrs(199, {"mine": 1})
+    ca1.set_attrs(399, {"mine": 2})
+    s1.ATTR_SYNC_PAGE = 1  # force one block per request
+    merged = s1.sync_holder()
+    assert merged >= 1
+    for cid, val in ((7, "a"), (105, "b"), (250, "c"), (10_050, "d")):
+        assert ca1.attrs(cid) == {"v": val}, cid
+    assert ca1.attrs(199) == {"mine": 1} and ca1.attrs(399) == {"mine": 2}
