@@ -107,6 +107,28 @@ def _apply_platform() -> None:
         jax.config.update("jax_platforms", PLATFORM)
 
 
+def _go_proxy() -> dict:
+    """Measured reference-proxy numbers (benches/refproxy.json — scalar
+    C++ mirror of the Go reference's kernels; see BASELINE.md). {} if the
+    file is absent."""
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "benches", "refproxy.json")
+    try:
+        with open(path) as f:
+            return json.load(f).get("results", {})
+    except (OSError, ValueError):
+        return {}
+
+
+def _attach_go_ref(m: dict, bench_name: str, tpu_s: float) -> None:
+    """Add vs_go_reference = proxy_seconds / tpu_seconds to a stage dict."""
+    entry = _go_proxy().get(bench_name)
+    if entry and tpu_s > 0:
+        go_s = entry["ns_per_op"] / 1e9
+        m["go_proxy_ms_per_query"] = round(go_s * 1e3, 4)
+        m["vs_go_reference"] = round(go_s / tpu_s, 2)
+
+
 def _concurrent_seconds_per_query(n_threads: int, per_thread: int,
                                   run_query) -> float:
     """Aggregate serving rate under concurrent clients: n_threads each
@@ -220,6 +242,8 @@ def bench_kernel() -> dict:
         "tpu_gcols_per_s": round(cols / tpu_s / 1e9, 2),
         "hbm_gb_per_s": round(2 * cols / 8 / tpu_s / 1e9, 1),
     }
+    if N_SHARDS == 1024:  # proxy measured at this exact shape
+        _attach_go_ref(out, "kernel_2rows_dense_1024shard", tpu_s)
 
     # Pallas scalar-prefetch stream: explicitly double-buffered DMA of the
     # data-dependent row blocks (real TPU only — interpret mode would time
@@ -324,7 +348,7 @@ def bench_executor(ex, row_bits) -> dict:
     ) * (EXEC_SHARDS / small)
     cpu_best_s = min(cpu_s, cpu_conc_s)
 
-    return {
+    out = {
         "metric": METRIC,
         "value": round(1.0 / tpu_s, 2),
         "unit": "queries/s/chip",
@@ -340,6 +364,9 @@ def bench_executor(ex, row_bits) -> dict:
                 "BEST of single-core and same-concurrency numpy on the "
                 "same dense work",
     }
+    if EXEC_SHARDS == 128:  # proxy measured at this exact shape (1% rows)
+        _attach_go_ref(out, "exec_128shard_1pct", tpu_s)
+    return out
 
 
 def build_topn_index(holder):
@@ -517,7 +544,7 @@ def bench_bsi(ex, vals) -> dict:
         _ = vals[m].sum(), m.sum()
     cpu_s = (time.perf_counter() - t0) / 3
 
-    return {
+    out = {
         "metric": "bsi_range_sum_p50_ms",
         "value": round(p50 * 1e3, 3),
         "unit": "ms",
@@ -528,6 +555,11 @@ def bench_bsi(ex, vals) -> dict:
         "path": "Executor Sum(Range) BSI plane kernels; concurrent_qps = "
                 "16 clients, varying thresholds, PlaneSumBatcher coalesced",
     }
+    if BSI_SHARDS == 16:  # proxy measured at this exact shape
+        _attach_go_ref(out, "bsi_sum_range_16shard", conc_s)
+        out["go_ref_compared_against"] = "concurrent (serving throughput; " \
+            "single-stream p50 over the tunnel measures link RTT)"
+    return out
 
 
 def bench_http(tmpdir) -> dict:

@@ -1,0 +1,66 @@
+"""Build + run the C++ reference-baseline proxy and record the results.
+
+Produces benches/refproxy.json: {bench_name: {"ns_per_op": float, "ops": int,
+"qps": float}} plus host metadata. bench.py reads this file to attach
+vs_go_reference ratios to its stages. See refproxy.cc for why a scalar C++
+proxy stands in for the absent Go toolchain.
+"""
+
+import json
+import os
+import platform
+import subprocess
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+SRC = os.path.join(HERE, "refproxy.cc")
+BIN = os.path.join(HERE, "refproxy")
+OUT = os.path.join(HERE, "refproxy.json")
+
+
+def build() -> None:
+    if (os.path.exists(BIN)
+            and os.path.getmtime(BIN) >= os.path.getmtime(SRC)):
+        return
+    subprocess.run(["g++", "-O2", "-std=c++17", "-o", BIN, SRC], check=True)
+
+
+def main() -> None:
+    build()
+    proc = subprocess.run([BIN] + sys.argv[1:], capture_output=True,
+                          text=True, check=True, timeout=600)
+    results = {}
+    if len(sys.argv) > 1:  # filtered rerun: merge over the existing file
+        try:
+            with open(OUT) as f:
+                results = json.load(f).get("results", {})
+        except (OSError, ValueError):
+            pass
+    for line in proc.stdout.splitlines():
+        parts = line.split()
+        if len(parts) != 3:
+            continue
+        name, ns, ops = parts[0], float(parts[1]), int(parts[2])
+        results[name] = {"ns_per_op": ns, "ops": ops,
+                         "qps": round(1e9 / ns, 2) if ns else 0.0}
+    try:
+        cpu = [l.split(":", 1)[1].strip()
+               for l in open("/proc/cpuinfo")
+               if l.startswith("model name")][0]
+    except (OSError, IndexError):
+        cpu = platform.processor()
+    out = {
+        "proxy": "scalar C++ -O2 reimplementation of the reference's "
+                 "roaring kernels + bench workloads (no Go toolchain in "
+                 "image; see refproxy.cc header and BASELINE.md)",
+        "host_cpu": cpu,
+        "host_cores": os.cpu_count(),
+        "results": results,
+    }
+    with open(OUT, "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps(out["results"], indent=1))
+
+
+if __name__ == "__main__":
+    main()

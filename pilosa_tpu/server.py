@@ -152,6 +152,7 @@ class Server:
         import queue as _queue
         self._bcast_queue: "_queue.Queue" = _queue.Queue()
         self._bcast_thread: Optional[threading.Thread] = None
+        self._bcast_dropped = 0  # per-peer queue overflow drops (AE heals)
         self.closed = False
 
     # -- lifecycle (server.go Open, §3.1) -----------------------------------
@@ -572,45 +573,60 @@ class Server:
         except ClientError:
             pass  # peers converge via anti-entropy
 
+    # per-peer async queue bound: a long-hung peer must not grow its queue
+    # without limit — dropped messages converge via anti-entropy / the
+    # return-heal schema sync
+    BCAST_PEER_QUEUE_MAX = 1024
+
     def broadcast_async(self, msg: dict) -> None:
         """SendAsync (broadcast.go:30-36): enqueue and return — delivery
-        happens on the broadcast worker with bounded retry; after that,
+        happens on per-peer sender workers with bounded retry; after that,
         anti-entropy converges. The caller (a write path) never blocks on
-        a peer."""
+        a peer, and a hung peer head-of-line-blocks ONLY its own queue —
+        announcements keep flowing to healthy peers."""
         if self.closed:
             return
         self._bcast_queue.put(msg)
 
     def _bcast_worker(self) -> None:
-        """Drains the async broadcast queue. One send round per message to
-        all peers concurrently; one retry after a short delay for peers
-        that failed (a restarting peer misses nothing: its return-heal
-        schema sync replays shard sets anyway)."""
+        """Fans the async broadcast queue out to one sender thread + queue
+        per peer URI (created lazily, torn down on close)."""
+        import queue as _queue
+
+        peer_queues: dict[str, "_queue.Queue"] = {}
+        peer_threads: dict[str, threading.Thread] = {}
+
+        def peer_sender(uri: str, q: "_queue.Queue") -> None:
+            while True:
+                m = q.get()
+                if m is None:
+                    return
+                try:
+                    self.client.send_message(uri, m)
+                except ClientError:
+                    if self.closed:
+                        continue
+                    time.sleep(0.2)  # one retry, then let AE converge
+                    self._send_quiet(uri, m)
+
         while True:
             msg = self._bcast_queue.get()
-            if msg is None:  # close() sentinel
+            if msg is None:  # close() sentinel: stop the peer senders too
+                for q in peer_queues.values():
+                    q.put(None)
                 return
-            failed: list[str] = []
-            lock = threading.Lock()
-
-            def send(u, m=msg):
-                try:
-                    self.client.send_message(u, m)
-                except ClientError:
-                    with lock:
-                        failed.append(u)
-
-            uris = self._peer_uris()
-            threads = [threading.Thread(target=send, args=(u,), daemon=True)
-                       for u in uris]
-            for t in threads:
-                t.start()
-            for t in threads:
-                t.join()
-            if failed and not self.closed:
-                time.sleep(0.2)
-                for u in failed:
-                    self._send_quiet(u, msg)
+            for uri in self._peer_uris():
+                q = peer_queues.get(uri)
+                if q is None:
+                    q = peer_queues[uri] = _queue.Queue()
+                    t = threading.Thread(target=peer_sender, args=(uri, q),
+                                         daemon=True)
+                    t.start()
+                    peer_threads[uri] = t
+                if q.qsize() < self.BCAST_PEER_QUEUE_MAX:
+                    q.put(msg)
+                else:
+                    self._bcast_dropped += 1
 
     # -- resize engine (cluster.go:1150-1515) -------------------------------
 
@@ -1111,7 +1127,7 @@ class Server:
                         store.set_bulk_attrs(attrs.items())
                         got = True
             except ClientError:
-                continue
+                pass  # later pages lost; earlier merges still count
             if got:
                 merged += 1
         return merged
