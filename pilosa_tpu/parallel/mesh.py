@@ -234,6 +234,25 @@ def count_pair_stream(rows: jax.Array, ii: jax.Array, jj: jax.Array,
     return tot
 
 
+def scatter_queries(mesh: Mesh, ii: np.ndarray, jj: np.ndarray):
+    """Shared replica-scatter scaffolding for query streams: pads K to a
+    multiple of the replica count with (0, 0) no-op queries (dropped after
+    gather) and places ii/jj sharded over the replica axis (replicated on
+    a 1-D shard mesh). Returns (ii_dev, jj_dev, real_k, rep_spec) — used
+    by both the XLA and Pallas stream kernels so padding semantics cannot
+    diverge."""
+    n_rep = mesh.shape.get(REPLICA_AXIS, 1)
+    rep_spec = P(REPLICA_AXIS) if REPLICA_AXIS in mesh.shape else P()
+    k = ii.shape[0]
+    pad = (-k) % n_rep
+    if pad:
+        ii = np.concatenate([ii, np.zeros(pad, ii.dtype)])
+        jj = np.concatenate([jj, np.zeros(pad, jj.dtype)])
+    ii_d = jax.device_put(ii.astype(np.int32), NamedSharding(mesh, rep_spec))
+    jj_d = jax.device_put(jj.astype(np.int32), NamedSharding(mesh, rep_spec))
+    return ii_d, jj_d, k, rep_spec
+
+
 def pair_stream_counts(mesh: Mesh, rows: jax.Array, ii: np.ndarray,
                        jj: np.ndarray) -> np.ndarray:
     """Per-query counts for a stream of K Count(Intersect(Row i, Row j))
@@ -249,17 +268,9 @@ def pair_stream_counts(mesh: Mesh, rows: jax.Array, ii: np.ndarray,
     """
     from jax.experimental.shard_map import shard_map
 
-    n_rep = mesh.shape.get(REPLICA_AXIS, 1)
     # on a 1-D ('shard',) mesh there is no replica axis: every device scans
     # the full stream (replicated), sharded only over the data
-    rep_spec = P(REPLICA_AXIS) if REPLICA_AXIS in mesh.shape else P()
-    k = ii.shape[0]
-    pad = (-k) % n_rep
-    if pad:  # pad with (0, 0) no-op queries, dropped after gather
-        ii = np.concatenate([ii, np.zeros(pad, ii.dtype)])
-        jj = np.concatenate([jj, np.zeros(pad, jj.dtype)])
-    ii_d = jax.device_put(ii, NamedSharding(mesh, rep_spec))
-    jj_d = jax.device_put(jj, NamedSharding(mesh, rep_spec))
+    ii_d, jj_d, k, rep_spec = scatter_queries(mesh, ii, jj)
 
     @jax.jit
     @functools.partial(
@@ -296,9 +307,10 @@ class DeviceRunner:
         if use_pallas is None:
             use_pallas = os.environ.get("PILOSA_TPU_PALLAS", "").lower() in (
                 "1", "true", "yes", "on")
-        # the Pallas count path is single-device (pallas_call under GSPMD
-        # sharding would need shard_map); a mesh keeps the XLA path
-        self.use_pallas = bool(use_pallas) and mesh is None
+        # with a mesh the Pallas kernels run under shard_map (each device
+        # blocks over its local shards, partials psum on ICI — see
+        # pallas_kernels.program_count_mesh)
+        self.use_pallas = bool(use_pallas)
 
     @property
     def n_devices(self) -> int:
@@ -366,8 +378,15 @@ class DeviceRunner:
         if self.use_pallas:
             # explicitly-blocked Pallas kernel: whole program + popcount in
             # VMEM, no HBM intermediates (PILOSA_TPU_PALLAS=1; parity with
-            # the XLA path is tested in tests/test_pallas.py)
-            from pilosa_tpu.ops.pallas_kernels import program_count
+            # the XLA path is tested in tests/test_pallas.py). Under a mesh
+            # the same kernel runs per-device via shard_map + ICI psum.
+            from pilosa_tpu.ops.pallas_kernels import (
+                program_count,
+                program_count_mesh,
+            )
 
-            return int(jnp.sum(program_count(jnp.stack(leaves), program)))
+            if self.mesh is not None:
+                return int(program_count_mesh(self.mesh, tuple(leaves),
+                                              program))
+            return int(jnp.sum(program_count(tuple(leaves), program)))
         return int(eval_count_total(tuple(leaves), program))

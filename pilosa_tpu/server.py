@@ -590,11 +590,12 @@ class Server:
 
     def _bcast_worker(self) -> None:
         """Fans the async broadcast queue out to one sender thread + queue
-        per peer URI (created lazily, torn down on close)."""
+        per peer URI (created lazily; torn down on close and when the peer
+        leaves the cluster — a departed peer must not keep a retrying
+        sender alive for the rest of the server's life)."""
         import queue as _queue
 
         peer_queues: dict[str, "_queue.Queue"] = {}
-        peer_threads: dict[str, threading.Thread] = {}
 
         def peer_sender(uri: str, q: "_queue.Queue") -> None:
             while True:
@@ -615,14 +616,19 @@ class Server:
                 for q in peer_queues.values():
                     q.put(None)
                 return
+            # retire senders only for peers that LEFT the cluster; a
+            # temporarily-down peer keeps its queue (it is just skipped
+            # by _peer_uris until liveness marks it back up)
+            member = {n.uri for n in self.cluster.nodes
+                      if n.id != self.node_id and n.uri}
+            for uri in [u for u in peer_queues if u not in member]:
+                peer_queues.pop(uri).put(None)
             for uri in self._peer_uris():
                 q = peer_queues.get(uri)
                 if q is None:
                     q = peer_queues[uri] = _queue.Queue()
-                    t = threading.Thread(target=peer_sender, args=(uri, q),
-                                         daemon=True)
-                    t.start()
-                    peer_threads[uri] = t
+                    threading.Thread(target=peer_sender, args=(uri, q),
+                                     daemon=True).start()
                 if q.qsize() < self.BCAST_PEER_QUEUE_MAX:
                     q.put(msg)
                 else:

@@ -56,3 +56,48 @@ def test_pair_stream_counts_matches_numpy():
 
 def test_available():
     assert pk.available()
+
+
+# -- mesh composition (shard_map wrappers; interpret mode on the 8-device
+#    CPU mesh — VERDICT r3: PILOSA_TPU_PALLAS must compose with multi-device)
+
+
+@pytest.mark.parametrize("replicas", [1, 2])
+def test_program_count_mesh_parity(replicas):
+    import jax
+
+    from pilosa_tpu.parallel.mesh import DeviceRunner, eval_count_total, make_mesh
+
+    mesh = make_mesh(replicas=replicas)
+    runner = DeviceRunner(mesh, use_pallas=True)
+    assert runner.use_pallas  # no longer forced off under a mesh
+    rng = np.random.default_rng(17)
+    host = [rng.integers(0, 2**32, size=(5, 256), dtype=np.uint32)
+            for _ in range(3)]
+    leaves = [runner.put_leaf(h) for h in host]
+    program = ("andnot", ("or", ("leaf", 0), ("leaf", 1)), ("leaf", 2))
+    got = runner.count_total_leaves(leaves, program)
+    expect = int(np.bitwise_count((host[0] | host[1]) & ~host[2]).sum())
+    assert got == expect
+    # and parity with the XLA mesh path on the same device arrays
+    assert got == int(eval_count_total(tuple(leaves), program))
+
+
+@pytest.mark.parametrize("replicas", [1, 2])
+def test_pair_stream_counts_mesh_parity(replicas):
+    import jax
+
+    from pilosa_tpu.parallel.mesh import DeviceRunner, make_mesh
+
+    mesh = make_mesh(replicas=replicas)
+    runner = DeviceRunner(mesh)
+    rng = np.random.default_rng(19)
+    host = rng.integers(0, 2**32, size=(6, 4, 256), dtype=np.uint32)
+    rows = runner.put_plane_slab(host)  # [R, S(padded), W] sharded
+    k = 10
+    ii = rng.integers(0, 6, size=k).astype(np.int32)
+    jj = rng.integers(0, 6, size=k).astype(np.int32)
+    got = pk.pair_stream_counts_mesh(mesh, rows, ii, jj)
+    for q in range(k):
+        expect = int(np.bitwise_count(host[ii[q]] & host[jj[q]]).sum())
+        assert got[q] == expect, (q, got[q], expect)
