@@ -343,16 +343,22 @@ class Executor:
                 return row_leaf(c)
             if c.name == "Range":
                 return range_leaf(c)
-            if c.name == "Union":
-                return ("or", *[walk(ch) for ch in c.children])
+            if c.name in ("Union", "Xor"):
+                # zero-arg Union()/Xor() = empty row (executor.go:1446,
+                # 1468: NewRow() with no children to fold in)
+                if not c.children:
+                    return leaf(("zeros", len(shards)), lambda: np.zeros(
+                        (len(shards), WORDS), dtype=np.uint32))
+                op = "or" if c.name == "Union" else "xor"
+                return (op, *[walk(ch) for ch in c.children])
             if c.name == "Intersect":
                 if not c.children:
                     raise ExecutionError("empty Intersect query is currently not supported")
                 return ("and", *[walk(ch) for ch in c.children])
             if c.name == "Difference":
+                if not c.children:  # executor.go:835
+                    raise ExecutionError("empty Difference query is currently not supported")
                 return ("andnot", *[walk(ch) for ch in c.children])
-            if c.name == "Xor":
-                return ("xor", *[walk(ch) for ch in c.children])
             if c.name == "Not":
                 if len(c.children) != 1:
                     raise ExecutionError("Not() takes exactly one argument")

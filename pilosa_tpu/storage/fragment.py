@@ -61,6 +61,29 @@ def _locked(method):
     return wrapper
 
 
+def as_array(x, dtype) -> np.ndarray:
+    """Coerce an iterable (or pass through an ndarray) to dtype — the
+    shared input normalization for the bulk import paths."""
+    return np.asarray(x if isinstance(x, np.ndarray) else list(x),
+                      dtype=dtype)
+
+
+def _aggregate_row_counts(rids: np.ndarray,
+                          ns: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """(unique row ids asc, summed counts) from per-container (row id,
+    cardinality) pairs — one reduceat pass when already sorted (frozen
+    stores), argsort first otherwise (dict iteration order)."""
+    if rids.size == 0:
+        return np.empty(0, np.int64), np.empty(0, np.int64)
+    if rids.size > 1 and not np.all(rids[1:] >= rids[:-1]):
+        order = np.argsort(rids, kind="stable")
+        rids, ns = rids[order], ns[order]
+    starts = np.flatnonzero(
+        np.concatenate([[True], rids[1:] != rids[:-1]]))
+    return (rids[starts].astype(np.int64),
+            np.add.reduceat(ns.astype(np.int64), starts))
+
+
 def pos(row_id: int, column: int) -> int:
     """Absolute bit position of (row, column-within-shard)."""
     return row_id * SHARD_WIDTH + (column % SHARD_WIDTH)
@@ -102,6 +125,11 @@ class Fragment:
         # generations to 0 would collide with the untouched-row key and
         # serve stale device-cache leaves, so they raise this floor instead.
         self._bulk_gen = 0
+        # volatile: storage came from import_frozen and has not been
+        # snapshotted — the WAL is detached and AUTO-snapshots are skipped
+        # (a billion-row frozen corpus must not be rewritten as a side
+        # effect of a small follow-up import); snapshot() clears it
+        self._volatile = False
         # Cached block checksums, invalidated per-block on writes
         # (fragment.go:1226-1305).
         self._block_checksums: dict[int, bytes] = {}
@@ -228,7 +256,7 @@ class Fragment:
     def _increment_op_n(self) -> None:
         self.op_n += 1
         if self.op_n > MAX_OP_N:
-            self.snapshot()
+            self._maybe_snapshot()
 
     @_locked
     def set_row(self, row_id: int, columns: np.ndarray) -> None:
@@ -310,6 +338,14 @@ class Fragment:
                 total += c.n
         return total
 
+    @staticmethod
+    def _frozen_row_arrays(store, kpr: int):
+        """(row_ids, counts) sorted arrays from a frozen store's flat key
+        layout — the shared vectorized base for row_counts / row_ids /
+        rank-cache building at bulk-load scale."""
+        keys, ns = store.key_and_count_arrays()
+        return _aggregate_row_counts(keys // kpr, ns)
+
     def row_counts(self, row_ids) -> np.ndarray:
         """Vectorized exact counts for many rows (the TopN recount asks for
         ~n=1000 winners per query; per-row count_range walks the whole key
@@ -325,16 +361,20 @@ class Fragment:
         cached = self._row_counts_cache
         if cached is None or cached[0] != self._bulk_gen:
             kpr = CONTAINERS_PER_SHARD  # container keys per row
-            items = list(self.storage.containers.items())
-            if items:
+            store = self.storage.containers
+            if hasattr(store, "key_and_count_arrays"):
+                # frozen store: whole-corpus (row -> count) as two sorted
+                # arrays, no Container materialization, no 1-entry-per-row
+                # Python dict (at 1B rows a dict is >100 GB of objects)
+                uids, sums = self._frozen_row_arrays(store, kpr)
+                m = ("np", uids, sums)
+            elif len(store):
+                items = list(store.items())
                 keys = np.fromiter((k for k, _ in items), np.int64,
                                    len(items))
                 ns = np.fromiter((c.n for _, c in items), np.int64,
                                  len(items))
-                rids = keys // kpr
-                uids, inv = np.unique(rids, return_inverse=True)
-                sums = np.zeros(uids.size, dtype=np.int64)
-                np.add.at(sums, inv, ns)
+                uids, sums = _aggregate_row_counts(keys // kpr, ns)
                 m = dict(zip(uids.tolist(), sums.tolist()))
             else:
                 m = {}
@@ -342,6 +382,17 @@ class Fragment:
             cached = (self._bulk_gen, self.generation, m, {})
             self._row_counts_cache = cached
         _, base_gen, m, overlay = cached
+        if isinstance(m, tuple):  # frozen: sorted-array lookup
+            _, uids, sums = m
+
+            def base_count(r: int) -> int:
+                i = int(np.searchsorted(uids, r))
+                if i < uids.size and int(uids[i]) == r:
+                    return int(sums[i])
+                return 0
+        else:
+            def base_count(r: int) -> int:
+                return m.get(r, 0)
         out = np.empty(len(row_ids), dtype=np.int64)
         row_gen = self._row_gen.get
         for x, r in enumerate(row_ids):
@@ -355,7 +406,7 @@ class Fragment:
                     c = self._row_count_direct(r)
                     overlay[r] = (rg, c)
             else:
-                c = m.get(r, 0)
+                c = base_count(r)
             out[x] = c
         return out
 
@@ -368,16 +419,32 @@ class Fragment:
         fragment.go:2000-2138): walks container keys, not bits. The full
         ascending list is cached per generation — Rows/GroupBy call this
         per shard per query, and the dict store pays a full key sort per
-        walk otherwise."""
+        walk otherwise. Frozen stores keep the cache as a numpy array
+        (a billion-row Python list is tens of GB of boxed ints)."""
         from bisect import bisect_left
 
         cached = self._row_ids_cache
         if cached is None or cached[0] != self.generation:
             kpr = CONTAINERS_PER_SHARD  # container keys per row
-            cached = (self.generation,
-                      sorted({key // kpr for key in self.storage.containers}))
+            store = self.storage.containers
+            if hasattr(store, "key_and_count_arrays"):
+                ids_arr = self._frozen_row_arrays(store, kpr)[0]
+                cached = (self.generation, ids_arr)
+            else:
+                cached = (self.generation,
+                          sorted({key // kpr for key in store}))
             self._row_ids_cache = cached
         ids = cached[1]
+        if isinstance(ids, np.ndarray):
+            if limit is not None or start:
+                if start:
+                    ids = ids[int(np.searchsorted(ids, start)):]
+                return ids[:limit].tolist()
+            # unlimited full walk: box once per generation and memoize —
+            # frozen-scale callers should page with limit instead
+            full = ids.tolist()
+            self._row_ids_cache = (cached[0], full)
+            return list(full)
         if start:
             ids = ids[bisect_left(ids, start):]
         return ids[:limit] if limit is not None else list(ids)
@@ -417,7 +484,7 @@ class Fragment:
         self.storage.add_many(positions)
         for rid in np.unique(rows).tolist():
             self._touch(int(rid))
-        self.snapshot()
+        self._maybe_snapshot()
 
     @_locked
     def bulk_clear(self, row_ids: Iterable[int], columns: Iterable[int]) -> None:
@@ -432,7 +499,7 @@ class Fragment:
         self.storage.remove_many(positions)
         for rid in np.unique(rows).tolist():
             self._touch(int(rid))
-        self.snapshot()
+        self._maybe_snapshot()
 
     @_locked
     def bulk_import_mutex(self, row_ids: Iterable[int], columns: Iterable[int]) -> None:
@@ -459,21 +526,23 @@ class Fragment:
         self.storage.add_many(positions)
         for rid in set(target.values()):
             self._touch(rid)
-        self.snapshot()
+        self._maybe_snapshot()
 
     @_locked
     def bulk_import_values(self, columns: Iterable[int], values: Iterable[int],
                            bit_depth: int) -> None:
-        """BSI bulk import (importValue, fragment.go:1624-1658)."""
-        cols = np.asarray(list(columns), dtype=np.uint64) % np.uint64(SHARD_WIDTH)
-        vals = list(values)
-        if cols.size != len(vals):
+        """BSI bulk import (importValue, fragment.go:1624-1658). Plane
+        masks are numpy shifts, not per-value Python loops (the BASELINE
+        1B-column config is ~11 planes x 1M values per shard)."""
+        cols = as_array(columns, np.uint64) % np.uint64(SHARD_WIDTH)
+        vals = as_array(values, np.int64)
+        if cols.size != vals.size:
             raise ValueError("column/value length mismatch")
         add_positions = []
         clear_positions = []
         for i in range(bit_depth):
             bit_base = np.uint64(i * SHARD_WIDTH)
-            mask = np.array([(v >> i) & 1 for v in vals], dtype=bool)
+            mask = ((vals >> i) & 1).astype(bool)
             add_positions.append(cols[mask] + bit_base)
             clear_positions.append(cols[~mask] + bit_base)
         add_positions.append(cols + np.uint64(bit_depth * SHARD_WIDTH))  # not-null
@@ -482,7 +551,36 @@ class Fragment:
         self.storage.add_many(np.concatenate(add_positions))
         for i in range(bit_depth + 1):
             self._touch(i)
-        self.snapshot()
+        self._maybe_snapshot()
+
+    @_locked
+    def import_frozen(self, positions: np.ndarray) -> None:
+        """BASELINE-scale bulk load: replace this (empty) fragment's
+        storage with a frozen array-backed store built from shard-local
+        bit positions in O(N log N) numpy (storage/frozen.py; the regime
+        of fragment.go:1445 bulkImportStandard at 1B rows, where the
+        per-container merge loop would cost hours of interpreter time).
+
+        Volatile by design: nothing is written to the WAL or snapshot —
+        the load is reproducible from its source, and an 8-GB-plus
+        snapshot is exactly the cost this path exists to avoid. The WAL is
+        therefore DETACHED for the frozen storage: post-freeze mutations
+        COW onto the frozen base in memory but are NOT op-logged (an op
+        record against the un-persisted base would replay on restart into
+        an empty fragment — silently serving one op's worth of a
+        billion-row corpus). Durability is opt-in via snapshot(), which
+        persists the full storage and re-attaches the WAL."""
+        if self.storage.any():
+            raise ValueError("import_frozen requires an empty fragment")
+        self.storage = Bitmap.frozen(positions)
+        self.storage.op_writer = None  # volatile: see docstring
+        self._volatile = True
+        self.generation += 1
+        self._row_gen.clear()
+        self._bulk_gen = self.generation
+        self._block_checksums.clear()
+        self._row_counts_cache = None
+        self._row_ids_cache = None
 
     @_locked
     def import_roaring(self, data: bytes, clear: bool = False) -> None:
@@ -490,21 +588,49 @@ class Fragment:
         (importRoaring, fragment.go:1659-1706)."""
         other = Bitmap.from_bytes(data)
         if clear:
-            self.storage = self.storage.difference(other)
+            store = self.storage.containers
+            if hasattr(store, "key_and_count_arrays"):
+                # frozen storage: difference() would materialize + copy
+                # the whole corpus; clear in place through the COW
+                # overlay, touching only the INCOMING containers. The
+                # storage object (and its detached-WAL volatility) is
+                # preserved.
+                for key, oc in other.containers.items():
+                    mine = store.get(key)
+                    if mine is None:
+                        continue
+                    res = mine.op(oc, "difference")
+                    if res.n:
+                        store[key] = res
+                    else:
+                        del store[key]
+            else:
+                # storage replaced: re-attach the WAL (with the configured
+                # fsync mode — previously dropped here)
+                self.storage = self.storage.difference(other)
+                self.storage.op_sync = self.wal_fsync
+                self.storage.op_writer = self._op_file
         else:
             # k-way in-place merge — the import hot path (fragment.go:1670
-            # unions the incoming bitmap straight into storage)
+            # unions the incoming bitmap straight into storage); writer
+            # state (including a frozen load's detached WAL) is preserved
             self.storage.union_in_place(other)
-        self.storage.op_writer = self._op_file
         self.generation += 1
         self._row_gen.clear()  # all rows considered dirty
         self._bulk_gen = self.generation
         self._block_checksums.clear()
-        self.snapshot()
+        self._maybe_snapshot()
 
     # -- snapshot / WAL compaction (fragment.go:1707-1781) ------------------
 
     @_locked
+    def _maybe_snapshot(self) -> None:
+        """Auto-snapshot hook for the mutating paths: volatile (frozen)
+        fragments skip it — their durability is opt-in via an explicit
+        snapshot() call (see import_frozen)."""
+        if not self._volatile:
+            self.snapshot()
+
     def snapshot(self) -> None:
         tmp = self.path + SNAPSHOT_EXT
         os.makedirs(os.path.dirname(self.path), exist_ok=True)
@@ -533,6 +659,7 @@ class Fragment:
             self._remap_after_snapshot()
             self.storage.op_writer = self._op_file
             self.storage.op_sync = self.wal_fsync
+        self._volatile = False  # persisted: WAL re-attached, durable again
 
     def _remap_after_snapshot(self) -> None:
         """Swap storage onto the freshly-written file (the reference remaps
@@ -640,7 +767,7 @@ class Fragment:
         self._row_gen.clear()
         self._bulk_gen = self.generation
         self._block_checksums.clear()
-        self.snapshot()
+        self._maybe_snapshot()
 
     # -- identity -----------------------------------------------------------
 

@@ -1,0 +1,233 @@
+"""Frozen (array-backed) container store: the billion-row bulk-load path.
+
+The dict and B+Tree stores (containers.py) hold one Python Container object
+per 2^16-position keyspace. That is the right shape for mutable serving
+state, but a bulk load of a BASELINE-scale index (configs 2-3: 100M-1B
+*rows*, so >= one container per row) would allocate hundreds of millions of
+Python objects through a per-container loop — hours of interpreter time and
+>100 GB of object headers for data that is logically three flat arrays.
+
+FrozenContainers keeps the whole store AS three flat numpy arrays:
+
+    keys    int64[Nc]    sorted container keys
+    offsets int64[Nc+1]  value-range per key
+    lows    uint16[N]    concatenated sorted low-16 members
+
+built in O(N log N) numpy from the position array of a bulk import
+(`from_positions`). Containers materialize lazily on access — a query
+touches only the <=16 containers of each row it reads, so the per-object
+cost is paid for the working set, not the corpus. This is the same
+sparse->dense impedance answer as the HBM residency layer (SURVEY §7): host
+storage stays sparse and columnar; dense materialization happens only for
+the rows queries actually touch.
+
+Mutations go to an overlay dict (copy-on-write per container) with a
+deletion set, so the frozen base never changes — `set_bit` after a frozen
+bulk load works, at dict-store cost for the touched containers only.
+
+Reference anchors: the bulk-import regime this serves is
+fragment.go:1445-1706 (bulkImportStandard/importRoaring); the flat
+(keys, offsets, data) layout mirrors the reference's *serialized* roaring
+layout (roaring.go:1387-1454 writeToUnoptimized: key header + offset table
++ container payloads) applied to the in-memory store.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterator, Optional
+
+import numpy as np
+
+from pilosa_tpu.storage.roaring import ARRAY_MAX_SIZE, Container
+
+__all__ = ["FrozenContainers"]
+
+
+class FrozenContainers:
+    """Mapping-protocol container store over flat arrays + a COW overlay.
+
+    Satisfies everything Bitmap expects of a store (get/item access,
+    iteration in key order, irange/first_key/last_key) plus vectorized
+    fast paths (`key_and_count_arrays`, `total_count`) that Bitmap and
+    Fragment use to avoid materializing the corpus.
+    """
+
+    def __init__(self, keys: np.ndarray, offsets: np.ndarray,
+                 lows: np.ndarray):
+        assert keys.ndim == 1 and offsets.shape == (keys.size + 1,)
+        self._keys = keys.astype(np.int64, copy=False)
+        self._offsets = offsets.astype(np.int64, copy=False)
+        self._lows = lows.astype(np.uint16, copy=False)
+        self._overlay: dict[int, Container] = {}
+        self._deleted: set[int] = set()
+
+    # -- construction -------------------------------------------------------
+
+    @classmethod
+    def from_positions(cls, positions: np.ndarray) -> "FrozenContainers":
+        """Sorted-unique uint64 bit positions -> frozen store, all numpy."""
+        positions = np.asarray(positions, dtype=np.uint64)
+        keys64 = (positions >> np.uint64(16)).astype(np.int64)
+        lows = (positions & np.uint64(0xFFFF)).astype(np.uint16)
+        ukeys, starts = np.unique(keys64, return_index=True)
+        offsets = np.empty(ukeys.size + 1, dtype=np.int64)
+        offsets[:-1] = starts
+        offsets[-1] = keys64.size
+        return cls(ukeys, offsets, lows)
+
+    @classmethod
+    def empty(cls) -> "FrozenContainers":
+        return cls(np.empty(0, np.int64), np.zeros(1, np.int64),
+                   np.empty(0, np.uint16))
+
+    # -- base access --------------------------------------------------------
+
+    def _base_idx(self, key: int) -> int:
+        i = int(np.searchsorted(self._keys, key))
+        if i < self._keys.size and int(self._keys[i]) == key:
+            return i
+        return -1
+
+    def _materialize(self, i: int) -> Container:
+        vals = self._lows[self._offsets[i]:self._offsets[i + 1]]
+        if vals.size > ARRAY_MAX_SIZE:
+            return Container.from_values(vals)  # picks bitmap
+        return Container("array", vals)
+
+    # -- mapping protocol ---------------------------------------------------
+
+    def get(self, key: int, default: Any = None) -> Optional[Container]:
+        c = self._overlay.get(key)
+        if c is not None:
+            return c
+        if key in self._deleted:
+            return default
+        i = self._base_idx(key)
+        return self._materialize(i) if i >= 0 else default
+
+    def __getitem__(self, key: int) -> Container:
+        c = self.get(key)
+        if c is None:
+            raise KeyError(key)
+        return c
+
+    def __contains__(self, key: object) -> bool:
+        return self.get(key) is not None  # type: ignore[arg-type]
+
+    def __setitem__(self, key: int, c: Container) -> None:
+        self._overlay[int(key)] = c
+        self._deleted.discard(int(key))
+
+    def __delitem__(self, key: int) -> None:
+        had = key in self
+        self._overlay.pop(int(key), None)
+        if self._base_idx(int(key)) >= 0:
+            self._deleted.add(int(key))
+        elif not had:
+            raise KeyError(key)
+
+    def pop(self, key: int, default: Any = None):
+        c = self.get(key)
+        if c is not None:
+            del self[key]
+        return c if c is not None else default
+
+    def __iter__(self) -> Iterator[int]:
+        return self.irange(None, None)
+
+    def keys(self) -> Iterator[int]:
+        return iter(self)
+
+    def __len__(self) -> int:
+        n = self._keys.size - len(self._deleted)
+        return n + sum(1 for k in self._overlay if self._base_idx(k) < 0)
+
+    def items(self):
+        for k in self:
+            yield k, self[k]
+
+    def values(self):
+        for k in self:
+            yield self[k]
+
+    # -- ordered-store protocol (matches BTreeContainers) -------------------
+
+    def irange(self, lo: Optional[int], hi: Optional[int]) -> Iterator[int]:
+        """Keys in [lo, hi] ascending, overlay-merged (hi inclusive, like
+        BTreeContainers.irange)."""
+        i = 0 if lo is None else int(np.searchsorted(self._keys, lo))
+        extra = sorted(k for k in self._overlay
+                       if self._base_idx(k) < 0
+                       and (lo is None or k >= lo)
+                       and (hi is None or k <= hi))
+        e = 0
+        while i < self._keys.size or e < len(extra):
+            base_k = int(self._keys[i]) if i < self._keys.size else None
+            if base_k is not None and (hi is not None and base_k > hi):
+                base_k = None
+            ext_k = extra[e] if e < len(extra) else None
+            if base_k is None and ext_k is None:
+                return
+            if ext_k is None or (base_k is not None and base_k < ext_k):
+                i += 1
+                if base_k in self._deleted:
+                    continue
+                yield base_k
+            else:
+                e += 1
+                yield ext_k
+
+    def first_key(self) -> int:
+        for k in self:
+            return k
+        raise KeyError("empty store")
+
+    def last_key(self) -> int:
+        # base tail, skipping deleted; vs max overlay-only key
+        last_base = None
+        for i in range(self._keys.size - 1, -1, -1):
+            k = int(self._keys[i])
+            if k not in self._deleted:
+                last_base = k
+                break
+        extra = [k for k in self._overlay if self._base_idx(k) < 0]
+        if extra or last_base is not None:
+            return max([k for k in (last_base,) if k is not None] + extra)
+        raise KeyError("empty store")
+
+    def __bool__(self) -> bool:
+        if self._overlay:
+            return True
+        return self._keys.size > len(self._deleted)
+
+    # -- vectorized fast paths ----------------------------------------------
+
+    def key_and_count_arrays(self) -> tuple[np.ndarray, np.ndarray]:
+        """(keys, cardinalities) for the WHOLE store as int64 arrays with
+        no Container materialization — what Fragment.row_counts and
+        rank-cache building aggregate over at bulk-load scale."""
+        base_n = np.diff(self._offsets)
+        if not self._overlay and not self._deleted:
+            return self._keys, base_n
+        keep = np.ones(self._keys.size, dtype=bool)
+        for k in self._deleted:
+            i = self._base_idx(k)
+            if i >= 0:
+                keep[i] = False
+        # overlay replaces base entries (mutated) and adds new keys
+        ov_keys = np.fromiter(self._overlay.keys(), np.int64,
+                              len(self._overlay))
+        for j, k in enumerate(ov_keys):
+            i = self._base_idx(int(k))
+            if i >= 0:
+                keep[i] = False
+        ov_n = np.fromiter((c.n for c in self._overlay.values()), np.int64,
+                           len(self._overlay))
+        keys = np.concatenate([self._keys[keep], ov_keys])
+        ns = np.concatenate([base_n[keep], ov_n])
+        order = np.argsort(keys, kind="stable")
+        return keys[order], ns[order]
+
+    def total_count(self) -> int:
+        keys, ns = self.key_and_count_arrays()
+        return int(ns.sum())

@@ -539,7 +539,23 @@ class Bitmap:
         c = self.containers.get(value >> 16)
         return c is not None and c.contains(value & 0xFFFF)
 
+    @classmethod
+    def frozen(cls, positions: np.ndarray) -> "Bitmap":
+        """Bulk-load constructor for BASELINE-scale imports: the whole
+        position set becomes a flat array-backed store (storage/frozen.py)
+        in O(N log N) numpy — no per-container Python loop, no per-row
+        object allocation. Mutations after the freeze go to a COW overlay."""
+        from pilosa_tpu.storage.frozen import FrozenContainers
+
+        b = cls()  # store_kind stays the resolved default: DERIVED bitmaps
+        # (intersect/union results) are ordinary mutable stores
+        positions = np.unique(np.asarray(positions, dtype=np.uint64))
+        b.containers = FrozenContainers.from_positions(positions)
+        return b
+
     def count(self) -> int:
+        if hasattr(self.containers, "total_count"):
+            return self.containers.total_count()
         return sum(c.n for c in self.containers.values())
 
     def count_range(self, start: int, stop: int) -> int:

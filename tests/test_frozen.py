@@ -1,0 +1,268 @@
+"""Frozen (array-backed) container store: the bulk-load path for
+BASELINE-scale imports (storage/frozen.py). Behavior parity with the dict
+store, COW overlay semantics, and the vectorized fragment/rank-cache
+integration."""
+
+import numpy as np
+import pytest
+
+from pilosa_tpu.constants import SHARD_WIDTH
+from pilosa_tpu.storage.frozen import FrozenContainers
+from pilosa_tpu.storage.roaring import Bitmap, Container
+
+
+def _positions(seed=3, n=5000, span=50):
+    rng = np.random.default_rng(seed)
+    return np.unique(rng.integers(0, span * (1 << 16), n).astype(np.uint64))
+
+
+def test_from_positions_matches_dict_store():
+    pos = _positions()
+    fz = FrozenContainers.from_positions(pos)
+    ref = Bitmap(pos)  # dict store
+    assert sorted(fz) == sorted(ref.containers)
+    for k in ref.containers:
+        a, b = fz[k], ref.containers[k]
+        assert np.array_equal(a.values(), b.values()), k
+    assert fz.total_count() == pos.size
+    assert len(fz) == len(ref.containers)
+    assert fz.first_key() == min(ref.containers)
+    assert fz.last_key() == max(ref.containers)
+
+
+def test_large_container_materializes_as_bitmap():
+    # >4096 members in one keyspace -> bitmap-kind container
+    pos = np.arange(5000, dtype=np.uint64)
+    fz = FrozenContainers.from_positions(pos)
+    assert fz[0].kind == "bitmap" and fz[0].n == 5000
+
+
+def test_overlay_cow_and_delete():
+    pos = _positions(n=2000, span=10)
+    fz = FrozenContainers.from_positions(pos)
+    base_total = fz.total_count()
+    k0 = int(next(iter(fz)))
+    # replace one container via the overlay
+    fz[k0] = Container.from_values(np.array([1, 2, 3], dtype=np.uint16))
+    assert fz[k0].n == 3
+    # brand-new key beyond the base
+    fz[10_000] = Container.from_values(np.array([7], dtype=np.uint16))
+    assert 10_000 in fz and fz.last_key() == 10_000
+    # delete a base key
+    keys = list(fz)
+    kdel = keys[1]
+    del fz[kdel]
+    assert kdel not in fz
+    with pytest.raises(KeyError):
+        _ = fz[kdel]
+    # iteration stays sorted and consistent
+    ks = list(fz)
+    assert ks == sorted(ks) and 10_000 in ks and kdel not in ks
+    # vectorized arrays reflect the overlay
+    ka, na = fz.key_and_count_arrays()
+    assert ka.tolist() == ks
+    total = fz.total_count()
+    assert total == int(na.sum()) != base_total
+    # irange with overlay-only and deleted keys
+    got = list(fz.irange(k0, 10_000))
+    assert got[0] == k0 and got[-1] == 10_000 and kdel not in got
+
+
+def test_pop_and_bool_and_len_empty():
+    fz = FrozenContainers.empty()
+    assert not fz and len(fz) == 0
+    assert fz.pop(5) is None
+    with pytest.raises(KeyError):
+        fz.first_key()
+    fz[1] = Container.from_values(np.array([4], dtype=np.uint16))
+    assert fz and len(fz) == 1
+    c = fz.pop(1)
+    assert c.n == 1 and not fz
+
+
+def test_bitmap_frozen_read_paths():
+    pos = _positions(seed=9, n=8000, span=64)
+    b = Bitmap.frozen(pos)
+    ref = Bitmap(pos)
+    assert b.count() == ref.count() == pos.size
+    lo, hi = 3 << 16, 40 << 16
+    assert b.count_range(lo, hi) == ref.count_range(lo, hi)
+    assert np.array_equal(b.slice(lo, hi), ref.slice(lo, hi))
+    assert np.array_equal(b.to_dense_words(0, 1 << 20),
+                          ref.to_dense_words(0, 1 << 20))
+    assert b.min() == ref.min() and b.max() == ref.max()
+    # mutation after freeze: COW overlay keeps reads exact
+    b.add(int(pos[0]) + 1) if int(pos[0]) + 1 not in pos else None
+    b.remove_many(pos[:10])
+    ref.remove_many(pos[:10])
+    got = set(b.slice(0, int(pos[20]) + 1).tolist())
+    assert int(pos[5]) not in got
+
+
+def test_fragment_import_frozen_and_queries(tmp_path):
+    from pilosa_tpu.storage.fragment import Fragment
+
+    rng = np.random.default_rng(11)
+    n_rows = 500
+    rows = rng.integers(0, n_rows, 20_000).astype(np.uint64)
+    cols = rng.integers(0, SHARD_WIDTH, 20_000).astype(np.uint64)
+    positions = np.unique(rows * np.uint64(SHARD_WIDTH) + cols)
+    frag = Fragment(str(tmp_path / "f0"), "i", "f", "standard", 0).open()
+    try:
+        frag.import_frozen(np.sort(positions))
+        model_rows = positions // np.uint64(SHARD_WIDTH)
+        uids, counts = np.unique(model_rows, return_counts=True)
+        assert frag.bit_count() == positions.size
+        # vectorized row_counts against the model
+        some = uids[::7]
+        got = frag.row_counts(some.tolist())
+        assert np.array_equal(got, counts[::7])
+        assert frag.row_ids()[:10] == uids[:10].tolist()
+        assert frag.row_ids(start=int(uids[13]), limit=3) == \
+            uids[13:16].tolist()
+        # dense row parity
+        r = int(uids[3])
+        dense = frag.row_dense(r)
+        expect_cols = positions[model_rows == r] % np.uint64(SHARD_WIDTH)
+        got_cols = np.flatnonzero(
+            np.unpackbits(dense.view(np.uint8), bitorder="little"))
+        assert np.array_equal(got_cols, expect_cols.astype(np.int64))
+        # post-freeze single-bit writes still work (COW overlay)
+        newcol = int(expect_cols[0]) + 1
+        changed = frag.set_bit(r, newcol)
+        assert frag.row_count(r) == int(counts[3]) + int(changed)
+        # double-freeze refused
+        with pytest.raises(ValueError):
+            frag.import_frozen(positions)
+    finally:
+        frag.close()
+
+
+def test_field_import_rows_frozen_topn_parity(tmp_path):
+    """End to end: frozen bulk load -> rank cache -> executor TopN matches
+    the mutating import path's answer."""
+    from pilosa_tpu.executor import Executor
+    from pilosa_tpu.models import Holder
+
+    rng = np.random.default_rng(23)
+    n_rows, n_bits = 2000, 60_000
+    rows = rng.integers(0, n_rows, n_bits).astype(np.uint64)
+    cols = rng.integers(0, 3 * SHARD_WIDTH, n_bits).astype(np.uint64)
+    # heavy head so TopN is decisive
+    rows[: n_bits // 4] = rng.integers(0, 20, n_bits // 4)
+
+    h = Holder(str(tmp_path / "d")).open()
+    try:
+        idx = h.create_index("fz", track_existence=False)
+        f1 = idx.create_field("mut")
+        f1.import_bits(rows.tolist(), cols.tolist())
+        f2 = idx.create_field("frz")
+        f2.import_rows_frozen(rows, cols)
+        ex = Executor(h)
+        (a,) = ex.execute("fz", "TopN(mut, n=50)")
+        (b,) = ex.execute("fz", "TopN(frz, n=50)")
+        assert [tuple(p) for p in a] == [tuple(p) for p in b]
+        (ra,) = ex.execute("fz", "Row(mut=7)")
+        (rb,) = ex.execute("fz", "Row(frz=7)")
+        assert ra.columns().tolist() == rb.columns().tolist()
+        (ca,) = ex.execute("fz", "Count(Intersect(Row(frz=3), Row(frz=5)))")
+        (cb,) = ex.execute("fz", "Count(Intersect(Row(mut=3), Row(mut=5)))")
+        assert ca == cb
+    finally:
+        h.close()
+
+
+def test_import_values_vectorized_parity(tmp_path):
+    """The numpy-array fast path of import_values matches the list path."""
+    from pilosa_tpu.executor import Executor
+    from pilosa_tpu.models import FieldOptions, FieldType, Holder
+
+    rng = np.random.default_rng(29)
+    n = 30_000
+    cols = rng.choice(2 * SHARD_WIDTH, n, replace=False).astype(np.uint64)
+    vals = rng.integers(0, 512, n).astype(np.int64)
+    h = Holder(str(tmp_path / "d")).open()
+    try:
+        idx = h.create_index("bv", track_existence=False)
+        va = idx.create_field("a", FieldOptions(type=FieldType.INT,
+                                                min=0, max=511))
+        vb = idx.create_field("b", FieldOptions(type=FieldType.INT,
+                                                min=0, max=511))
+        va.import_values(cols, vals)  # numpy arrays
+        vb.import_values(cols.tolist(), vals.tolist())  # lists
+        ex = Executor(h)
+        (x,) = ex.execute("bv", "Sum(Range(a > 100), field=a)")
+        (y,) = ex.execute("bv", "Sum(Range(b > 100), field=b)")
+        assert (x.val, x.count) == (y.val, y.count)
+        mask = vals > 100
+        assert x.val == int(vals[mask].sum()) and x.count == int(mask.sum())
+    finally:
+        h.close()
+
+
+def test_frozen_volatility_contract(tmp_path):
+    """A frozen fragment is volatile until snapshot(): post-freeze writes
+    are NOT op-logged (a WAL op against the un-persisted base would replay
+    into an empty fragment after restart and silently serve one op's worth
+    of a billion-row corpus), and reopening yields an EMPTY fragment that
+    accepts a fresh import_frozen. snapshot() makes it durable."""
+    from pilosa_tpu.storage.fragment import Fragment
+
+    path = str(tmp_path / "vf")
+    pos = np.arange(0, 3000, 3, dtype=np.uint64)
+    frag = Fragment(path, "i", "f", "standard", 0).open()
+    frag.import_frozen(pos)
+    frag.set_bit(0, 1)  # volatile too — must not op-log
+    assert frag.bit_count() == pos.size + 1
+    frag.close()
+    # restart: clean empty state, not a one-op corpse
+    frag2 = Fragment(path, "i", "f", "standard", 0).open()
+    assert frag2.bit_count() == 0
+    frag2.import_frozen(pos)  # re-import allowed
+    frag2.snapshot()  # opt-in durability
+    frag2.set_bit(0, 1)  # WAL re-attached by snapshot: this op persists
+    frag2.close()
+    frag3 = Fragment(path, "i", "f", "standard", 0).open()
+    assert frag3.bit_count() == pos.size + 1
+    frag3.close()
+
+
+def test_frozen_clear_roaring_in_place(tmp_path):
+    """clear=True roaring import against frozen storage removes bits
+    through the COW overlay (touching only incoming containers) instead of
+    materializing the whole corpus via difference()."""
+    from pilosa_tpu.storage.fragment import Fragment
+
+    pos = np.arange(0, 200_000, 2, dtype=np.uint64)
+    frag = Fragment(str(tmp_path / "cf"), "i", "f", "standard", 0).open()
+    try:
+        frag.import_frozen(pos)
+        store = frag.storage.containers
+        clear = Bitmap(np.arange(0, 1000, 2, dtype=np.uint64))
+        frag.import_roaring(clear.to_bytes(), clear=True)
+        assert frag.storage.containers is store  # same store object (COW)
+        assert frag.bit_count() == pos.size - 500
+        assert not frag.storage.contains(0) and frag.storage.contains(1000)
+    finally:
+        frag.close()
+
+
+def test_import_values_last_write_wins(tmp_path):
+    """Duplicate columns in one import_values call: the LAST value wins
+    (importValue semantics, fragment.go:1624) — not the bitwise OR."""
+    from pilosa_tpu.executor import Executor, ValCount
+    from pilosa_tpu.models import FieldOptions, FieldType, Holder
+
+    h = Holder(str(tmp_path / "d")).open()
+    try:
+        idx = h.create_index("lw", track_existence=False)
+        v = idx.create_field("v", FieldOptions(type=FieldType.INT,
+                                               min=0, max=100))
+        v.import_values([5, 5, 9], [2, 1, 7])  # col 5: 2 then 1
+        ex = Executor(h)
+        (vc,) = ex.execute("lw", "Sum(field=v)")
+        assert vc == ValCount(8, 2)  # 1 + 7, NOT 3 + 7
+        (r,) = ex.execute("lw", "Range(v == 1)")
+        assert r.columns().tolist() == [5]
+    finally:
+        h.close()
