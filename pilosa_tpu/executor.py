@@ -237,7 +237,8 @@ class Executor:
     def _query_shards(self, index: Index, shards) -> list[int]:
         if shards is not None:
             return sorted(shards)
-        return [int(s) for s in index.available_shards().slice()]
+        # memoized on per-field shard versions; shared list — don't mutate
+        return index.available_shards_list()
 
     # ----------------------------------------------------- bitmap programs
 

@@ -40,6 +40,8 @@ class Index:
         # not both construct a Field: duplicate stores + fragment flocks)
         import threading
         self._field_mu = threading.Lock()
+        # (per-field shard versions, union bitmap) — see available_shards
+        self._avail_shards_cache = None
         self.shard_hook = None
         # column attr store (reference: index.go ColumnAttrStore)
         from pilosa_tpu.utils.attrstore import AttrStore
@@ -136,13 +138,29 @@ class Index:
     # -- shards -------------------------------------------------------------
 
     def available_shards(self) -> Bitmap:
-        """Union of per-field shard bitmaps (index.go:238)."""
+        """Union of per-field shard bitmaps (index.go:238), memoized on
+        the per-field shard versions — the query fan-out calls this per
+        query, and rebuilding the union per call was a measurable share
+        of serving CPU on small hosts. Callers must not mutate it."""
+        key = tuple((name, f.shards_version)
+                    for name, f in self.fields.items())
+        cached = self._avail_shards_cache
+        if cached is not None and cached[0] == key:
+            return cached[1]
         out = Bitmap()
         for f in self.fields.values():
             out = out.union(f.available_shards)
         if not out.any():
             out.add(0)  # queries always cover at least shard 0
+        self._avail_shards_cache = (key, out, sorted(
+            int(s) for s in out.slice()))
         return out
+
+    def available_shards_list(self) -> list[int]:
+        """Sorted shard ids, memoized with available_shards — what the
+        executor's per-query fan-out actually consumes."""
+        self.available_shards()
+        return self._avail_shards_cache[2]
 
     # -- existence tracking (writes mark columns live; Not()/existence
     #    queries read it — index.go:167, executor.go:1478) ------------------
