@@ -42,6 +42,9 @@ class Index:
         self._field_mu = threading.Lock()
         # (per-field shard versions, union bitmap) — see available_shards
         self._avail_shards_cache = None
+        # bumped on field create/delete: keys the memo across schema
+        # changes (a recreated field's shards_version restarts at 0)
+        self._schema_epoch = 0
         self.shard_hook = None
         # column attr store (reference: index.go ColumnAttrStore)
         from pilosa_tpu.utils.attrstore import AttrStore
@@ -107,6 +110,7 @@ class Index:
             f.open()
             f.on_shard_added = self.shard_hook
             self.fields[name] = f
+            self._schema_epoch += 1
             return f
 
     def set_shard_hook(self, fn) -> None:
@@ -132,6 +136,7 @@ class Index:
         f = self.fields.pop(name, None)
         if f is None:
             raise KeyError(f"field not found: {name}")
+        self._schema_epoch += 1
         f.close()
         shutil.rmtree(f.path, ignore_errors=True)
 
@@ -142,13 +147,19 @@ class Index:
         the per-field shard versions — the query fan-out calls this per
         query, and rebuilding the union per call was a measurable share
         of serving CPU on small hosts. Callers must not mutate it."""
-        key = tuple((name, f.shards_version)
-                    for name, f in self.fields.items())
+        # list(): atomic snapshot under the GIL — generator iteration
+        # would race a concurrent create_field resizing the dict. The
+        # schema epoch guards delete+recreate: a fresh Field restarts
+        # shards_version at 0, which would otherwise collide with the
+        # old field's first version and serve a stale shard list.
+        fields = list(self.fields.items())
+        key = (self._schema_epoch,
+               tuple((name, f.shards_version) for name, f in fields))
         cached = self._avail_shards_cache
         if cached is not None and cached[0] == key:
             return cached[1]
         out = Bitmap()
-        for f in self.fields.values():
+        for _, f in fields:
             out = out.union(f.available_shards)
         if not out.any():
             out.add(0)  # queries always cover at least shard 0

@@ -290,3 +290,23 @@ def test_mutex_bulk_import_one_row_per_column(tmp_path):
     assert f.row(2).columns().tolist() == [6, 7]
     assert f.row(3).columns().tolist() == [5]
     h.close()
+
+
+def test_available_shards_memo_field_recreate(tmp_path):
+    """The shard-fanout memo must not serve a deleted field's shard list
+    after delete+recreate (a fresh Field restarts shards_version at 0,
+    colliding with the old version without the schema epoch)."""
+    from pilosa_tpu.models import Holder
+
+    h = Holder(str(tmp_path / "d")).open()
+    try:
+        idx = h.create_index("m", track_existence=False)
+        f = idx.create_field("f")
+        f.import_bits([1], [5 * 1048576 + 3])  # shard 5
+        assert idx.available_shards_list() == [5]
+        idx.delete_field("f")
+        f2 = idx.create_field("f")
+        f2.import_bits([1], [9 * 1048576 + 3])  # shard 9
+        assert idx.available_shards_list() == [9]
+    finally:
+        h.close()
