@@ -895,9 +895,17 @@ class Executor:
         shards = self._query_shards(index, shards)
         limit = call.uint_arg("limit")
         previous = call.args.get("previous")
+        if isinstance(previous, str):
+            # keyed paging: previous is a row KEY (rows() RowKey handling,
+            # executor.go:2693); unknown key -> no lower bound
+            previous = self._translate_row(index, f, previous, create=False)
+        else:
+            previous = call.uint_arg("previous")  # validated: `previous+1`
+            # must not shift semantics for fractional inputs
         column = call.uint_arg("column")
         view = f.view(VIEW_STANDARD)
         out: set[int] = set()
+        start = (previous + 1) if previous is not None else 0
         if view is not None:
             for s in shards:
                 frag = view.fragment(s)
@@ -908,12 +916,17 @@ class Executor:
                         continue
                     # column probe (fragment.go:2446 filterColumn): only
                     # the candidate container per row is membership-tested
-                    out.update(frag.rows_for_column(column))
+                    out.update(r for r in frag.rows_for_column(column)
+                               if r >= start)
                 else:
-                    out.update(frag.row_ids())
+                    # limit pushdown: any row in the global ascending
+                    # top-k is inside some shard's ascending top-k, so
+                    # the union of per-shard prefixes suffices — at
+                    # billion-row scale this is O(shards · k), not
+                    # O(total rows) (rows() start/limit semantics,
+                    # fragment.go:2000-2138)
+                    out.update(frag.row_ids(start=start, limit=limit))
         rows = sorted(out)
-        if previous is not None:
-            rows = [r for r in rows if r > previous]
         if limit is not None:
             rows = rows[:limit]
         return RowIdentifiers(rows)

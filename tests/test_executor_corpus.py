@@ -584,3 +584,26 @@ def test_error_cases(wex):
                 "Sum(field=nosuch)"]:
         with pytest.raises(Exception):
             wex.execute("i", bad)
+
+
+def test_keyed_rows_paging(keyed):
+    """Rows paging by row KEY (previous="...") on a keyed field
+    (executor.go:2693 RowKey paging)."""
+    e, _ = keyed
+    e.execute("ki", 'Set("a", f="x") Set("b", f="y") Set("c", f="z")')
+    (all_rows,) = e.execute("ki", "Rows(field=f)")
+    keys = all_rows.row_keys
+    assert set(keys) == {"x", "y", "z"}
+    (page,) = e.execute("ki", f'Rows(field=f, previous="{keys[0]}")')
+    assert page.row_keys == keys[1:]
+    (page,) = e.execute("ki", 'Rows(field=f, previous="nosuch")')
+    assert page.row_keys == keys  # unknown key: no lower bound
+
+
+def test_rows_previous_validation(wex):
+    """Fractional/invalid `previous` fails loudly instead of silently
+    shifting the page window."""
+    f = wex.holder.create_index("i").create_field("f")
+    f.import_bits([3, 4], [0, 1])
+    with pytest.raises(Exception):
+        wex.execute("i", "Rows(field=f, previous=2.5)")
