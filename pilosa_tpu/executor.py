@@ -956,7 +956,13 @@ class Executor:
         rows_calls = [c for c in call.children if c.name == "Rows"]
         if not rows_calls:
             raise ExecutionError("GroupBy requires at least one Rows() call")
+        # filter: the reference takes it as a NAMED arg (executor.go
+        # groupByCall filter); a positional trailing bitmap call is also
+        # accepted for convenience
         filt_calls = [c for c in call.children if c.name != "Rows"]
+        named_filter = call.args.get("filter")
+        if isinstance(named_filter, Call):
+            filt_calls.append(named_filter)
         if len(filt_calls) > 1:
             raise ExecutionError("GroupBy supports at most one filter call")
         filter_dev = None

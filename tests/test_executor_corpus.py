@@ -607,3 +607,122 @@ def test_rows_previous_validation(wex):
     f.import_bits([3, 4], [0, 1])
     with pytest.raises(Exception):
         wex.execute("i", "Rows(field=f, previous=2.5)")
+
+
+# ------------------------------------------------ additional scenario depth
+
+
+def test_time_quantum_variants(wex):
+    """Coarser quanta produce coarser covers (YM: whole months only)."""
+    idx = wex.holder.create_index("i")
+    idx.create_field("t", FieldOptions(type=FieldType.TIME,
+                                       time_quantum="YM"))
+    wex.execute("i", "Set(1, t=1, 2010-01-15T10:00)")
+    wex.execute("i", "Set(2, t=1, 2010-03-02T00:00)")
+    # end must reach April for March to be a COMPLETE covered month
+    (r,) = wex.execute("i", "Range(t=1, 2010-01-01T00:00, 2010-04-01T00:00)")
+    assert r.columns().tolist() == [1, 2]
+    (r,) = wex.execute("i", "Range(t=1, 2010-01-01T00:00, 2010-03-31T23:59)")
+    assert r.columns().tolist() == [1]  # March incomplete: col 2 excluded
+    (r,) = wex.execute("i", "Range(t=1, 2010-02-01T00:00, 2010-04-01T00:00)")
+    assert r.columns().tolist() == [2]
+    # sub-month window: no complete month covered
+    (r,) = wex.execute("i", "Range(t=1, 2010-01-02T00:00, 2010-01-20T00:00)")
+    assert r.columns().tolist() == []
+
+
+def test_not_compositions(wex):
+    idx = wex.holder.create_index("i", track_existence=True)
+    idx.create_field("f")
+    idx.create_field("v", FieldOptions(type=FieldType.INT, min=0, max=50))
+    wex.execute("i", "Set(1, f=1) Set(2, f=1) Set(3, f=2)")
+    wex.execute("i", "Set(1, v=10) Set(2, v=40) Set(3, v=20)")
+    (c,) = wex.execute("i", "Count(Not(Row(f=1)))")
+    assert c == 1
+    (r,) = wex.execute("i", "Not(Range(v > 15))")
+    assert r.columns().tolist() == [1]
+    (r,) = wex.execute("i", "Union(Not(Row(f=1)), Row(f=1))")
+    assert r.columns().tolist() == [1, 2, 3]  # existence partition
+    (c,) = wex.execute("i", "Count(Intersect(Not(Row(f=1)), Not(Row(f=2))))")
+    assert c == 0
+
+
+def test_store_from_arbitrary_sources(wex):
+    idx = wex.holder.create_index("i")
+    idx.create_field("f")
+    idx.create_field("v", FieldOptions(type=FieldType.INT, min=0, max=50))
+    wex.execute("i", "Set(1, f=1) Set(2, f=1) Set(5, f=2)")
+    wex.execute("i", "Set(1, v=10) Set(2, v=40) Set(5, v=45)")
+    # Store a BSI comparison result as a materialized row
+    wex.execute("i", "Store(Range(v > 30), f=77)")
+    (r,) = wex.execute("i", "Row(f=77)")
+    assert r.columns().tolist() == [2, 5]
+    # Store a compound expression
+    wex.execute("i", "Store(Intersect(Row(f=1), Range(v > 30)), f=78)")
+    (r,) = wex.execute("i", "Row(f=78)")
+    assert r.columns().tolist() == [2]
+    # overwrite the stored row with a different source
+    wex.execute("i", "Store(Row(f=2), f=77)")
+    (r,) = wex.execute("i", "Row(f=77)")
+    assert r.columns().tolist() == [5]
+
+
+def test_min_max_all_negative(wex):
+    idx = wex.holder.create_index("i")
+    idx.create_field("v", FieldOptions(type=FieldType.INT,
+                                       min=-1000, max=-1))
+    wex.execute("i", "Set(1, v=-5) Set(2, v=-1000) Set(3, v=-5)")
+    (vc,) = wex.execute("i", "Min(field=v)")
+    assert (vc.val, vc.count) == (-1000, 1)
+    (vc,) = wex.execute("i", "Max(field=v)")
+    assert (vc.val, vc.count) == (-5, 2)
+    (vc,) = wex.execute("i", "Sum(field=v)")
+    assert (vc.val, vc.count) == (-1010, 3)
+
+
+def test_groupby_filter_and_limit_interplay(wex):
+    idx = wex.holder.create_index("i")
+    f = idx.create_field("f")
+    g = idx.create_field("g")
+    f.import_bits([1, 1, 2, 2, 3], [0, 1, 1, 2, 9])
+    g.import_bits([7, 7, 8], [1, 2, 0])
+    (groups,) = wex.execute(
+        "i", "GroupBy(Rows(field=f), Rows(field=g), filter=Row(f=1))")
+    got = {(d["group"][0]["rowID"], d["group"][1]["rowID"]): d["count"]
+           for d in groups}
+    # counts intersected with Row(f=1) = {0, 1}
+    assert got == {(1, 7): 1, (1, 8): 1, (2, 7): 1}
+    (groups,) = wex.execute(
+        "i", "GroupBy(Rows(field=f), Rows(field=g), limit=2)")
+    assert len(groups) == 2  # lexicographic cutoff
+    (groups,) = wex.execute(
+        "i", "GroupBy(Rows(field=f, previous=1), Rows(field=g))")
+    assert all(d["group"][0]["rowID"] > 1 for d in groups)
+
+
+def test_topn_attr_ids_cross(wex):
+    idx = wex.holder.create_index("i")
+    f = idx.create_field("f", FieldOptions(cache_size=50))
+    f.import_bits([1] * 4 + [2] * 3 + [3] * 2 + [4] * 1,
+                  [0, 1, 2, 3, 0, 1, 2, 0, 1, 0])
+    wex.execute("i", 'SetRowAttrs(f, 1, cat="a")')
+    wex.execute("i", 'SetRowAttrs(f, 2, cat="b")')
+    wex.execute("i", 'SetRowAttrs(f, 3, cat="a")')
+    (pairs,) = wex.execute(
+        "i", 'TopN(f, n=10, attrName=cat, attrValues=["a"])')
+    assert [tuple(p) for p in pairs] == [(1, 4), (3, 2)]
+    # attr filter x ids: intersection of both restrictions
+    (pairs,) = wex.execute(
+        "i", 'TopN(f, n=10, ids=[1, 2], attrName=cat, attrValues=["a"])')
+    assert [tuple(p) for p in pairs] == [(1, 4)]
+
+
+def test_count_distinct_shard_boundaries(wex):
+    """Bits on exact shard edges land in the right shard's fan-out."""
+    f = wex.holder.create_index("i").create_field("f")
+    edge = [0, SW - 1, SW, 2 * SW - 1, 2 * SW, 3 * SW - 1]
+    f.import_bits([1] * len(edge), edge)
+    (c,) = wex.execute("i", "Count(Row(f=1))")
+    assert c == len(edge)
+    (r,) = wex.execute("i", "Row(f=1)")
+    assert r.columns().tolist() == edge
