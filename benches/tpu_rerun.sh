@@ -1,0 +1,36 @@
+#!/bin/bash
+# One-shot TPU evidence capture for when the axon tunnel is healthy:
+#   1. full bench.py (checkpointed per stage -> benches/bench_ckpt.jsonl)
+#   2. scale-config QUERY phases on chip (config3 TopN + config4 BSI;
+#      imports are host-side and platform-independent)
+#   3. Pallas kernel validation on real TPU (compile + parity)
+# Usage: bash benches/tpu_rerun.sh [deadline_seconds=1800]
+set -x
+cd "$(dirname "$0")/.."
+DEADLINE=${1:-1800}
+date -u
+timeout 120 python -c "
+import jax; print(jax.devices())
+import jax.numpy as jnp
+print(int((jnp.ones((256,256),jnp.uint32) & jnp.ones((256,256),jnp.uint32)).sum()))" \
+  || { echo "TUNNEL STILL DOWN"; exit 1; }
+PILOSA_BENCH_DEADLINE_S=$DEADLINE python bench.py 2> benches/tpu_bench_stderr.log \
+  | tee benches/tpu_bench_result.json
+tail -5 benches/tpu_bench_stderr.log
+PILOSA_SCALE=1.0 timeout 5400 python benches/scale_configs.py config3 config4 \
+  2>&1 | tail -4
+timeout 600 python -m pytest tests/test_pallas.py -q -x 2>&1 | tail -2
+PILOSA_TPU_PALLAS=1 timeout 900 python - <<'PYEOF'
+# scalar-prefetch stream on the real chip (interpret mode can't check tiling)
+import jax, jax.numpy as jnp, numpy as np, time
+from pilosa_tpu.ops.pallas_kernels import pair_stream_counts
+assert jax.default_backend() == "tpu", jax.default_backend()
+rows = jax.random.bits(jax.random.key(7), (16, 256, 32768), dtype=jnp.uint32)
+ii = np.arange(64, dtype=np.int32) % 16
+jj = (np.arange(64, dtype=np.int32) + 1) % 16
+out = np.asarray(pair_stream_counts(rows, ii, jj))
+a = np.asarray(rows[ii[0]]); b = np.asarray(rows[jj[0]])
+assert out[0] == int(np.bitwise_count(a & b).sum())
+print("pallas stream on TPU OK", out[:4])
+PYEOF
+date -u
