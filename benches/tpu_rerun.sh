@@ -5,24 +5,34 @@
 #      imports are host-side and platform-independent)
 #   3. Pallas kernel validation on real TPU (compile + parity)
 # Usage: bash benches/tpu_rerun.sh [deadline_seconds=1800]
+# Exit codes: 1 = tunnel down, 2+ = a capture phase failed (artifacts of
+# earlier phases are still on disk). All phase timeouts derive from the
+# deadline so the total run fits ~3x the given window.
 set -x
+set -o pipefail
 cd "$(dirname "$0")/.."
 DEADLINE=${1:-1800}
+FAILED=0
 date -u
+# probe must assert a NON-CPU backend: a silent JAX cpu fallback would
+# capture CPU numbers labeled as TPU evidence (tpu_watch.sh's check)
 timeout 120 python -c "
-import jax; print(jax.devices())
+import jax
+assert jax.default_backend() not in ('cpu',), jax.default_backend()
+print(jax.devices())
 import jax.numpy as jnp
 print(int((jnp.ones((256,256),jnp.uint32) & jnp.ones((256,256),jnp.uint32)).sum()))" \
-  || { echo "TUNNEL STILL DOWN"; exit 1; }
+  || { echo "TUNNEL STILL DOWN / CPU FALLBACK"; exit 1; }
 PILOSA_BENCH_DEADLINE_S=$DEADLINE python bench.py 2> benches/tpu_bench_stderr.log \
-  | tee benches/tpu_bench_result.json
+  | tee benches/tpu_bench_result.json || FAILED=2
 tail -5 benches/tpu_bench_stderr.log
-PILOSA_SCALE=1.0 timeout 5400 python benches/scale_configs.py config3 config4 \
-  2>&1 | tail -4
-timeout 600 python -m pytest tests/test_pallas.py -q -x 2>&1 | tail -2
-PILOSA_TPU_PALLAS=1 timeout 900 python - <<'PYEOF'
+PILOSA_SCALE=1.0 timeout $((DEADLINE * 2)) python benches/scale_configs.py \
+  config3 config4 2>&1 | tail -4 || FAILED=3
+timeout $((DEADLINE / 3)) python -m pytest tests/test_pallas.py -q -x 2>&1 \
+  | tail -2 || FAILED=4
+timeout $((DEADLINE / 2)) python - <<'PYEOF' || FAILED=5
 # scalar-prefetch stream on the real chip (interpret mode can't check tiling)
-import jax, jax.numpy as jnp, numpy as np, time
+import jax, jax.numpy as jnp, numpy as np
 from pilosa_tpu.ops.pallas_kernels import pair_stream_counts
 assert jax.default_backend() == "tpu", jax.default_backend()
 rows = jax.random.bits(jax.random.key(7), (16, 256, 32768), dtype=jnp.uint32)
@@ -34,3 +44,4 @@ assert out[0] == int(np.bitwise_count(a & b).sum())
 print("pallas stream on TPU OK", out[:4])
 PYEOF
 date -u
+exit $FAILED
