@@ -164,10 +164,20 @@ def config3(holder, ex):
     assert recounts < C3_ROWS // 1000, \
         f"recounted {recounts} of {C3_ROWS} rows — pruning broken"
     assert res["bytes"] <= ex.residency.budget, res
+    # Rows paging at 1B rows: the per-shard limit pushdown keeps this
+    # O(shards * k) instead of O(total rows)
+    (first,) = ex.execute("c3", "Rows(field=t, limit=100)")
+    assert list(first) == list(range(100))
+    rows_samples = []
+    for i in range(9):
+        t = time.perf_counter()
+        ex.execute("c3", f"Rows(field=t, previous={i * 1000}, limit=100)")
+        rows_samples.append(time.perf_counter() - t)
     emit({"config": 3, "rows": C3_ROWS, "shards": C3_SHARDS,
           "bits": n_bits, "build_s": round(build_s, 1),
           "topn_p50_ms": round(_p50(samples) * 1e3, 3),
           "topn_recount_rows": recounts,
+          "rows_page100_p50_ms": round(_p50(rows_samples) * 1e3, 3),
           "residency_bytes": res["bytes"],
           "residency_budget": ex.residency.budget})
     holder.delete_index("c3")
