@@ -266,3 +266,33 @@ def test_import_values_last_write_wins(tmp_path):
         assert r.columns().tolist() == [5]
     finally:
         h.close()
+
+
+def test_frozen_mutation_fuzz():
+    """Randomized mutation/read fuzz vs the dict-store model: set/delete/
+    get/irange interleave across base and overlay keys."""
+    rng = np.random.default_rng(7)
+    pos = np.unique(rng.integers(0, 40 << 16, 10_000).astype(np.uint64))
+    fz = FrozenContainers.from_positions(pos)
+    ref = Bitmap(pos)
+    for i in range(600):
+        op = int(rng.integers(0, 4))
+        key = int(rng.integers(0, 42))
+        if op == 0:
+            vals = np.unique(rng.integers(0, 65536, 20)).astype(np.uint16)
+            c = Container.from_values(vals)
+            fz[key] = c
+            ref.containers[key] = c
+        elif op == 1:
+            a, b = fz.get(key), ref.containers.get(key)
+            assert (a is None) == (b is None), (i, key)
+            if a is not None:
+                assert np.array_equal(a.values(), b.values()), (i, key)
+        elif op == 2 and key in fz:
+            del fz[key]
+            ref.containers.pop(key, None)
+        else:
+            assert list(fz.irange(key, key + 5)) == sorted(
+                k for k in ref.containers if key <= k <= key + 5), (i, key)
+    assert list(fz) == sorted(ref.containers)
+    assert fz.total_count() == ref.count()
