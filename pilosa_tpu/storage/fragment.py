@@ -213,9 +213,16 @@ class Fragment:
         self.storage.op_writer = None
         # close the mapping WITHOUT materializing: shutdown must not read
         # the whole file; later access to a still-lazy container of a
-        # closed fragment raises loudly ("mmap closed"), never corrupts
+        # closed fragment raises loudly ("mmap closed"), never corrupts.
+        # A frozen-parsed store holds numpy views over the mapping
+        # (exported buffers): those make close() impossible — drop our
+        # reference instead and let refcounting reclaim the mapping when
+        # the last view dies (reads through live views stay valid).
         if self._mmap is not None:
-            self._mmap.close()
+            try:
+                self._mmap.close()
+            except BufferError:
+                pass
             self._mmap = None
         if self._lock_file is not None:
             self._lock_file.close()  # releases the flock
@@ -678,6 +685,11 @@ class Fragment:
 
         old = self.storage
         self._map()  # fresh lazy parse of the new file
+        if hasattr(old.containers, "write_pilosa"):
+            # the snapshot just serialized base+overlay compacted; the
+            # fresh parse covers everything, and walking a billion-entry
+            # frozen store to "carry over" would materialize the corpus
+            return
         for key, c in old.containers.items():
             if not isinstance(c, LazyContainer):
                 self.storage.containers[key] = c

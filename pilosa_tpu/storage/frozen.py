@@ -53,13 +53,29 @@ class FrozenContainers:
     """
 
     def __init__(self, keys: np.ndarray, offsets: np.ndarray,
-                 lows: np.ndarray):
-        assert keys.ndim == 1 and offsets.shape == (keys.size + 1,)
+                 lows: np.ndarray, ends: Optional[np.ndarray] = None):
+        """offsets: value-range starts per key; without `ends`, container i
+        spans offsets[i]:offsets[i+1] (contiguous lows, the from_positions
+        layout). With `ends` (the zero-copy file-parse layout, where
+        bitmap/run payload bytes sit between array payloads in the same
+        buffer) container i spans offsets[i]:ends[i]."""
+        if ends is None:
+            assert keys.ndim == 1 and offsets.shape == (keys.size + 1,)
+            starts, ends = offsets[:-1], offsets[1:]
+        else:
+            starts = offsets
+            assert keys.shape == starts.shape == ends.shape
         self._keys = keys.astype(np.int64, copy=False)
-        self._offsets = offsets.astype(np.int64, copy=False)
+        self._starts = starts.astype(np.int64, copy=False)
+        self._ends = ends.astype(np.int64, copy=False)
         self._lows = lows.astype(np.uint16, copy=False)
         self._overlay: dict[int, Container] = {}
         self._deleted: set[int] = set()
+        self._version = 0  # bumped per mutation; memo key for the
+        # vectorized aggregates (a file-parsed store carries its dense
+        # bitmap/run containers in the overlay, and recomputing the merge
+        # per call would cost an O(Nc) sort each time)
+        self._kca_cache = None
 
     # -- construction -------------------------------------------------------
 
@@ -89,7 +105,7 @@ class FrozenContainers:
         return -1
 
     def _materialize(self, i: int) -> Container:
-        vals = self._lows[self._offsets[i]:self._offsets[i + 1]]
+        vals = self._lows[self._starts[i]:self._ends[i]]
         if vals.size > ARRAY_MAX_SIZE:
             return Container.from_values(vals)  # picks bitmap
         return Container("array", vals)
@@ -117,6 +133,7 @@ class FrozenContainers:
     def __setitem__(self, key: int, c: Container) -> None:
         self._overlay[int(key)] = c
         self._deleted.discard(int(key))
+        self._version += 1
 
     def __delitem__(self, key: int) -> None:
         had = key in self
@@ -125,6 +142,7 @@ class FrozenContainers:
             self._deleted.add(int(key))
         elif not had:
             raise KeyError(key)
+        self._version += 1
 
     def pop(self, key: int, default: Any = None):
         c = self.get(key)
@@ -206,9 +224,12 @@ class FrozenContainers:
         """(keys, cardinalities) for the WHOLE store as int64 arrays with
         no Container materialization — what Fragment.row_counts and
         rank-cache building aggregate over at bulk-load scale."""
-        base_n = np.diff(self._offsets)
+        base_n = self._ends - self._starts
         if not self._overlay and not self._deleted:
             return self._keys, base_n
+        cached = self._kca_cache
+        if cached is not None and cached[0] == self._version:
+            return cached[1], cached[2]
         keep = np.ones(self._keys.size, dtype=bool)
         for k in self._deleted:
             i = self._base_idx(k)
@@ -226,8 +247,212 @@ class FrozenContainers:
         keys = np.concatenate([self._keys[keep], ov_keys])
         ns = np.concatenate([base_n[keep], ov_n])
         order = np.argsort(keys, kind="stable")
-        return keys[order], ns[order]
+        out = (keys[order], ns[order])
+        self._kca_cache = (self._version, out[0], out[1])
+        return out
 
     def total_count(self) -> int:
         keys, ns = self.key_and_count_arrays()
         return int(ns.sum())
+
+    # -- serialization (the 1B-scale snapshot path) -------------------------
+
+    def _compact_arrays(self):
+        """(keys, counts, lows, starts, ends) with the overlay/deletions
+        folded in and lows CONTIGUOUS (ends[i] == starts[i+1]) — the shape
+        the vectorized serializer wants. All paths stay array math: the
+        base gather is one fancy-index (a per-container Python loop here
+        would reintroduce the 1B-container cost this store removes), and
+        only the (small) overlay merges via per-entry splicing."""
+        keep = np.ones(self._keys.size, dtype=bool)
+        for k in self._deleted:
+            i = self._base_idx(k)
+            if i >= 0:
+                keep[i] = False
+        for k in self._overlay:
+            i = self._base_idx(k)
+            if i >= 0:
+                keep[i] = False
+        bkeys = self._keys[keep]
+        bstarts, bends = self._starts[keep], self._ends[keep]
+        counts = bends - bstarts
+        out_ends = np.cumsum(counts)
+        out_starts = out_ends - counts
+        if not self._overlay:
+            # fast path: base already contiguous from element 0 (the
+            # from_positions layout) — serialize straight from the views
+            contiguous = (keep.all() and bkeys.size > 0
+                          and int(bstarts[0]) == 0
+                          and (bkeys.size == 1
+                               or bool((bends[:-1] == bstarts[1:]).all())))
+            if contiguous:
+                return bkeys, counts, self._lows, bstarts, bends
+            # one vectorized multi-slice gather (file-parsed layouts with
+            # payload gaps, or deletions)
+            total = int(counts.sum())
+            idx = (np.arange(total, dtype=np.int64)
+                   + np.repeat(bstarts - out_starts, counts))
+            return (bkeys, counts, self._lows[idx], out_starts, out_ends)
+        # overlay present: splice its (few) containers into the flat form
+        ov = sorted((k, self._overlay[k].values())
+                    for k in self._overlay if self._overlay[k].n > 0)
+        total = int(counts.sum())
+        idx = (np.arange(total, dtype=np.int64)
+               + np.repeat(bstarts - out_starts, counts))
+        base_lows = self._lows[idx]
+        key_pieces, low_pieces, cnt_pieces = [], [], []
+        pos = 0  # index into bkeys
+        for k, vals in ov:
+            cut = int(np.searchsorted(bkeys, k))
+            if cut > pos:
+                key_pieces.append(bkeys[pos:cut])
+                cnt_pieces.append(counts[pos:cut])
+                low_pieces.append(
+                    base_lows[out_starts[pos]:out_ends[cut - 1]])
+            key_pieces.append(np.array([k], dtype=np.int64))
+            cnt_pieces.append(np.array([vals.size], dtype=np.int64))
+            low_pieces.append(vals.astype(np.uint16))
+            pos = cut
+        if pos < bkeys.size:
+            key_pieces.append(bkeys[pos:])
+            cnt_pieces.append(counts[pos:])
+            low_pieces.append(base_lows[out_starts[pos]:])
+        keys = (np.concatenate(key_pieces) if key_pieces
+                else np.empty(0, np.int64))
+        cnts = (np.concatenate(cnt_pieces) if cnt_pieces
+                else np.empty(0, np.int64))
+        lows = (np.concatenate(low_pieces) if low_pieces
+                else np.empty(0, np.uint16))
+        ends = np.cumsum(cnts)
+        starts = ends - cnts
+        return keys, cnts, lows, starts, ends
+
+    def write_pilosa(self, w) -> int:
+        """Serialize in Pilosa roaring format with NO per-container Python
+        on the hot path: metadata (desc records + offset table) is built
+        as numpy structured arrays, and payload bytes for consecutive
+        array-encoded containers are written as single contiguous slices
+        of the flat value array. Only the (rare at row-scale) containers
+        dense enough for bitmap encoding pay a per-container pack. This
+        is what makes snapshot() of a billion-row frozen fragment seconds
+        of array writes instead of hours of Container marshaling
+        (roaring.go:1387-1454 writeToUnoptimized's layout)."""
+        from pilosa_tpu.storage.roaring import (
+            HEADER_BASE_SIZE,
+            MAGIC_NUMBER,
+            STORAGE_VERSION,
+            TYPE_ARRAY,
+            TYPE_BITMAP,
+            _array_to_words,
+        )
+
+        keys, counts, lows, starts, ends = self._compact_arrays()
+        nc = keys.size
+        is_arr = counts <= ARRAY_MAX_SIZE
+        sizes = np.where(is_arr, 2 * counts, 8 * 1024)
+        desc = np.empty(nc, dtype=[("k", "<u8"), ("code", "<u2"),
+                                   ("nm1", "<u2")])
+        desc["k"] = keys.astype(np.uint64)
+        desc["code"] = np.where(is_arr, TYPE_ARRAY, TYPE_BITMAP)
+        desc["nm1"] = (counts - 1).astype(np.uint64)
+        base = HEADER_BASE_SIZE + nc * 12 + nc * 4
+        file_off = np.empty(nc, dtype=np.int64)
+        if nc:
+            np.cumsum(sizes[:-1], out=file_off[1:])
+            file_off[0] = 0
+            file_off += base
+        import struct as _struct
+
+        if nc and int(file_off[-1]) + int(sizes[-1]) > 0xFFFFFFFF:
+            # the offset table is u32 by format; fail loudly like the
+            # dict-store writer's struct.pack would, never wrap silently
+            raise ValueError(
+                f"snapshot payload region exceeds the format's 4 GiB "
+                f"offset space ({int(file_off[-1]) + int(sizes[-1])} bytes)"
+                " — split the fragment")
+        written = 0
+        written += w.write(_struct.pack("<HHI", MAGIC_NUMBER,
+                                        STORAGE_VERSION, nc))
+        written += w.write(memoryview(desc))  # no multi-GB bytes copies:
+        written += w.write(memoryview(file_off.astype("<u4")))
+        # payloads: stream runs of consecutive array containers as one
+        # buffer view; bitmap-encoded containers pack individually
+        i = 0
+        lows_le = np.ascontiguousarray(lows.astype("<u2", copy=False))
+        while i < nc:
+            if is_arr[i]:
+                j = i
+                while j < nc and is_arr[j]:
+                    j += 1
+                written += w.write(
+                    memoryview(lows_le[starts[i]:ends[j - 1]]))
+                i = j
+            else:
+                words = _array_to_words(lows[starts[i]:ends[i]])
+                written += w.write(memoryview(words.astype("<u8")))
+                i += 1
+        return written
+
+
+# threshold above which from_bytes parses straight into a frozen store
+# (per-container Python at file-open time stops being viable)
+FROZEN_PARSE_MIN = 65536
+
+
+def parse_pilosa_frozen(data, key_n: int, desc_off: int, off_off: int):
+    """Vectorized parse of a Pilosa roaring snapshot section into a
+    FrozenContainers store: metadata via zero-copy structured views,
+    array payloads as element ranges into ONE uint16 view of the buffer
+    (mmap-friendly: nothing is copied but the key/offset columns),
+    bitmap/run containers (rare at this scale) materialize into the COW
+    overlay. Returns (store, ops_offset) — the op-log tail position."""
+    from pilosa_tpu.storage.roaring import (
+        TYPE_ARRAY,
+        Container,
+        _payload_size,
+    )
+
+    desc = np.frombuffer(data, dtype=[("k", "<u8"), ("code", "<u2"),
+                                      ("nm1", "<u2")],
+                         count=key_n, offset=desc_off)
+    offs = np.frombuffer(data, dtype="<u4", count=key_n, offset=off_off)
+    counts = desc["nm1"].astype(np.int64) + 1
+    codes = desc["code"]
+    is_arr = codes == TYPE_ARRAY
+    n_bytes = len(data)
+    # bounds validation, vectorized for the array containers
+    arr_ends = offs.astype(np.int64) + 2 * counts
+    if is_arr.any():
+        bad = is_arr & ((offs.astype(np.int64) % 2 != 0)
+                        | (arr_ends > n_bytes))
+        if bad.any():
+            i = int(np.flatnonzero(bad)[0])
+            raise ValueError(
+                f"container payload out of bounds: off={int(offs[i])}, "
+                f"size={2 * int(counts[i])}, len={n_bytes}")
+    lows = np.frombuffer(data, dtype="<u2", count=n_bytes // 2)
+    keys = desc["k"].astype(np.int64)
+    starts16 = np.where(is_arr, offs.astype(np.int64) // 2, 0)
+    ends16 = starts16 + np.where(is_arr, counts, 0)
+    store = FrozenContainers(keys[is_arr], starts16[is_arr],
+                             lows, ends=ends16[is_arr])
+    ops_offset = off_off + key_n * 4  # overwritten below (key_n > 0)
+    # non-array containers into the overlay (few: bitmap/run encodings
+    # appear for dense containers — BSI planes, time views)
+    for i in np.flatnonzero(~is_arr):
+        off = int(offs[i])
+        size = _payload_size(int(codes[i]), int(counts[i]), data, off)
+        if off + size > n_bytes:
+            raise ValueError(
+                f"container payload out of bounds: off={off}, "
+                f"size={size}, len={n_bytes}")
+        c, _ = Container.from_payload(int(codes[i]), int(counts[i]),
+                                      memoryview(data)[off:])
+        store[int(keys[i])] = c
+    if key_n:
+        last = int(np.argmax(offs))
+        last_size = (2 * int(counts[last]) if is_arr[last] else
+                     _payload_size(int(codes[last]), int(counts[last]),
+                                   data, int(offs[last])))
+        ops_offset = int(offs[last]) + last_size
+    return store, ops_offset

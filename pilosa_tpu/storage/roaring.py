@@ -743,7 +743,12 @@ class Bitmap:
         """Drop empty containers and re-pick stale encodings (Container.Repair
         + Containers.Repair, roaring/roaring.go:2093-2113,106; cardinality is
         derived here, so popcount drift cannot occur). Returns containers
-        changed."""
+        changed. Stores that own their serialization (frozen) skip the
+        walk: their parse bounds-checked every container, base entries
+        cannot be empty (cardinality = desc nm1 + 1 >= 1), and encodings
+        re-pick lazily."""
+        if hasattr(self.containers, "write_pilosa"):
+            return 0
         changed = 0
         for key in list(self.containers):
             c = self.containers[key]
@@ -766,6 +771,11 @@ class Bitmap:
         optimized=True skips per-container encoding selection (serialize
         each container's current kind) — for callers that just ran
         optimize(), avoiding a second selection scan per snapshot."""
+        if hasattr(self.containers, "write_pilosa"):
+            # vectorized store-owned path: metadata as structured arrays,
+            # array payloads streamed as contiguous buffer views (a
+            # billion-container store must never marshal per container)
+            return self.containers.write_pilosa(w)
         keys = sorted(k for k, c in self.containers.items() if c.n > 0)
         encs = []
         for k in keys:
@@ -816,6 +826,18 @@ class Bitmap:
             raise ValueError(
                 f"header overruns buffer: {key_n} containers need {ops_offset} bytes, have {len(data)}"
             )
+        from pilosa_tpu.storage.frozen import (
+            FROZEN_PARSE_MIN,
+            parse_pilosa_frozen,
+        )
+
+        if lazy and key_n >= FROZEN_PARSE_MIN:
+            # billion-container files: vectorized parse into the frozen
+            # store (zero-copy array payload views over the mmap) — the
+            # per-container loop below is interpreter-bound at this scale
+            b.containers, ops_offset = parse_pilosa_frozen(
+                data, key_n, desc_off, off_off)
+            return cls._replay_ops(b, data, ops_offset)
         for i in range(key_n):
             key, code, n_minus_1 = struct.unpack_from("<QHH", data, desc_off + i * 12)
             (offset,) = struct.unpack_from("<I", data, off_off + i * 4)
@@ -834,8 +856,13 @@ class Bitmap:
                 c, consumed = Container.from_payload(code, n_minus_1 + 1, mv[offset:])
                 b._store(int(key), c)
             ops_offset = offset + consumed
-        # Trailing op-log replay — batched native parse when available
-        # (order-preserving runs applied via the bulk paths).
+        return cls._replay_ops(b, data, ops_offset)
+
+    @classmethod
+    def _replay_ops(cls, b: "Bitmap", data, ops_offset: int) -> "Bitmap":
+        """Trailing op-log replay — batched native parse when available
+        (order-preserving runs applied via the bulk paths). Shared by the
+        per-container and frozen parse paths."""
         if ops_offset < len(data):
             from pilosa_tpu import native
             parsed = native.oplog_parse(bytes(data[ops_offset:]))
@@ -942,7 +969,12 @@ class Bitmap:
         """Re-pick every container's encoding, introducing run containers
         where smallest (Bitmap.Optimize, roaring/roaring.go:1594); called at
         snapshot time. Returns containers re-encoded. Unmaterialized lazy
-        containers keep their on-disk encoding (already optimized at write)."""
+        containers keep their on-disk encoding (already optimized at write).
+        Stores that own their serialization (frozen) skip: the serializer
+        picks encodings itself, and a per-container walk defeats the
+        billion-container design."""
+        if hasattr(self.containers, "write_pilosa"):
+            return 0
         changed = 0
         for key in list(self.containers):
             c = self.containers[key]
