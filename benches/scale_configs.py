@@ -173,13 +173,23 @@ def config3(holder, ex):
         t = time.perf_counter()
         ex.execute("c3", f"Rows(field=t, previous={i * 1000}, limit=100)")
         rows_samples.append(time.perf_counter() - t)
-    emit({"config": 3, "rows": C3_ROWS, "shards": C3_SHARDS,
-          "bits": n_bits, "build_s": round(build_s, 1),
-          "topn_p50_ms": round(_p50(samples) * 1e3, 3),
-          "topn_recount_rows": recounts,
-          "rows_page100_p50_ms": round(_p50(rows_samples) * 1e3, 3),
-          "residency_bytes": res["bytes"],
-          "residency_budget": ex.residency.budget})
+    rec = {"config": 3, "rows": C3_ROWS, "shards": C3_SHARDS,
+           "bits": n_bits, "build_s": round(build_s, 1),
+           "topn_p50_ms": round(_p50(samples) * 1e3, 3),
+           "topn_recount_rows": recounts,
+           "rows_page100_p50_ms": round(_p50(rows_samples) * 1e3, 3),
+           "residency_bytes": res["bytes"],
+           "residency_budget": ex.residency.budget}
+    if os.environ.get("PILOSA_SCALE_SNAPSHOT") == "1":
+        # durable round trip at scale: vectorized snapshot of shard 0's
+        # frozen fragment + frozen reopen (storage/frozen.py write_pilosa)
+        frag = f.view("standard").fragment(0)
+        t = time.perf_counter()
+        frag.snapshot()
+        rec["snapshot_shard0_s"] = round(time.perf_counter() - t, 1)
+        rec["snapshot_shard0_gb"] = round(
+            os.path.getsize(frag.path) / 1e9, 2)
+    emit(rec)
     holder.delete_index("c3")
     ex.clear_caches()
 

@@ -1,0 +1,88 @@
+"""Star-trace walkthrough — the reference's getting-started example
+(docs/getting-started.md: a repository×stargazer/language index) against a
+live pilosa_tpu server over plain HTTP.
+
+Run:  python -m pilosa_tpu.cli server --data-dir $(mktemp -d) --bind :10101 &
+      python examples/star_trace.py [host:port]
+
+Builds the schema, loads a synthetic star trace (who starred what, when,
+in which language), then runs the tour: which repos did user X star
+(Row), intersection of two users' stars (Intersect+Count), the most
+starred repos (TopN), stars in a time window (Range), repos by language
+(GroupBy), and language stats over a BSI star-count field (Sum/Min/Max).
+"""
+
+import json
+import sys
+import urllib.request
+
+import numpy as np
+
+HOST = sys.argv[1] if len(sys.argv) > 1 else "localhost:10101"
+BASE = f"http://{HOST}"
+
+
+def post(path, body):
+    data = body if isinstance(body, bytes) else json.dumps(body).encode()
+    req = urllib.request.Request(BASE + path, data=data, method="POST")
+    with urllib.request.urlopen(req, timeout=30) as r:
+        return json.loads(r.read())
+
+
+def q(pql):
+    return post("/index/startrace/query", pql.encode())["results"]
+
+
+def main():
+    rng = np.random.default_rng(7)
+    post("/index/startrace", {})
+    post("/index/startrace/field/stargazer",
+         {"options": {"type": "time", "timeQuantum": "YMD"}})
+    post("/index/startrace/field/language", {"options": {"type": "set"}})
+    post("/index/startrace/field/stars",
+         {"options": {"type": "int", "min": 0, "max": 1_000_000}})
+
+    n_repos, n_users, n_langs = 2000, 300, 12
+    # zipf-ish star distribution over repos
+    stars_per_repo = np.maximum(1, (2000 / (np.arange(n_repos) + 2))
+                                .astype(int))
+    rows, cols, days = [], [], []
+    for repo in range(n_repos):
+        users = rng.choice(n_users, size=min(stars_per_repo[repo], n_users),
+                           replace=False)
+        rows += users.tolist()
+        cols += [repo] * users.size
+        days += rng.integers(1, 28, users.size).tolist()
+    print(f"loading {len(rows)} star events...")
+    post("/index/startrace/field/stargazer/import",
+         {"rowIDs": rows, "columnIDs": cols,
+          "timestamps": [f"2019-03-{d:02d}T00:00" for d in days]})
+    post("/index/startrace/field/language/import",
+         {"rowIDs": rng.integers(0, n_langs, n_repos).tolist(),
+          "columnIDs": list(range(n_repos))})
+    post("/index/startrace/field/stars/import-value" if False else
+         "/index/startrace/field/stars/import",
+         {"columnIDs": list(range(n_repos)),
+          "values": stars_per_repo.tolist()})
+
+    print("\n1) repos user 14 starred (first 10):")
+    print("  ", q("Row(stargazer=14)")[0]["columns"][:10])
+    print("2) repos BOTH user 14 and user 15 starred:")
+    print("  ", q("Count(Intersect(Row(stargazer=14), Row(stargazer=15)))")[0])
+    print("3) most-starred repos (TopN over the stargazer rank cache):")
+    print("  ", q("TopN(stargazer, n=3)")[0])
+    print("4) user 14's stars in the first March week:")
+    print("  ", q("Count(Range(stargazer=14, 2019-03-01T00:00,"
+                  " 2019-03-08T00:00))")[0])
+    print("5) count of repos per language (GroupBy):")
+    print("  ", q("GroupBy(Rows(field=language), limit=3)")[0])
+    print("6) total/min/max stars across repos in language 0:")
+    print("  ", q("Sum(Row(language=0), field=stars)")[0],
+          q("Min(Row(language=0), field=stars)")[0],
+          q("Max(Row(language=0), field=stars)")[0])
+    print("7) highly-starred repos (BSI range):")
+    print("  ", q("Count(Range(stars > 100))")[0])
+
+
+if __name__ == "__main__":
+    main()

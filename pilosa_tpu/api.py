@@ -350,12 +350,26 @@ class API:
         timestamps = list(timestamps) if timestamps else None
         if timestamps:
             # normalize to epoch numbers BEFORE routing: forwarded payloads
-            # are JSON and must not carry datetime objects
-            timestamps = [
-                t.replace(tzinfo=timezone.utc).timestamp()
-                if isinstance(t, datetime) and t.tzinfo is None
-                else (t.timestamp() if isinstance(t, datetime) else t)
-                for t in timestamps]
+            # are JSON and must not carry datetime objects. The reference
+            # wire uses epoch numbers; ISO-8601 strings are accepted as a
+            # convenience — anything else fails loudly instead of silently
+            # dropping the timestamp (and with it the time views)
+            def _epoch(t):
+                if isinstance(t, str):
+                    try:
+                        t = datetime.fromisoformat(t)
+                    except ValueError:
+                        raise ApiError(f"invalid import timestamp: {t!r}")
+                if isinstance(t, datetime):
+                    if t.tzinfo is None:
+                        t = t.replace(tzinfo=timezone.utc)
+                    return t.timestamp()
+                if t is None or isinstance(t, (int, float)) \
+                        and not isinstance(t, bool):
+                    return t
+                raise ApiError(f"invalid import timestamp: {t!r}")
+
+            timestamps = [_epoch(t) for t in timestamps]
         if not remote:
             row_ids, column_ids, timestamps = self._route_import(
                 index_name, field_name, row_ids, column_ids, timestamps,

@@ -369,7 +369,7 @@ class Fragment:
         if cached is None or cached[0] != self._bulk_gen:
             kpr = CONTAINERS_PER_SHARD  # container keys per row
             store = self.storage.containers
-            if hasattr(store, "key_and_count_arrays"):
+            if getattr(store, "VECTORIZED_STORE", False):
                 # frozen store: whole-corpus (row -> count) as two sorted
                 # arrays, no Container materialization, no 1-entry-per-row
                 # Python dict (at 1B rows a dict is >100 GB of objects)
@@ -435,7 +435,7 @@ class Fragment:
         if cached is None or cached[0] != self.generation:
             kpr = CONTAINERS_PER_SHARD  # container keys per row
             store = self.storage.containers
-            if hasattr(store, "key_and_count_arrays"):
+            if getattr(store, "VECTORIZED_STORE", False):
                 ids_arr = self._frozen_row_arrays(store, kpr)[0]
                 cached = (self.generation, ids_arr)
             else:
@@ -597,7 +597,7 @@ class Fragment:
         other = Bitmap.from_bytes(data)
         if clear:
             store = self.storage.containers
-            if hasattr(store, "key_and_count_arrays"):
+            if getattr(store, "VECTORIZED_STORE", False):
                 # frozen storage: difference() would materialize + copy
                 # the whole corpus; clear in place through the COW
                 # overlay, touching only the INCOMING containers. The
@@ -685,7 +685,7 @@ class Fragment:
 
         old = self.storage
         self._map()  # fresh lazy parse of the new file
-        if hasattr(old.containers, "write_pilosa"):
+        if getattr(old.containers, "VECTORIZED_STORE", False):
             # the snapshot just serialized base+overlay compacted; the
             # fresh parse covers everything, and walking a billion-entry
             # frozen store to "carry over" would materialize the corpus

@@ -52,6 +52,12 @@ class FrozenContainers:
     Fragment use to avoid materializing the corpus.
     """
 
+    # THE capability marker: every caller that special-cases this store
+    # (vectorized aggregation, store-owned serialization, skipped
+    # per-container walks) probes this one attribute — not scattered
+    # hasattr checks on unrelated method names
+    VECTORIZED_STORE = True
+
     def __init__(self, keys: np.ndarray, offsets: np.ndarray,
                  lows: np.ndarray, ends: Optional[np.ndarray] = None):
         """offsets: value-range starts per key; without `ends`, container i
@@ -432,6 +438,10 @@ def parse_pilosa_frozen(data, key_n: int, desc_off: int, off_off: int):
                 f"size={2 * int(counts[i])}, len={n_bytes}")
     lows = np.frombuffer(data, dtype="<u2", count=n_bytes // 2)
     keys = desc["k"].astype(np.int64)
+    if keys.size > 1 and not bool((np.diff(keys) > 0).all()):
+        # the store binary-searches keys: an unsorted (corrupt / foreign)
+        # desc section must fail loudly, not silently miss lookups
+        raise ValueError("container keys not strictly ascending")
     starts16 = np.where(is_arr, offs.astype(np.int64) // 2, 0)
     ends16 = starts16 + np.where(is_arr, counts, 0)
     store = FrozenContainers(keys[is_arr], starts16[is_arr],

@@ -554,7 +554,7 @@ class Bitmap:
         return b
 
     def count(self) -> int:
-        if hasattr(self.containers, "total_count"):
+        if getattr(self.containers, "VECTORIZED_STORE", False):
             return self.containers.total_count()
         return sum(c.n for c in self.containers.values())
 
@@ -747,7 +747,7 @@ class Bitmap:
         walk: their parse bounds-checked every container, base entries
         cannot be empty (cardinality = desc nm1 + 1 >= 1), and encodings
         re-pick lazily."""
-        if hasattr(self.containers, "write_pilosa"):
+        if getattr(self.containers, "VECTORIZED_STORE", False):
             return 0
         changed = 0
         for key in list(self.containers):
@@ -771,7 +771,7 @@ class Bitmap:
         optimized=True skips per-container encoding selection (serialize
         each container's current kind) — for callers that just ran
         optimize(), avoiding a second selection scan per snapshot."""
-        if hasattr(self.containers, "write_pilosa"):
+        if getattr(self.containers, "VECTORIZED_STORE", False):
             # vectorized store-owned path: metadata as structured arrays,
             # array payloads streamed as contiguous buffer views (a
             # billion-container store must never marshal per container)
@@ -973,7 +973,7 @@ class Bitmap:
         Stores that own their serialization (frozen) skip: the serializer
         picks encodings itself, and a per-container walk defeats the
         billion-container design."""
-        if hasattr(self.containers, "write_pilosa"):
+        if getattr(self.containers, "VECTORIZED_STORE", False):
             return 0
         changed = 0
         for key in list(self.containers):

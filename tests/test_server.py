@@ -1040,3 +1040,23 @@ def test_attr_sync_paginates(cluster3):
     for cid, val in ((7, "a"), (105, "b"), (250, "c"), (10_050, "d")):
         assert ca1.attrs(cid) == {"v": val}, cid
     assert ca1.attrs(199) == {"mine": 1} and ca1.attrs(399) == {"mine": 2}
+
+
+def test_import_iso_timestamps(server):
+    """Import accepts ISO-8601 timestamp strings (convenience superset of
+    the reference's epoch numbers) and lands bits in time views; junk
+    timestamps fail loudly instead of silently dropping the time views."""
+    u = server.uri
+    jpost(u, "/index/ts", {})
+    jpost(u, "/index/ts/field/t",
+          {"options": {"type": "time", "timeQuantum": "YMD"}})
+    status, _ = jpost(u, "/index/ts/field/t/import", {
+        "rowIDs": [1, 1], "columnIDs": [5, 6],
+        "timestamps": ["2019-03-02T00:00", 1551744000]})  # str + epoch
+    assert status == 200
+    _, out = jpost(u, "/index/ts/query",
+                   raw=b"Count(Range(t=1, 2019-03-01T00:00, 2019-03-10T00:00))")
+    assert out["results"] == [2], out
+    status, out = jpost(u, "/index/ts/field/t/import", {
+        "rowIDs": [1], "columnIDs": [7], "timestamps": ["not-a-time"]})
+    assert status >= 400 and "invalid import timestamp" in json.dumps(out)
