@@ -99,7 +99,22 @@ class _Parser:
             self.sp()
         return Query(calls)
 
+    # nesting bound: recursive descent must fail with a clean parse error
+    # on pathologically deep inputs, not let RecursionError escape as an
+    # internal 500 (fuzz finding; ample for real queries — the reference's
+    # deepest documented call trees are a handful of levels)
+    MAX_DEPTH = 128
+
     def call(self) -> Call:
+        self._depth = getattr(self, "_depth", 0) + 1
+        try:
+            if self._depth > self.MAX_DEPTH:
+                self.error(f"query nested deeper than {self.MAX_DEPTH}")
+            return self._call_inner()
+        finally:
+            self._depth -= 1
+
+    def _call_inner(self) -> Call:
         name = self.match(IDENT_RE)
         if name is None:
             self.error("expected call")

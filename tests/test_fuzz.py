@@ -199,3 +199,34 @@ def test_fuzz_bsi_intersect_and_sum(bsi_world):
         keep = _bsi_model(values, ">=", x)
         assert vc.count == len(keep) and \
             vc.val == sum(values[c] for c in keep), f"iteration {i}: Sum"
+
+
+def test_parser_depth_limit_and_adversarial_inputs():
+    """Every adversarial input parses or raises ValueError — never an
+    internal error type. 500-deep nesting used to escape as
+    RecursionError (a remote crash/500 vector)."""
+    import random
+    import string
+
+    from pilosa_tpu.pql.parser import parse_string
+
+    rng = random.Random(4)
+    cases = ["Union(" * 200 + "Row(f=1)" + ")" * 200,
+             "Not(" * 500 + "Row(f=1)" + ")" * 500,
+             "Row(f=99999999999999999999999999)",
+             "Set(18446744073709551615, f=1)",
+             'Row(f="héllo wörld")', 'Set("☃", f="☃")', 'Row(f="")']
+    q = 'TopN(f, Row(g=3), n=5, attrName=cat, attrValues=["a", "b"])'
+    cases += [q[:i] for i in range(1, len(q))]
+    alphabet = string.printable
+    cases += ["".join(rng.choice(alphabet)
+                      for _ in range(rng.randrange(1, 60)))
+              for _ in range(800)]
+    for c in cases:
+        try:
+            parse_string(c)
+        except ValueError:
+            pass  # the one acceptable failure type
+    # depth just under the bound still parses
+    ok = "Not(" * 100 + "Row(f=1)" + ")" * 100
+    parse_string(ok)
