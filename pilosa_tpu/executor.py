@@ -790,10 +790,30 @@ class Executor:
 
         import jax.numpy as jnp
 
-        from pilosa_tpu.ops.bitvector import intersect_count
+        from pilosa_tpu.ops.bitvector import intersect_count, popcount
         from pilosa_tpu.ops.topn import tanimoto_counts, tanimoto_mask
 
         src_flat = src_dense.reshape(-1)
+        scount = 0
+        if tanimoto:
+            # Tanimoto count bounds (fragment.go:1043-1060):
+            # tanimoto(a, b) >= T/100 requires |b| in
+            # (|src|*T/100, |src|*100/T) — rows outside the band are
+            # skipped WITHOUT materialization. The band tests EXACT row
+            # counts from container metadata, not merged cache counts: a
+            # row evicted from one shard's cache undercounts in the merge
+            # (executor.py _execute_topn recount rationale) and a stale
+            # band test would drop rows whose true tanimoto qualifies.
+            scount = int(jnp.sum(popcount(src_flat)))
+            lo = scount * tanimoto / 100
+            hi = scount * 100 / tanimoto
+            exact = self._host_row_counts(index, f, shards,
+                                          [rid for rid, _ in pairs])
+            pairs = [(rid, c) for rid, c in exact if lo < c < hi]
+        sparse = self._topn_src_sparse(index, f, shards, pairs, src_dense,
+                                       n, tanimoto, scount)
+        if sparse is not None:
+            return sparse
         # min-heap of (count, -row_id): evicts lowest count, then largest id,
         # preserving Pairs order (count desc, id asc) at the boundary
         heap: list[tuple[int, int]] = []
@@ -838,6 +858,55 @@ class Executor:
         if n is None:
             return out
         return [(-nrid, c) for c, nrid in heap]
+
+    def _topn_src_sparse(self, index: Index, f, shards,
+                         pairs: list[tuple[int, int]], src_dense, n,
+                         tanimoto: int, scount: int = 0):
+        """Sparse host path for the Src intersection ranking: batched
+        |row ∩ src| from the frozen stores' flat arrays — linear in the
+        candidates' STORED bits, not candidates × dense shard width (the
+        regime of the reference's chemical-similarity showcase, where
+        uniform fingerprint cardinalities defeat count-bound pruning and
+        every cached row must be intersected). Returns None when any
+        fragment can't take the vectorized path (mutable store / mutated
+        candidates) — the dense device walk handles those."""
+        import heapq
+
+        view = f.view(VIEW_STANDARD)
+        if view is None or not pairs:
+            return []
+        rids = [rid for rid, _ in pairs]
+        src_host = np.asarray(src_dense)  # [S', W] (pad shards are zero)
+        totals = np.zeros(len(rids), dtype=np.int64)
+        for i, s in enumerate(shards):
+            qctx.check()  # abort between shard passes, like the dense walk
+            frag = view.fragment(s)
+            if frag is None:
+                continue
+            bits = np.unpackbits(src_host[i].view(np.uint8),
+                                 bitorder="little")
+            src_cols = np.flatnonzero(bits).astype(np.int64)
+            got = frag.rows_intersection_counts(rids, src_cols)
+            if got is None:
+                return None  # fall back to the dense walk
+            totals += got
+        self.topn_recount_rows += len(rids)
+        # scount arrives from the caller when tanimoto is set; unused
+        # otherwise (no full popcount sweep for the plain-Src case)
+        out = []
+        for (rid, rcount), inter in zip(pairs, totals.tolist()):
+            if inter <= 0:
+                continue
+            if tanimoto and 100 * inter < tanimoto * (rcount + scount
+                                                      - inter):
+                continue
+            out.append((rid, inter))
+        if n is None:
+            return out
+        # top n by (count desc, id asc) — matches the dense walk's heap
+        heap = [(c, -rid) for rid, c in out]
+        top = heapq.nlargest(n, heap)
+        return [(-nrid, c) for c, nrid in top]
 
     def _host_row_counts(self, index: Index, f, shards,
                          row_ids: list[int]) -> list[tuple[int, int]]:

@@ -238,3 +238,51 @@ def test_topn_n_zero_distributed(tmp_path):
     out = ex._reduce(call, partials, idx, [0])
     assert list(out) == [(1, 2), (2, 1)]
     ex.holder.close()
+
+
+def test_topn_src_sparse_matches_dense(tmp_path):
+    """The sparse host walk (frozen stores) and the dense device walk
+    agree on TopN-with-Src results, with and without tanimotoThreshold;
+    mutated candidate rows force the dense fallback and still agree."""
+    from pilosa_tpu.constants import SHARD_WIDTH
+    from pilosa_tpu.executor import Executor
+    from pilosa_tpu.models import FieldOptions, Holder
+
+    rng = np.random.default_rng(67)
+    h = Holder(str(tmp_path / "d")).open()
+    try:
+        idx = h.create_index("sp", track_existence=False)
+        n_rows = 3000
+        rows_l, cols_l = [], []
+        sets = {}
+        for r in range(n_rows):
+            c = np.unique(rng.integers(0, 2 * SHARD_WIDTH, 40))
+            sets[r] = set(c.tolist())
+            rows_l.append(np.full(c.size, r, dtype=np.uint64))
+            cols_l.append(c.astype(np.uint64))
+        fz = idx.create_field("fz", FieldOptions(cache_size=5000))
+        fz.import_rows_frozen(np.concatenate(rows_l), np.concatenate(cols_l))
+        mu = idx.create_field("mu", FieldOptions(cache_size=5000))
+        mu.import_bits(np.concatenate(rows_l).tolist(),
+                       np.concatenate(cols_l).tolist())
+        ex = Executor(h)
+        for q in ("TopN(%s, Row(%s=7), n=15)",
+                  "TopN(%s, Row(%s=7), n=15, tanimotoThreshold=30)"):
+            (a,) = ex.execute("sp", q % ("fz", "fz"))
+            (b,) = ex.execute("sp", q % ("mu", "mu"))  # dense walk (dict)
+            assert [tuple(p) for p in a] == [tuple(p) for p in b], q
+        # brute-force check of the non-tanimoto result
+        (a,) = ex.execute("sp", "TopN(fz, Row(fz=7), n=15)")
+        brute = sorted(((len(sets[r] & sets[7]), -r) for r in range(n_rows)
+                        if sets[r] & sets[7]), reverse=True)[:15]
+        assert [tuple(p) for p in a] == [(-nr, c) for c, nr in brute]
+        # mutate a candidate row on the frozen field -> overlay forces the
+        # dense fallback for that walk; result still exact
+        ex.execute("sp", f"Set({2 * SHARD_WIDTH - 1}, fz=7)")
+        (a2,) = ex.execute("sp", "TopN(fz, Row(fz=7), n=15)")
+        sets[7].add(2 * SHARD_WIDTH - 1)
+        brute2 = sorted(((len(sets[r] & sets[7]), -r) for r in range(n_rows)
+                         if sets[r] & sets[7]), reverse=True)[:15]
+        assert [tuple(p) for p in a2] == [(-nr, c) for c, nr in brute2]
+    finally:
+        h.close()
