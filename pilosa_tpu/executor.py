@@ -711,9 +711,9 @@ class Executor:
                 keep = cand_counts >= threshold
                 cand_ids, cand_counts = cand_ids[keep], cand_counts[keep]
             if src_dense is not None:
-                cand = list(zip(cand_ids.tolist(), cand_counts.tolist()))
-                pairs = self._topn_src_walk(index, f, shards, cand,
-                                            src_dense, n, tanimoto)
+                pairs = self._topn_src_walk(index, f, shards, cand_ids,
+                                            cand_counts, src_dense, n,
+                                            tanimoto)
             else:
                 # cached counts are exact per-shard (write-maintained,
                 # view.py:141-147) but a row can be missing from a shard's
@@ -778,8 +778,8 @@ class Executor:
         return ids, counts
 
     def _topn_src_walk(self, index: Index, f, shards,
-                       pairs: list[tuple[int, int]], src_dense, n,
-                       tanimoto: int) -> list[tuple[int, int]]:
+                       cand_ids: np.ndarray, cand_counts: np.ndarray,
+                       src_dense, n, tanimoto: int) -> list[tuple[int, int]]:
         """Phase-1 intersection ranking with the reference's threshold walk
         (fragment.go:1121-1136): walk candidates in count-desc blocks,
         recount |row ∩ src| on device (ops/topn.top_rows_intersect /
@@ -807,13 +807,15 @@ class Executor:
             scount = int(jnp.sum(popcount(src_flat)))
             lo = scount * tanimoto / 100
             hi = scount * 100 / tanimoto
-            exact = self._host_row_counts(index, f, shards,
-                                          [rid for rid, _ in pairs])
-            pairs = [(rid, c) for rid, c in exact if lo < c < hi]
-        sparse = self._topn_src_sparse(index, f, shards, pairs, src_dense,
-                                       n, tanimoto, scount)
+            exact = self._host_row_count_arr(index, f, shards, cand_ids)
+            keep = (exact > lo) & (exact < hi)
+            cand_ids, cand_counts = cand_ids[keep], exact[keep]
+        sparse = self._topn_src_sparse(index, f, shards, cand_ids,
+                                       cand_counts, src_dense, n,
+                                       tanimoto, scount)
         if sparse is not None:
             return sparse
+        pairs = list(zip(cand_ids.tolist(), cand_counts.tolist()))
         # min-heap of (count, -row_id): evicts lowest count, then largest id,
         # preserving Pairs order (count desc, id asc) at the boundary
         heap: list[tuple[int, int]] = []
@@ -860,8 +862,8 @@ class Executor:
         return [(-nrid, c) for c, nrid in heap]
 
     def _topn_src_sparse(self, index: Index, f, shards,
-                         pairs: list[tuple[int, int]], src_dense, n,
-                         tanimoto: int, scount: int = 0):
+                         cand_ids: np.ndarray, cand_counts: np.ndarray,
+                         src_dense, n, tanimoto: int, scount: int = 0):
         """Sparse host path for the Src intersection ranking: batched
         |row ∩ src| from the frozen stores' flat arrays — linear in the
         candidates' STORED bits, not candidates × dense shard width (the
@@ -873,11 +875,10 @@ class Executor:
         import heapq
 
         view = f.view(VIEW_STANDARD)
-        if view is None or not pairs:
+        if view is None or cand_ids.size == 0:
             return []
-        rids = [rid for rid, _ in pairs]
         src_host = np.asarray(src_dense)  # [S', W] (pad shards are zero)
-        totals = np.zeros(len(rids), dtype=np.int64)
+        totals = np.zeros(cand_ids.size, dtype=np.int64)
         for i, s in enumerate(shards):
             qctx.check()  # abort between shard passes, like the dense walk
             frag = view.fragment(s)
@@ -886,33 +887,30 @@ class Executor:
             bits = np.unpackbits(src_host[i].view(np.uint8),
                                  bitorder="little")
             src_cols = np.flatnonzero(bits).astype(np.int64)
-            got = frag.rows_intersection_counts(rids, src_cols)
+            got = frag.rows_intersection_counts(cand_ids, src_cols)
             if got is None:
                 return None  # fall back to the dense walk
             totals += got
-        self.topn_recount_rows += len(rids)
-        # scount arrives from the caller when tanimoto is set; unused
-        # otherwise (no full popcount sweep for the plain-Src case)
-        out = []
-        for (rid, rcount), inter in zip(pairs, totals.tolist()):
-            if inter <= 0:
-                continue
-            if tanimoto and 100 * inter < tanimoto * (rcount + scount
-                                                      - inter):
-                continue
-            out.append((rid, inter))
-        if n is None:
-            return out
-        # top n by (count desc, id asc) — matches the dense walk's heap
-        heap = [(c, -rid) for rid, c in out]
-        top = heapq.nlargest(n, heap)
-        return [(-nrid, c) for c, nrid in top]
+        self.topn_recount_rows += int(cand_ids.size)
+        # array-native filter + rank (a Python tuple loop over 100k+
+        # candidates was a measurable share of the walk)
+        keep = totals > 0
+        if tanimoto:
+            # scount arrives from the caller; cand_counts are EXACT here
+            # (the band recounted them)
+            keep &= 100 * totals >= tanimoto * (cand_counts + scount
+                                                - totals)
+        ids, counts = cand_ids[keep], totals[keep]
+        if n is not None and ids.size > n:
+            # top n by (count desc, id asc) — matches the dense walk
+            order = np.lexsort((ids, -counts))[:n]
+            ids, counts = ids[order], counts[order]
+        return list(zip(ids.tolist(), counts.tolist()))
 
-    def _host_row_counts(self, index: Index, f, shards,
-                         row_ids: list[int]) -> list[tuple[int, int]]:
+    def _host_row_count_arr(self, index: Index, f, shards,
+                            row_ids) -> np.ndarray:
         """Exact full-row counts from container metadata — one vectorized
-        Fragment.row_counts call per shard (each a dict probe per row over
-        a generation-cached row->count map), zero dense materialization
+        Fragment.row_counts call per shard, zero dense materialization
         (fragment.go top RowIDs path via row().Count())."""
         view = f.view(VIEW_STANDARD)
         totals = np.zeros(len(row_ids), dtype=np.int64)
@@ -921,6 +919,11 @@ class Executor:
                 frag = view.fragment(s)
                 if frag is not None:
                     totals += frag.row_counts(row_ids)
+        return totals
+
+    def _host_row_counts(self, index: Index, f, shards,
+                         row_ids: list[int]) -> list[tuple[int, int]]:
+        totals = self._host_row_count_arr(index, f, shards, row_ids)
         return [(rid, int(c)) for rid, c in zip(row_ids, totals)]
 
     def _exact_counts(self, index: Index, f, shards, row_ids: list[int],

@@ -141,7 +141,8 @@ def test_pow2():
     assert [_pow2(n) for n in (1, 2, 3, 5, 8, 9)] == [1, 2, 4, 8, 8, 16]
 
 
-def test_executor_count_uses_batcher(tmp_path):
+def test_executor_count_uses_batcher(tmp_path, monkeypatch):
+    monkeypatch.setenv("PILOSA_TPU_BATCH", "1")  # asserts batcher behavior
     from pilosa_tpu.executor import Executor
     from pilosa_tpu.models import Holder
 
@@ -212,7 +213,8 @@ def test_plane_sum_batcher_concurrent():
     assert snap["batches"] < 24  # coalescing happened
 
 
-def test_executor_concurrent_sums_batch(tmp_path):
+def test_executor_concurrent_sums_batch(tmp_path, monkeypatch):
+    monkeypatch.setenv("PILOSA_TPU_BATCH", "1")  # asserts batcher behavior
     from pilosa_tpu.executor import Executor
     from pilosa_tpu.models import FieldOptions, FieldType, Holder
 
@@ -260,7 +262,8 @@ def test_executor_batcher_disabled(tmp_path, monkeypatch):
     holder.close()
 
 
-def test_executor_concurrent_min_max_batch(tmp_path):
+def test_executor_concurrent_min_max_batch(tmp_path, monkeypatch):
+    monkeypatch.setenv("PILOSA_TPU_BATCH", "1")  # asserts batcher behavior
     from pilosa_tpu.executor import Executor
     from pilosa_tpu.models import FieldOptions, FieldType, Holder
 

@@ -195,7 +195,8 @@ def test_http_server_concurrent_clients(tmp_path):
         srv.close()
 
 
-def test_executor_sums_vs_value_writes(tmp_path):
+def test_executor_sums_vs_value_writes(tmp_path, monkeypatch):
+    monkeypatch.setenv("PILOSA_TPU_BATCH", "1")  # exercises the batched Sum path
     """Batched BSI Sums racing SetValue writes on fresh columns: sums are
     append-only so both val and count must be monotone, and the plane-slab
     residency cache must never serve a torn slab."""
