@@ -1139,69 +1139,124 @@ class Server:
         return merged
 
     def _sync_fragment(self, iname: str, fname: str, vname: str, shard: int) -> int:
+        """Majority-consensus fragment sync (syncBlock, fragment.go:2271-2356):
+        fetch each out-of-sync block's pairset from EVERY reachable replica,
+        run ONE merge with majorityN = (configured replicas + 1)//2, apply
+        local sets AND clears, and push both delta directions to each peer
+        (clears ride import_roaring(clear=True)). The threshold comes from
+        the CONFIGURED replica count, and whenever any configured replica
+        didn't vote (unreachable, marked down, deleted schema) the merge
+        falls back to union — so clears only ever happen on the full
+        replica set's evidence, and a dropped voter can never let a
+        minority outvote the true majority."""
+        import numpy as np
+        from pilosa_tpu.storage.roaring import Bitmap
+        from pilosa_tpu.constants import SHARD_WIDTH
+
         frag = self.holder.index(iname).field(fname).view(vname).fragment(shard)
         if frag is None:
             return 0
-        local_blocks = dict(frag.blocks())
-        merged = 0
-        adopted = False  # any peer pairs merged in -> snapshot for the WAL
+        # collect every reachable replica's block-checksum map up front
+        peers = []  # (node, {blk: checksum-hex}, has_fragment)
         for node in self.cluster.shard_nodes(iname, shard):
             if node.id == self.node_id or not node.uri \
                     or self.cluster.is_down(node.id):
                 continue
-            peer_has_fragment = True
             try:
                 remote = {b["id"]: b["checksum"]
                           for b in self.client.fragment_blocks(
                               node.uri, iname, fname, vname, shard)}
+                has_fragment = True
             except ClientError as e:
                 if e.code != "fragment-not-found":
                     # a missing *index/field* on the peer means it was
                     # deleted there (we missed the broadcast while down):
                     # do NOT push — that would churn RPCs against the
-                    # deleted schema every pass
+                    # deleted schema every pass. An unreachable peer is
+                    # likewise excluded: it can't vote or receive deltas.
                     continue
                 # peer owns the shard but has no fragment at all (e.g. it
-                # was down for the write that created it): every local
-                # block is local-only — push them all, creating the
+                # was down for the write that created it): it votes with
+                # empty blocks, and the set-deltas we push create the
                 # fragment remotely via the import
-                remote = {}
-                peer_has_fragment = False
-            for blk in set(local_blocks) | set(remote):
-                lc = local_blocks.get(blk)
-                if lc is not None and remote.get(blk) == lc.hex():
-                    continue
-                if not peer_has_fragment:
-                    data = {}  # proven absent: skip the per-block 404 RPC
+                remote, has_fragment = {}, False
+            peers.append((node, remote, has_fragment))
+        if not peers:
+            return 0
+        # clears need the FULL replica set's evidence: if any configured
+        # replica isn't voting (down, unreachable, schema gone), fall back
+        # to union (majority_n=1) instead of letting the remaining voters
+        # clear bits the absent replica may hold the majority with
+        configured = min(self.cluster.replica_n, len(self.cluster.nodes))
+        if len(peers) + 1 == configured:
+            majority_n = (configured + 1) // 2
+        else:
+            majority_n = 1
+        local_blocks = dict(frag.blocks())
+        all_blocks = set(local_blocks)
+        for _, remote, _ in peers:
+            all_blocks |= set(remote)
+        merged = 0
+        adopted = False  # any local change -> snapshot for the WAL
+        sw = np.uint64(SHARD_WIDTH)
+        for blk in sorted(all_blocks):
+            lc = local_blocks.get(blk)
+            if lc is not None and all(remote.get(blk) == lc.hex()
+                                      for _, remote, _ in peers):
+                continue
+            # every peer votes: absent block (or absent fragment) = empty
+            # set; a peer whose checksum matches local holds by definition
+            # the same pairs — vote local's copy, skip the RPC
+            local_vote = None  # lazily built local position array
+            voters, positions = [], []
+            fetch_failed = False
+            for node, remote, has_fragment in peers:
+                if not has_fragment or blk not in remote:
+                    pos = np.empty(0, dtype=np.uint64)
+                elif lc is not None and remote.get(blk) == lc.hex():
+                    if local_vote is None:
+                        lr, lcols = frag.block_data(blk)
+                        local_vote = lr.astype(np.uint64) * sw \
+                            + lcols.astype(np.uint64)
+                    pos = local_vote
                 else:
                     try:
                         data = self.client.block_data(node.uri, iname, fname,
                                                       vname, shard, blk)
                     except ClientError as e:
                         if e.status != 404:
-                            continue
-                        data = {}  # block raced away: all pairs push
-                import numpy as np
-                sets_r, sets_c, n_adopted = frag.merge_block(
-                    blk, np.array(data.get("rowIDs", []), dtype=np.int64),
-                    np.array(data.get("columnIDs", []), dtype=np.int64))
-                adopted |= n_adopted > 0
-                merged += 1
-                # push local-only pairs back to the peer
-                if sets_r.size:
-                    from pilosa_tpu.storage.roaring import Bitmap
-                    from pilosa_tpu.constants import SHARD_WIDTH
-                    positions = sets_r.astype(np.uint64) * np.uint64(SHARD_WIDTH) \
-                        + sets_c.astype(np.uint64)
-                    payload = Bitmap(positions).to_bytes()
+                            # a correct majority needs this replica's vote;
+                            # skip the block this pass rather than clear on
+                            # partial evidence
+                            fetch_failed = True
+                            break
+                        data = {}  # block raced away: empty vote
+                    pos = (np.array(data.get("rowIDs", []), dtype=np.uint64)
+                           * sw
+                           + np.array(data.get("columnIDs", []),
+                                      dtype=np.uint64))
+                voters.append(node)
+                positions.append(pos)
+            if fetch_failed:
+                continue
+            n_sets, n_clears, deltas = frag.merge_block_majority(
+                blk, positions, majority_n=majority_n)
+            adopted |= (n_sets + n_clears) > 0
+            merged += 1
+            for node, (peer_sets, peer_clears) in zip(voters, deltas):
+                for delta, clear in ((peer_sets, False), (peer_clears, True)):
+                    if not delta.size:
+                        continue
+                    payload = Bitmap(delta).to_bytes()
                     try:
-                        self.client.import_roaring(node.uri, iname, fname, shard,
-                                                   {vname: payload}, remote=True)
+                        self.client.import_roaring(
+                            node.uri, iname, fname, shard, {vname: payload},
+                            remote=True, clear=clear)
                     except ClientError:
                         pass
         if adopted:
             # merge_block bulk-adds bypass the op-log; one snapshot per sync
-            # pass makes the adopted pairs durable (same contract as the
+            # pass makes the merged state durable (same contract as the
             # bulk import paths)
             frag.snapshot()
         return merged

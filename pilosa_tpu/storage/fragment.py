@@ -787,32 +787,78 @@ class Fragment:
         return rows, cols
 
     @_locked
-    def merge_block(self, blk: int, peer_rows: np.ndarray, peer_cols: np.ndarray):
-        """3-way-ish merge: adopt the union of local and peer pairs; returns
-        (sets_for_peer_rows, sets_for_peer_cols, n_adopted) — the deltas the
-        caller pushes back plus how many peer pairs were merged in locally
-        (mergeBlock, fragment.go:1323-1443 — reference streams sorted
-        pairsets). Vectorized as sorted position-array set difference: a
-        100-row block can hold up to 100 * 2^20 pairs, and building Python
-        tuple-sets of those froze anti-entropy at BASELINE scale."""
+    def merge_block_majority(self, blk: int, peer_positions: list,
+                             majority_n: Optional[int] = None):
+        """Majority-consensus merge of one 100-row block across ALL replicas
+        at once (mergeBlock, fragment.go:1323-1443; driven per-replica-set by
+        syncBlock, fragment.go:2271-2356).
+
+        `peer_positions` holds one uint64 position array per peer replica
+        (a peer with no data in the block contributes an empty array — it
+        still votes). The target state is every (row, col) pair present on
+        at least majorityN = (replicas+1)//2 replicas, local included. With
+        one peer that degenerates to union (majorityN=1, no clears) — the
+        same grace the reference gets from its 2-replica majority. With
+        >=3 replicas, a bit cleared on a majority STAYS cleared (the stale
+        replica clears it locally instead of resurrecting it cluster-wide),
+        and minority stray bits are removed. Callers that know the
+        CONFIGURED replica count pass `majority_n` explicitly so an
+        unreachable replica can't shrink the threshold below the true
+        majority (server._sync_fragment falls back to union — majority_n=1
+        — whenever any configured replica didn't vote).
+
+        Applies the local sets AND clears in place, then returns
+        (n_local_sets, n_local_clears, deltas) where deltas[i] is the
+        (set_positions, clear_positions) pair the caller pushes to peer i
+        (fragment.go:1407-1417 emits both directions per replica).
+        Vectorized as sorted position-array set algebra: a 100-row block can
+        hold up to 100 * 2^20 pairs, and building Python tuple-sets of those
+        froze anti-entropy at BASELINE scale."""
         local_rows, local_cols = self.block_data(blk)
         sw = np.uint64(SHARD_WIDTH)
         local_pos = local_rows.astype(np.uint64) * sw \
             + local_cols.astype(np.uint64)
+        votes = [np.unique(np.asarray(p, dtype=np.uint64))
+                 for p in peer_positions]
+        votes.insert(0, local_pos)  # block_data is already sorted-unique
+        if majority_n is None:
+            majority_n = (len(votes) + 1) // 2
+        uniq, counts = np.unique(np.concatenate(votes), return_counts=True)
+        target = uniq[counts >= majority_n]
+        deltas = []
+        for posarr in votes:
+            deltas.append((np.setdiff1d(target, posarr),
+                           np.setdiff1d(posarr, target)))
+        local_sets, local_clears = deltas[0]
+        if local_sets.size:
+            # bulk adds/removes bypass the op-log; callers that need the
+            # merged state durable snapshot once per sync pass
+            # (server._sync_fragment), the same WAL contract as the bulk
+            # import paths
+            self.storage.add_many(local_sets)
+        if local_clears.size:
+            self.storage.remove_many(local_clears)
+        if local_sets.size or local_clears.size:
+            changed = np.concatenate([local_sets, local_clears])
+            for rid in np.unique(changed // sw):
+                self._touch(int(rid))
+        return int(local_sets.size), int(local_clears.size), deltas[1:]
+
+    @_locked
+    def merge_block(self, blk: int, peer_rows: np.ndarray, peer_cols: np.ndarray):
+        """2-replica merge: with a single peer the majority threshold is 1,
+        so this is the union merge (mergeBlock, fragment.go:1366 with
+        len(sets)==2); returns (sets_for_peer_rows, sets_for_peer_cols,
+        n_adopted) — the deltas the caller pushes back plus how many peer
+        pairs were merged in locally."""
+        sw = np.uint64(SHARD_WIDTH)
         peer_pos = np.asarray(peer_rows, dtype=np.uint64) * sw \
             + np.asarray(peer_cols, dtype=np.uint64)
-        missing_local = np.setdiff1d(peer_pos, local_pos)  # sorted, unique
-        missing_peer = np.setdiff1d(local_pos, peer_pos)
-        if missing_local.size:
-            # bulk adds bypass the op-log; callers that need the adopted
-            # pairs durable snapshot once per sync pass (server._sync_
-            # fragment), the same WAL contract as the bulk import paths
-            self.storage.add_many(missing_local)
-            for rid in np.unique(missing_local // sw):
-                self._touch(int(rid))
-        return ((missing_peer // sw).astype(np.int64),
-                (missing_peer % sw).astype(np.int64),
-                int(missing_local.size))
+        n_sets, _n_clears, deltas = self.merge_block_majority(blk, [peer_pos])
+        peer_sets, _peer_clears = deltas[0]
+        return ((peer_sets // sw).astype(np.int64),
+                (peer_sets % sw).astype(np.int64),
+                n_sets)
 
     # -- archive streaming for resize copies (fragment.go:1823-1998) --------
 

@@ -166,6 +166,50 @@ def test_blocks_and_merge(frag, tmp_path):
     peer.close()
 
 
+def test_merge_block_majority(frag):
+    """3-replica consensus: pairs on >= 2 of 3 replicas win; local applies
+    sets AND clears; per-peer deltas carry both directions
+    (fragment.go:1366, 1407-1417)."""
+    import numpy as np
+
+    def pos(r, c):
+        from pilosa_tpu.constants import SHARD_WIDTH
+        return np.uint64(r) * np.uint64(SHARD_WIDTH) + np.uint64(c)
+
+    # local: {A, B};  peer1: {B, C};  peer2: {C}
+    # counts: A=1 (clear), B=2 (keep), C=2 (local must adopt)
+    frag.set_bit(0, 1)   # A, local-only stray
+    frag.set_bit(0, 2)   # B
+    peer1 = np.array([pos(0, 2), pos(0, 3)], dtype=np.uint64)  # B, C
+    peer2 = np.array([pos(0, 3)], dtype=np.uint64)             # C
+    n_sets, n_clears, deltas = frag.merge_block_majority(0, [peer1, peer2])
+    assert n_sets == 1 and n_clears == 1
+    assert not frag.contains(0, 1)   # minority stray cleared locally
+    assert frag.contains(0, 2)
+    assert frag.contains(0, 3)       # majority pair adopted
+    # peer1 already matches the target {B, C}: no deltas
+    p1_sets, p1_clears = deltas[0]
+    assert p1_sets.size == 0 and p1_clears.size == 0
+    # peer2 is missing B: one set delta, no clears
+    p2_sets, p2_clears = deltas[1]
+    assert p2_sets.tolist() == [int(pos(0, 2))]
+    assert p2_clears.size == 0
+
+
+def test_merge_block_majority_two_replicas_is_union(frag):
+    """With a single peer the majority threshold is 1 — union, no clears."""
+    import numpy as np
+    from pilosa_tpu.constants import SHARD_WIDTH
+    frag.set_bit(0, 1)
+    peer = np.array([np.uint64(7)], dtype=np.uint64)  # row 0, col 7
+    n_sets, n_clears, deltas = frag.merge_block_majority(0, [peer])
+    assert n_sets == 1 and n_clears == 0
+    assert frag.contains(0, 1) and frag.contains(0, 7)
+    sets, clears = deltas[0]
+    assert sets.tolist() == [int(np.uint64(0) * np.uint64(SHARD_WIDTH) + np.uint64(1))]
+    assert clears.size == 0
+
+
 def test_tar_roundtrip(frag, tmp_path):
     frag.bulk_import([0, 1, 2], [1, 2, 3])
     buf = io.BytesIO()

@@ -327,6 +327,83 @@ def test_liveness_detects_crashed_node(cluster3):
     assert s0.cluster.node_by_id(s2.node_id).state == "READY"
 
 
+@pytest.fixture
+def cluster3_r3(tmp_path):
+    """3 nodes, ReplicaN=3: every node owns every shard — the consensus
+    configuration (fragment.go:1366 majorityN kicks in at 3 replicas)."""
+    servers = []
+    for i in range(3):
+        s = Server(str(tmp_path / f"r3n{i}"), port=0, replica_n=3).open()
+        servers.append(s)
+    uris = [s.uri for s in servers]
+    for s in servers:
+        s.cluster_hosts = uris
+        s.refresh_membership()
+    yield servers
+    for s in servers:
+        s.close()
+
+
+def _frag(server, index="i", field="f", view="standard", shard=0):
+    return server.holder.index(index).field(field).view(view).fragment(shard)
+
+
+def test_majority_sync_clear_stays_cleared(cluster3_r3):
+    """A bit cleared on 2 of 3 replicas must STAY cleared after anti-entropy
+    — the stale replica adopts the clear instead of resurrecting the bit
+    cluster-wide (mergeBlock majority + clear deltas,
+    fragment.go:1366, 1407-1417)."""
+    s0, s1, s2 = cluster3_r3
+    jpost(s0.uri, "/index/i", {})
+    jpost(s0.uri, "/index/i/field/f", {})
+    jpost(s0.uri, "/index/i/query", raw=b"Set(5, f=1)")
+    jpost(s0.uri, "/index/i/query", raw=b"Set(6, f=1)")  # keeps block nonempty
+    for s in cluster3_r3:
+        assert _frag(s).contains(1, 5), "replication should reach all 3"
+    # two replicas clear the bit directly (simulating a clear the third
+    # replica missed while down)
+    _frag(s0).clear_bit(1, 5)
+    _frag(s1).clear_bit(1, 5)
+    # sync FROM the stale node — the worst case: union semantics would push
+    # its stale bit back onto the two cleared replicas
+    assert s2.sync_holder() > 0
+    for s in cluster3_r3:
+        assert not _frag(s).contains(1, 5), f"bit resurrected on {s.uri}"
+        assert _frag(s).contains(1, 6), f"innocent bit lost on {s.uri}"
+    # steady state: another pass from any node moves nothing
+    assert _frag(s0).contains(1, 6)
+
+
+def test_majority_sync_removes_minority_stray(cluster3_r3):
+    """A bit present on only 1 of 3 replicas (below majority) is removed
+    from that replica by its own sync pass, not propagated."""
+    s0, s1, s2 = cluster3_r3
+    jpost(s0.uri, "/index/i", {})
+    jpost(s0.uri, "/index/i/field/f", {})
+    jpost(s0.uri, "/index/i/query", raw=b"Set(10, f=2)")
+    _frag(s0).set_bit(2, 77)  # local-only stray, bypassing replication
+    assert s0.sync_holder() > 0
+    for s in cluster3_r3:
+        assert not _frag(s).contains(2, 77), f"stray bit spread to {s.uri}"
+        assert _frag(s).contains(2, 10)
+
+
+def test_majority_sync_union_with_two_replicas(cluster3_r3):
+    """With one reachable peer (one replica down), the majority threshold is
+    1 — union semantics, no clears on partial evidence."""
+    s0, s1, s2 = cluster3_r3
+    jpost(s0.uri, "/index/i", {})
+    jpost(s0.uri, "/index/i/field/f", {})
+    jpost(s0.uri, "/index/i/query", raw=b"Set(3, f=4)")
+    _frag(s0).clear_bit(4, 3)  # s0 cleared; s1 holds the bit; s2 marked down
+    s0.cluster.mark_down(s2.node_id)
+    assert s0.sync_holder() > 0
+    # only 2 voters -> union: the bit comes BACK to s0 rather than being
+    # cleared on s1 off partial evidence
+    assert _frag(s0).contains(4, 3)
+    assert _frag(s1).contains(4, 3)
+
+
 def test_anti_entropy_heals_divergence(cluster3):
     s0, s1, s2 = cluster3
     jpost(s0.uri, "/index/i", {})
