@@ -1205,20 +1205,22 @@ class Server:
                                       for _, remote, _ in peers):
                 continue
             # every peer votes: absent block (or absent fragment) = empty
-            # set; a peer whose checksum matches local holds by definition
-            # the same pairs — vote local's copy, skip the RPC
-            local_vote = None  # lazily built local position array
+            # set; identical checksums mean identical pairsets, so each
+            # DISTINCT checksum is fetched once — a peer matching local
+            # votes the local copy, peers matching each other share one
+            # fetch (each still votes individually)
+            by_checksum: dict = {}
+            if lc is not None:
+                lr, lcols = frag.block_data(blk)
+                by_checksum[lc.hex()] = (lr.astype(np.uint64) * sw
+                                         + lcols.astype(np.uint64))
             voters, positions = [], []
             fetch_failed = False
             for node, remote, has_fragment in peers:
                 if not has_fragment or blk not in remote:
                     pos = np.empty(0, dtype=np.uint64)
-                elif lc is not None and remote.get(blk) == lc.hex():
-                    if local_vote is None:
-                        lr, lcols = frag.block_data(blk)
-                        local_vote = lr.astype(np.uint64) * sw \
-                            + lcols.astype(np.uint64)
-                    pos = local_vote
+                elif remote[blk] in by_checksum:
+                    pos = by_checksum[remote[blk]]
                 else:
                     try:
                         data = self.client.block_data(node.uri, iname, fname,
@@ -1230,11 +1232,15 @@ class Server:
                             # partial evidence
                             fetch_failed = True
                             break
-                        data = {}  # block raced away: empty vote
-                    pos = (np.array(data.get("rowIDs", []), dtype=np.uint64)
-                           * sw
-                           + np.array(data.get("columnIDs", []),
-                                      dtype=np.uint64))
+                        data = None  # block raced away: empty vote
+                    if data is None:
+                        pos = np.empty(0, dtype=np.uint64)
+                    else:
+                        pos = (np.array(data.get("rowIDs", []),
+                                        dtype=np.uint64) * sw
+                               + np.array(data.get("columnIDs", []),
+                                          dtype=np.uint64))
+                        by_checksum[remote[blk]] = pos
                 voters.append(node)
                 positions.append(pos)
             if fetch_failed:

@@ -514,20 +514,27 @@ class Fragment:
     def rows_for_column(self, column: int) -> list[int]:
         """Row ids with this column's bit set — the reference's mutex column
         probe (rowsVector.Get → rows(0, filterColumn(col)),
-        fragment.go:2446-2455). Only the single candidate container per row
-        (key ≡ col>>16 mod keys-per-row) is probed, so a mutex write costs
-        one membership test per *existing* candidate container instead of a
-        full per-row scan over every row id."""
+        fragment.go:2446-2455). The reference walks EVERY container through
+        filterColumn (fragment.go:2016-2023, 2062-2106); here the candidate
+        keys (key ≡ col>>16 mod keys-per-row) are selected with one
+        vectorized mask over the store's key array and probed with one
+        batched membership call — no per-key Python loop, so a single
+        mutex set_bit against a frozen corpus-scale fragment stays in
+        milliseconds."""
         col = column % SHARD_WIDTH
         keys_per_row = CONTAINERS_PER_SHARD
         sub, low = col >> 16, col & 0xFFFF
-        out: list[int] = []
-        for key in self.storage.containers:
-            if key % keys_per_row == sub and self.storage.contains(
-                    (key << 16) | low):
-                out.append(key // keys_per_row)
-        out.sort()
-        return out
+        store = self.storage.containers
+        if getattr(store, "VECTORIZED_STORE", False):
+            keys = store.key_and_count_arrays()[0]
+        else:
+            keys = np.fromiter(store.keys(), np.int64, len(store))
+        cand = keys[keys % keys_per_row == sub]
+        if cand.size == 0:
+            return []
+        positions = (cand.astype(np.uint64) << np.uint64(16)) | np.uint64(low)
+        mask = self.storage.contains_many(positions)
+        return np.sort(cand[mask] // keys_per_row).tolist()
 
     def bit_count(self) -> int:
         return self.storage.count()
@@ -568,26 +575,50 @@ class Fragment:
         """Mutex bulk set path: last write wins per column, and every other
         row's bit for a written column is cleared — preserving the
         one-row-per-column invariant under bulk load (bulkImportMutex,
-        fragment.go:1535-1622)."""
-        target: dict[int, int] = {}
-        for r, c in zip(row_ids, columns):
-            target[int(c) % SHARD_WIDTH] = int(r)
-        if not target:
+        fragment.go:1535-1622). The reference probes the mutex vector per
+        bit (a rows(filterColumn) container walk each); here the mutex
+        invariant bounds total fragment bits by the column space, so ALL
+        existing bits are materialized once (one array op) and the
+        stale-row clears fall out of pure set algebra — O(bits + batch),
+        no per-row or per-bit loop."""
+        rows = np.asarray(list(row_ids), dtype=np.uint64)
+        cols = np.asarray(list(columns), dtype=np.uint64) % np.uint64(SHARD_WIDTH)
+        if rows.size != cols.size:
+            raise ValueError("row/column length mismatch")
+        if rows.size == 0:
             return
-        cols = np.fromiter(target.keys(), dtype=np.uint64)
-        for rid in self.row_ids():
-            # probe just the written columns in this row — O(batch), not
-            # O(row cardinality)
-            cands = np.uint64(rid) * np.uint64(SHARD_WIDTH) + cols
-            mask = self.storage.contains_many(cands)
-            if mask.any():
-                self.storage.remove_many(cands[mask])
-                self._touch(rid)
-        positions = np.array(
-            [r * SHARD_WIDTH + c for c, r in target.items()], dtype=np.uint64)
-        self.storage.add_many(positions)
-        for rid in set(target.values()):
-            self._touch(rid)
+        # last write per column wins: first occurrence in the reversed
+        # arrays is the last in import order
+        ucols, ridx = np.unique(cols[::-1], return_index=True)
+        target_rows = rows[::-1][ridx]  # aligned with ucols (sorted)
+        # existing bits in any written column that point at a different row
+        all_pos = self.storage.positions()
+        all_cols = all_pos % np.uint64(SHARD_WIDTH)
+        sel = np.isin(all_cols, ucols)
+        cand_pos = all_pos[sel]
+        want = target_rows[np.searchsorted(
+            ucols, cand_pos % np.uint64(SHARD_WIDTH))]
+        to_clear = cand_pos[cand_pos // np.uint64(SHARD_WIDTH) != want]
+        add_pos = target_rows * np.uint64(SHARD_WIDTH) + ucols
+        store = self.storage.containers
+        if getattr(store, "VECTORIZED_STORE", False):
+            # frozen store: a wide mutex rewrite touches ~one container per
+            # bit, and the generic remove_many/add_many pay a Python loop
+            # plus an overlay entry per container. The mutex invariant
+            # bounds total bits by the column space, so rebuilding the flat
+            # arrays from the final position set is pure O(bits) array math
+            from pilosa_tpu.storage.frozen import FrozenContainers
+            final = np.union1d(
+                np.setdiff1d(all_pos, to_clear, assume_unique=True), add_pos)
+            self.storage.containers = FrozenContainers.from_positions(final)
+        else:
+            if to_clear.size:
+                self.storage.remove_many(to_clear)
+            self.storage.add_many(add_pos)
+        touched = np.unique(np.concatenate(
+            [to_clear // np.uint64(SHARD_WIDTH), target_rows]))
+        for rid in touched.tolist():
+            self._touch(int(rid))
         self._maybe_snapshot()
 
     @_locked

@@ -85,9 +85,28 @@ class FrozenContainers:
 
     # -- construction -------------------------------------------------------
 
+    # a container leaves the flat lows for a run-encoded overlay entry only
+    # when the run form is at least this many times smaller than the array
+    # form AND the container is big enough for the dict entry to pay off —
+    # sequential/fully-set shapes (existence rows, time views) qualify,
+    # random sparse data never does (countRuns heuristic,
+    # /root/reference/roaring/roaring.go:1261,1594 — tuned for a store
+    # whose base cost is flat uint16 arrays, not per-container objects)
+    RUNIFY_MIN_CARD = 4096
+    RUNIFY_FACTOR = 8
+
     @classmethod
     def from_positions(cls, positions: np.ndarray) -> "FrozenContainers":
-        """Sorted-unique uint64 bit positions -> frozen store, all numpy."""
+        """Sorted-unique uint64 bit positions -> frozen store, all numpy.
+
+        Runny containers (long consecutive stretches) are detected with one
+        vectorized diff pass and stored run-encoded in the overlay instead
+        of inflating the flat lows: a fully-set existence container costs
+        one (0, 65535) interval, not 128 KiB of uint16s — at a 1B-column
+        corpus that is the difference between KBs and GBs of RSS for the
+        existence/time views."""
+        from pilosa_tpu.storage.roaring import Container
+
         positions = np.asarray(positions, dtype=np.uint64)
         keys64 = (positions >> np.uint64(16)).astype(np.int64)
         lows = (positions & np.uint64(0xFFFF)).astype(np.uint16)
@@ -95,6 +114,49 @@ class FrozenContainers:
         offsets = np.empty(ukeys.size + 1, dtype=np.int64)
         offsets[:-1] = starts
         offsets[-1] = keys64.size
+        if positions.size:
+            counts = np.diff(offsets)
+            # element i starts a run unless it continues element i-1 within
+            # the same container
+            run_start = np.ones(positions.size, dtype=bool)
+            run_start[1:] = np.diff(positions) != 1
+            run_start[starts] = True
+            nruns = np.add.reduceat(run_start, offsets[:-1])
+            runny = ((counts >= cls.RUNIFY_MIN_CARD)
+                     & (nruns * cls.RUNIFY_FACTOR * 2 <= counts))
+            if runny.any():
+                start_idx = np.flatnonzero(run_start)
+                # run r spans [start_idx[r], next start or container end)
+                run_container = np.searchsorted(
+                    offsets[:-1], start_idx, side="right") - 1
+                run_last = np.empty(start_idx.size, dtype=np.int64)
+                run_last[:-1] = start_idx[1:] - 1
+                run_last[-1] = positions.size - 1
+                # runs never span containers (run_start forced at starts),
+                # so clipping to the container end is already implied
+                # run_container is non-decreasing, so each runny
+                # container's runs are one contiguous slice — two binary
+                # searches per container, never a full rescan
+                overlay_items = []
+                for ci in np.flatnonzero(runny):
+                    lo = np.searchsorted(run_container, ci, side="left")
+                    hi = np.searchsorted(run_container, ci, side="right")
+                    iv = np.stack([lows[start_idx[lo:hi]],
+                                   lows[run_last[lo:hi]]], axis=1)
+                    overlay_items.append((int(ukeys[ci]),
+                                          Container("run", iv)))
+                keep = ~runny
+                keep_elems = np.repeat(keep, counts)
+                lows = lows[keep_elems]
+                kept_counts = counts[keep]
+                offsets = np.empty(int(keep.sum()) + 1, dtype=np.int64)
+                offsets[0] = 0
+                np.cumsum(kept_counts, out=offsets[1:])
+                ukeys = ukeys[keep]
+                store = cls(ukeys, offsets, lows)
+                for k, c in overlay_items:
+                    store._overlay[k] = c
+                return store
         return cls(ukeys, offsets, lows)
 
     @classmethod
@@ -261,15 +323,91 @@ class FrozenContainers:
         keys, ns = self.key_and_count_arrays()
         return int(ns.sum())
 
+    def all_positions(self) -> np.ndarray:
+        """Every set position as one sorted uint64 array, pure array math
+        (no Container materialization): repeat each key over its
+        cardinality and OR in the flat lows."""
+        keys, counts, lows, _starts, _ends = self._compact_arrays()
+        if keys.size == 0:
+            return np.empty(0, dtype=np.uint64)
+        return (np.repeat(keys.astype(np.uint64) << np.uint64(16),
+                          counts.astype(np.int64))
+                | lows.astype(np.uint64))
+
+    def contains_positions(self, positions: np.ndarray) -> np.ndarray:
+        """Vectorized membership for a batch of uint64 positions: one
+        searchsorted to resolve keys, one gather of ONLY the probed
+        containers' lows, one searchsorted for the low words — cost
+        O(bits in probed containers), never O(store). The mutex write
+        paths (rows_for_column / bulk_import_mutex) probe frozen
+        corpus-scale fragments through this."""
+        positions = np.asarray(positions, dtype=np.uint64)
+        out = np.zeros(positions.size, dtype=bool)
+        if positions.size == 0:
+            return out
+        qkeys = (positions >> np.uint64(16)).astype(np.int64)
+        qlows = (positions & np.uint64(0xFFFF)).astype(np.uint16)
+        pending = np.ones(positions.size, dtype=bool)
+        # overlay first: one sorted join resolves which queries land in
+        # overlay containers (runified stores can hold thousands of
+        # entries — a per-entry scan of the batch would be quadratic)
+        if self._overlay:
+            from pilosa_tpu.storage.roaring import container_contains_many
+            ov_keys = np.fromiter(self._overlay.keys(), np.int64,
+                                  len(self._overlay))
+            ov_keys.sort()
+            oi = np.searchsorted(ov_keys, qkeys)
+            oic = np.minimum(oi, ov_keys.size - 1)
+            in_ov = (oi < ov_keys.size) & (ov_keys[oic] == qkeys)
+            hits = np.nonzero(in_ov)[0]
+            if hits.size:
+                grouped = hits[np.argsort(qkeys[hits], kind="stable")]
+                bounds = np.flatnonzero(np.diff(qkeys[grouped])) + 1
+                for grp in np.split(grouped, bounds):
+                    c = self._overlay[int(qkeys[grp[0]])]
+                    if c.n:
+                        out[grp] = container_contains_many(c, qlows[grp])
+            pending &= ~in_ov
+        if self._deleted:
+            dead = np.isin(qkeys, np.fromiter(self._deleted, np.int64,
+                                              len(self._deleted)))
+            pending &= ~dead
+        if self._keys.size == 0 or not pending.any():
+            return out
+        qi = np.nonzero(pending)[0]
+        i = np.searchsorted(self._keys, qkeys[qi])
+        ic = np.minimum(i, self._keys.size - 1)
+        hit = (i < self._keys.size) & (self._keys[ic] == qkeys[qi])
+        if not hit.any():
+            return out
+        qi, seg = qi[hit], ic[hit]
+        # gather the probed containers' lows into one flat sorted-by-
+        # (key, low) array, then one global searchsorted answers all
+        useg = np.unique(seg)
+        counts = self._ends[useg] - self._starts[useg]
+        g_ends = np.cumsum(counts)
+        g_starts = g_ends - counts
+        total = int(g_ends[-1])
+        gather = (np.arange(total, dtype=np.int64)
+                  + np.repeat(self._starts[useg] - g_starts, counts))
+        gpos = (np.repeat(self._keys[useg].astype(np.uint64) << np.uint64(16),
+                          counts)
+                | self._lows[gather].astype(np.uint64))
+        j = np.searchsorted(gpos, positions[qi])
+        jc = np.minimum(j, gpos.size - 1)
+        out[qi] = (j < gpos.size) & (gpos[jc] == positions[qi])
+        return out
+
     # -- serialization (the 1B-scale snapshot path) -------------------------
 
-    def _compact_arrays(self):
-        """(keys, counts, lows, starts, ends) with the overlay/deletions
-        folded in and lows CONTIGUOUS (ends[i] == starts[i+1]) — the shape
-        the vectorized serializer wants. All paths stay array math: the
-        base gather is one fancy-index (a per-container Python loop here
-        would reintroduce the 1B-container cost this store removes), and
-        only the (small) overlay merges via per-entry splicing."""
+    def _base_compact(self):
+        """Kept base containers — deleted and overlay-replaced keys
+        excluded — compacted to (keys, counts, lows, starts, ends) with
+        lows contiguous (ends[i] == starts[i+1]). Zero-copy views when the
+        base layout is already contiguous (the from_positions shape);
+        otherwise one vectorized multi-slice gather (file-parsed layouts
+        with payload gaps, or deletions) — a per-container Python loop
+        here would reintroduce the 1B-container cost this store removes."""
         keep = np.ones(self._keys.size, dtype=bool)
         for k in self._deleted:
             i = self._base_idx(k)
@@ -282,30 +420,34 @@ class FrozenContainers:
         bkeys = self._keys[keep]
         bstarts, bends = self._starts[keep], self._ends[keep]
         counts = bends - bstarts
+        contiguous = (keep.all() and bkeys.size > 0
+                      and int(bstarts[0]) == 0
+                      and (bkeys.size == 1
+                           or bool((bends[:-1] == bstarts[1:]).all())))
+        if contiguous:
+            return bkeys, counts, self._lows, bstarts, bends
         out_ends = np.cumsum(counts)
         out_starts = out_ends - counts
-        if not self._overlay:
-            # fast path: base already contiguous from element 0 (the
-            # from_positions layout) — serialize straight from the views
-            contiguous = (keep.all() and bkeys.size > 0
-                          and int(bstarts[0]) == 0
-                          and (bkeys.size == 1
-                               or bool((bends[:-1] == bstarts[1:]).all())))
-            if contiguous:
-                return bkeys, counts, self._lows, bstarts, bends
-            # one vectorized multi-slice gather (file-parsed layouts with
-            # payload gaps, or deletions)
+        if bkeys.size:
             total = int(counts.sum())
             idx = (np.arange(total, dtype=np.int64)
                    + np.repeat(bstarts - out_starts, counts))
-            return (bkeys, counts, self._lows[idx], out_starts, out_ends)
+            lows = self._lows[idx]
+        else:
+            lows = np.empty(0, dtype=np.uint16)
+        return bkeys, counts, lows, out_starts, out_ends
+
+    def _compact_arrays(self):
+        """(keys, counts, lows, starts, ends) with the overlay/deletions
+        folded in and lows CONTIGUOUS — the shape the vectorized
+        aggregates want. Overlay containers (few) splice in per entry,
+        expanded to their member values."""
+        bkeys, counts, base_lows, out_starts, out_ends = self._base_compact()
+        if not self._overlay:
+            return bkeys, counts, base_lows, out_starts, out_ends
         # overlay present: splice its (few) containers into the flat form
         ov = sorted((k, self._overlay[k].values())
                     for k in self._overlay if self._overlay[k].n > 0)
-        total = int(counts.sum())
-        idx = (np.arange(total, dtype=np.int64)
-               + np.repeat(bstarts - out_starts, counts))
-        base_lows = self._lows[idx]
         key_pieces, low_pieces, cnt_pieces = [], [], []
         pos = 0  # index into bkeys
         for k, vals in ov:
@@ -338,11 +480,13 @@ class FrozenContainers:
         on the hot path: metadata (desc records + offset table) is built
         as numpy structured arrays, and payload bytes for consecutive
         array-encoded containers are written as single contiguous slices
-        of the flat value array. Only the (rare at row-scale) containers
-        dense enough for bitmap encoding pay a per-container pack. This
-        is what makes snapshot() of a billion-row frozen fragment seconds
-        of array writes instead of hours of Container marshaling
-        (roaring.go:1387-1454 writeToUnoptimized's layout)."""
+        of the flat value array. Only the (few) overlay containers —
+        run-encoded existence/time shapes, bitmap-dense mutations — pay a
+        per-container encode, and they keep their native encoding on disk
+        (a fully-set container writes as one TYPE_RUN interval, not 8 KiB
+        of bitmap). This is what makes snapshot() of a billion-row frozen
+        fragment seconds of array writes instead of hours of Container
+        marshaling (roaring.go:1387-1454 writeToUnoptimized's layout)."""
         from pilosa_tpu.storage.roaring import (
             HEADER_BASE_SIZE,
             MAGIC_NUMBER,
@@ -352,50 +496,81 @@ class FrozenContainers:
             _array_to_words,
         )
 
-        keys, counts, lows, starts, ends = self._compact_arrays()
-        nc = keys.size
-        is_arr = counts <= ARRAY_MAX_SIZE
-        sizes = np.where(is_arr, 2 * counts, 8 * 1024)
+        # base part: kept containers compacted so consecutive array
+        # payloads stream as single slices
+        bkeys, bcounts, blows, b_starts, b_ends = self._base_compact()
+        # overlay: few containers, encoded natively (optimize picks the
+        # smallest of array/bitmap/run, reference roaring.go:1594)
+        ov = sorted((int(k), c.optimize()) for k, c in self._overlay.items()
+                    if c.n > 0)
+        ov_enc = [(k,) + c.encode_current() + (c.n,) for k, c in ov]
+        nb, no = bkeys.size, len(ov_enc)
+        nc = nb + no
+        # merged key order: base order is preserved, overlay splices in
+        all_keys = np.concatenate(
+            [bkeys, np.array([e[0] for e in ov_enc], dtype=np.int64)])
+        all_counts = np.concatenate(
+            [bcounts, np.array([e[3] for e in ov_enc], dtype=np.int64)])
+        b_is_arr = bcounts <= ARRAY_MAX_SIZE
+        all_codes = np.concatenate(
+            [np.where(b_is_arr, TYPE_ARRAY, TYPE_BITMAP).astype(np.int64),
+             np.array([e[1] for e in ov_enc], dtype=np.int64)])
+        all_sizes = np.concatenate(
+            [np.where(b_is_arr, 2 * bcounts, 8 * 1024),
+             np.array([len(e[2]) for e in ov_enc], dtype=np.int64)])
+        order = np.argsort(all_keys, kind="stable")
+        keys_m = all_keys[order]
+        counts_m = all_counts[order]
+        codes_m = all_codes[order]
+        sizes_m = all_sizes[order]
         desc = np.empty(nc, dtype=[("k", "<u8"), ("code", "<u2"),
                                    ("nm1", "<u2")])
-        desc["k"] = keys.astype(np.uint64)
-        desc["code"] = np.where(is_arr, TYPE_ARRAY, TYPE_BITMAP)
-        desc["nm1"] = (counts - 1).astype(np.uint64)
+        desc["k"] = keys_m.astype(np.uint64)
+        desc["code"] = codes_m
+        desc["nm1"] = (counts_m - 1).astype(np.uint64)
         base = HEADER_BASE_SIZE + nc * 12 + nc * 4
         file_off = np.empty(nc, dtype=np.int64)
         if nc:
-            np.cumsum(sizes[:-1], out=file_off[1:])
+            np.cumsum(sizes_m[:-1], out=file_off[1:])
             file_off[0] = 0
             file_off += base
         import struct as _struct
 
-        if nc and int(file_off[-1]) + int(sizes[-1]) > 0xFFFFFFFF:
+        if nc and int(file_off[-1]) + int(sizes_m[-1]) > 0xFFFFFFFF:
             # the offset table is u32 by format; fail loudly like the
             # dict-store writer's struct.pack would, never wrap silently
             raise ValueError(
                 f"snapshot payload region exceeds the format's 4 GiB "
-                f"offset space ({int(file_off[-1]) + int(sizes[-1])} bytes)"
+                f"offset space ({int(file_off[-1]) + int(sizes_m[-1])} bytes)"
                 " — split the fragment")
         written = 0
         written += w.write(_struct.pack("<HHI", MAGIC_NUMBER,
                                         STORAGE_VERSION, nc))
         written += w.write(memoryview(desc))  # no multi-GB bytes copies:
         written += w.write(memoryview(file_off.astype("<u4")))
-        # payloads: stream runs of consecutive array containers as one
-        # buffer view; bitmap-encoded containers pack individually
+        # payloads in merged order: stream maximal streaks of consecutive
+        # base array containers as one buffer view (their relative order —
+        # and so their compacted contiguity — survives the merge); bitmap
+        # and overlay containers emit individually
+        lows_le = np.ascontiguousarray(blows.astype("<u2", copy=False))
         i = 0
-        lows_le = np.ascontiguousarray(lows.astype("<u2", copy=False))
         while i < nc:
-            if is_arr[i]:
+            src = int(order[i])
+            if src < nb and b_is_arr[src]:
                 j = i
-                while j < nc and is_arr[j]:
+                while j < nc and int(order[j]) < nb \
+                        and b_is_arr[int(order[j])]:
                     j += 1
+                first, last = int(order[i]), int(order[j - 1])
                 written += w.write(
-                    memoryview(lows_le[starts[i]:ends[j - 1]]))
+                    memoryview(lows_le[b_starts[first]:b_ends[last]]))
                 i = j
-            else:
-                words = _array_to_words(lows[starts[i]:ends[i]])
+            elif src < nb:
+                words = _array_to_words(blows[b_starts[src]:b_ends[src]])
                 written += w.write(memoryview(words.astype("<u8")))
+                i += 1
+            else:
+                written += w.write(ov_enc[src - nb][2])
                 i += 1
         return written
 

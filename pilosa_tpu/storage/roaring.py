@@ -97,6 +97,22 @@ def _runs_to_values(iv: np.ndarray) -> np.ndarray:
     ])
 
 
+def container_contains_many(c, lows: np.ndarray) -> np.ndarray:
+    """Vectorized membership of uint16 `lows` in one container, by kind."""
+    if c.kind == "array":
+        idx = np.searchsorted(c.data, lows)
+        idx_c = np.minimum(idx, c.data.size - 1)
+        return (idx < c.data.size) & (c.data[idx_c] == lows)
+    if c.kind == "run":
+        i = np.searchsorted(c.data[:, 0], lows, side="right") - 1
+        i_c = np.maximum(i, 0)
+        return (i >= 0) & (lows <= c.data[i_c, 1])
+    li = lows.astype(np.int64)
+    w = c.data[li >> 6]
+    return ((w >> (li.astype(np.uint64) & np.uint64(63)))
+            & np.uint64(1)).astype(bool)
+
+
 class Container:
     """One 2^16-bit container: sorted uint16 array, uint64[1024] bitmap, or
     [nruns, 2] (start, last) run intervals — all three in-memory, matching
@@ -510,6 +526,10 @@ class Bitmap:
         """Vectorized membership: bool mask per value, grouped by container
         (the batch analog of the per-container probe in contains())."""
         values = np.asarray(values, dtype=np.uint64)
+        if getattr(self.containers, "VECTORIZED_STORE", False):
+            # frozen store: segmented searchsorted over the flat arrays —
+            # no per-key Python loop, no Container materialization
+            return self.containers.contains_positions(values)
         out = np.zeros(values.size, dtype=bool)
         keys = (values >> np.uint64(16)).astype(np.int64)
         lows = (values & np.uint64(0xFFFF)).astype(np.uint16)
@@ -518,22 +538,16 @@ class Bitmap:
             if c is None or c.n == 0:
                 continue
             m = keys == key
-            lo = lows[m]
-            if c.kind == "array":
-                idx = np.searchsorted(c.data, lo)
-                idx_c = np.minimum(idx, c.data.size - 1)
-                ok = (idx < c.data.size) & (c.data[idx_c] == lo)
-            elif c.kind == "run":
-                i = np.searchsorted(c.data[:, 0], lo, side="right") - 1
-                i_c = np.maximum(i, 0)
-                ok = (i >= 0) & (lo <= c.data[i_c, 1])
-            else:
-                li = lo.astype(np.int64)
-                w = c.data[li >> 6]
-                ok = ((w >> (li.astype(np.uint64) & np.uint64(63)))
-                      & np.uint64(1)).astype(bool)
-            out[m] = ok
+            out[m] = container_contains_many(c, lows[m])
         return out
+
+    def positions(self) -> np.ndarray:
+        """ALL set positions as one sorted uint64 array. Frozen stores
+        answer from their flat arrays; dict/btree stores concatenate per
+        container (slice with no bounds)."""
+        if getattr(self.containers, "VECTORIZED_STORE", False):
+            return self.containers.all_positions()
+        return self.slice(0)
 
     def contains(self, value: int) -> bool:
         c = self.containers.get(value >> 16)
