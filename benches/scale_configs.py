@@ -215,7 +215,7 @@ def config4(holder, ex):
     for lo in range(0, n, chunk):
         hi = min(lo + chunk, n)
         vals = rng.integers(0, 1024, hi - lo).astype(np.int64)
-        v.import_values(np.arange(lo, hi, dtype=np.uint64), vals)
+        v.import_values_frozen(np.arange(lo, hi, dtype=np.uint64), vals)
         m = vals > thr
         tot_all += int(vals.sum())
         cnt_gt += int(m.sum())

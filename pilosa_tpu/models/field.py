@@ -333,10 +333,14 @@ class Field:
             view.load_frozen_fragment(shard, g_pos)
             self.add_available_shard(shard)
 
-    def import_values(self, columns: Iterable[int], values: Iterable[int]) -> None:
-        """BSI bulk import. Fully vectorized: the shard grouping is one
-        sort + split (a Python-loop grouping walks every (col, val) pair —
-        at the BASELINE 1B-column scale that alone is hours)."""
+    def _values_shard_groups(self, columns: Iterable[int],
+                             values: Iterable[int]):
+        """Validate, dedup (LAST write per column wins — importValue
+        semantics, fragment.go:1624: applying both duplicates would leave
+        the bitwise OR of the values, a value never written) and split a
+        BSI import into per-shard (shard, cols, vals) groups. Fully
+        vectorized: a Python-loop grouping walks every (col, val) pair —
+        at the BASELINE 1B-column scale that alone is hours."""
         from pilosa_tpu.storage.fragment import as_array
 
         cols = as_array(columns, np.uint64)
@@ -347,24 +351,56 @@ class Field:
                           or int(vals.max()) > self.options.max):
             bad = vals[(vals < self.options.min) | (vals > self.options.max)]
             raise ValueError(f"value {int(bad[0])} out of range")
-        view = self.create_view_if_not_exists(self.bsi_view_name)
         order = np.argsort(cols, kind="stable")
         cols, vals = cols[order], vals[order]
         if cols.size > 1:
-            # duplicate columns: LAST write wins (importValue semantics,
-            # fragment.go:1624 — applying both would leave the bitwise OR
-            # of the values, a value never written). After a stable sort
-            # the last duplicate is the last in input order.
+            # after a stable sort the last duplicate is last in input order
             last = np.concatenate([cols[1:] != cols[:-1], [True]])
             cols, vals = cols[last], vals[last]
         shards = (cols // np.uint64(SHARD_WIDTH)).astype(np.int64)
         boundaries = np.flatnonzero(np.diff(shards)) + 1
-        for gcols, gvals in zip(np.split(cols, boundaries),
-                                np.split(vals, boundaries)):
-            shard = int(gcols[0] // np.uint64(SHARD_WIDTH))
+        # eager list, not a generator: callers create the BSI view AFTER
+        # this validates, so a rejected import leaves no ghost empty view
+        return [(int(gcols[0] // np.uint64(SHARD_WIDTH)),
+                 gcols % np.uint64(SHARD_WIDTH), gvals - self.base)
+                for gcols, gvals in zip(np.split(cols, boundaries),
+                                        np.split(vals, boundaries))]
+
+    def import_values(self, columns: Iterable[int], values: Iterable[int]) -> None:
+        """BSI bulk import through the mutating path (WAL-detached bulk
+        merge + snapshot per touched fragment)."""
+        groups = self._values_shard_groups(columns, values)
+        view = self.create_view_if_not_exists(self.bsi_view_name)
+        for shard, scols, svals in groups:
             frag = view.create_fragment_if_not_exists(shard)
-            frag.bulk_import_values(gcols % np.uint64(SHARD_WIDTH),
-                                    gvals - self.base, self.bit_depth)
+            frag.bulk_import_values(scols, svals, self.bit_depth)
+            self.add_available_shard(shard)
+
+    def import_values_frozen(self, columns: Iterable[int],
+                             values: Iterable[int]) -> None:
+        """BASELINE-scale BSI bulk load through the frozen store — the
+        deferred-durability analog of import_rows_frozen for INT fields:
+        plane masks become one sorted position array per shard and each
+        (empty) fragment freezes in one shot, skipping the per-container
+        merge loops and per-batch snapshots of the mutating path
+        (importValue, fragment.go:1624-1658 at 1B-column scale). Volatile
+        like import_frozen: durability is opt-in via snapshot()."""
+        if self.options.type != FieldType.INT:
+            raise ValueError("import_values_frozen supports int fields only")
+        groups = self._values_shard_groups(columns, values)
+        view = self.create_view_if_not_exists(self.bsi_view_name)
+        depth = self.bit_depth
+        sw = np.uint64(SHARD_WIDTH)
+        for shard, scols, svals in groups:
+            # plane ranges are disjoint and scols is sorted-unique, so each
+            # plane slice is already sorted — concatenation in plane order
+            # IS the sorted position array (presorted skips a re-sort of
+            # depth x |cols| positions per shard)
+            planes = [scols[((svals >> i) & 1).astype(bool)]
+                      + np.uint64(i) * sw for i in range(depth)]
+            planes.append(scols + np.uint64(depth) * sw)  # not-null row
+            view.load_frozen_fragment(shard, np.concatenate(planes),
+                                      presorted=True)
             self.add_available_shard(shard)
 
     # -- reads --------------------------------------------------------------

@@ -172,7 +172,8 @@ class View:
         cache.bulk_add((rid, frag.row_count(rid)) for rid in frag.row_ids())
         self.rank_caches[shard] = cache
 
-    def load_frozen_fragment(self, shard: int, positions: np.ndarray) -> Fragment:
+    def load_frozen_fragment(self, shard: int, positions: np.ndarray,
+                             presorted: bool = False) -> Fragment:
         """Bulk-load one shard's fragment from shard-local bit positions
         via the frozen store (fragment.import_frozen), building the rank
         cache VECTORIZED: per-row counts come from the frozen key layout
@@ -181,7 +182,7 @@ class View:
         cache_size by rank), but without iterating a billion rows in
         Python."""
         frag = self.create_fragment_if_not_exists(shard)
-        frag.import_frozen(positions)
+        frag.import_frozen(positions, presorted=presorted)
         if self.track_rank:
             from pilosa_tpu.constants import CONTAINERS_PER_SHARD
 

@@ -1227,11 +1227,16 @@ class Server:
                                                       vname, shard, blk)
                     except ClientError as e:
                         if e.status != 404:
-                            # a correct majority needs this replica's vote;
-                            # skip the block this pass rather than clear on
-                            # partial evidence
-                            fetch_failed = True
-                            break
+                            if majority_n > 1:
+                                # a correct majority needs this replica's
+                                # vote; skip the block this pass rather
+                                # than clear on partial evidence
+                                fetch_failed = True
+                                break
+                            # union mode can't clear, so a flaky peer just
+                            # drops out of this block: the remaining peers
+                            # still heal (and it gets no delta push)
+                            continue
                         data = None  # block raced away: empty vote
                     if data is None:
                         pos = np.empty(0, dtype=np.uint64)

@@ -631,15 +631,19 @@ class Fragment:
         vals = as_array(values, np.int64)
         if cols.size != vals.size:
             raise ValueError("column/value length mismatch")
+        empty = not self.storage.any()
         add_positions = []
         clear_positions = []
         for i in range(bit_depth):
             bit_base = np.uint64(i * SHARD_WIDTH)
             mask = ((vals >> i) & 1).astype(bool)
             add_positions.append(cols[mask] + bit_base)
-            clear_positions.append(cols[~mask] + bit_base)
+            if not empty:
+                clear_positions.append(cols[~mask] + bit_base)
         add_positions.append(cols + np.uint64(bit_depth * SHARD_WIDTH))  # not-null
         if clear_positions:
+            # zero-plane clears only matter when overwriting prior values —
+            # on a fresh fragment there is nothing to clear
             self.storage.remove_many(np.concatenate(clear_positions))
         self.storage.add_many(np.concatenate(add_positions))
         for i in range(bit_depth + 1):
@@ -647,7 +651,8 @@ class Fragment:
         self._maybe_snapshot()
 
     @_locked
-    def import_frozen(self, positions: np.ndarray) -> None:
+    def import_frozen(self, positions: np.ndarray,
+                      presorted: bool = False) -> None:
         """BASELINE-scale bulk load: replace this (empty) fragment's
         storage with a frozen array-backed store built from shard-local
         bit positions in O(N log N) numpy (storage/frozen.py; the regime
@@ -665,7 +670,7 @@ class Fragment:
         persists the full storage and re-attaches the WAL."""
         if self.storage.any():
             raise ValueError("import_frozen requires an empty fragment")
-        self.storage = Bitmap.frozen(positions)
+        self.storage = Bitmap.frozen(positions, presorted=presorted)
         self.storage.op_writer = None  # volatile: see docstring
         self._volatile = True
         self.generation += 1

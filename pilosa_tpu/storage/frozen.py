@@ -110,7 +110,15 @@ class FrozenContainers:
         positions = np.asarray(positions, dtype=np.uint64)
         keys64 = (positions >> np.uint64(16)).astype(np.int64)
         lows = (positions & np.uint64(0xFFFF)).astype(np.uint16)
-        ukeys, starts = np.unique(keys64, return_index=True)
+        # positions are sorted-unique, so keys are sorted: container
+        # boundaries fall out of one diff pass (np.unique would pay a
+        # redundant O(N log N) sort per shard at bulk-load scale)
+        if keys64.size:
+            starts = np.flatnonzero(
+                np.concatenate([[True], keys64[1:] != keys64[:-1]]))
+        else:
+            starts = np.empty(0, dtype=np.int64)
+        ukeys = keys64[starts]
         offsets = np.empty(ukeys.size + 1, dtype=np.int64)
         offsets[:-1] = starts
         offsets[-1] = keys64.size

@@ -554,16 +554,23 @@ class Bitmap:
         return c is not None and c.contains(value & 0xFFFF)
 
     @classmethod
-    def frozen(cls, positions: np.ndarray) -> "Bitmap":
+    def frozen(cls, positions: np.ndarray,
+               presorted: bool = False) -> "Bitmap":
         """Bulk-load constructor for BASELINE-scale imports: the whole
         position set becomes a flat array-backed store (storage/frozen.py)
         in O(N log N) numpy — no per-container Python loop, no per-row
-        object allocation. Mutations after the freeze go to a COW overlay."""
+        object allocation. Mutations after the freeze go to a COW overlay.
+        `presorted=True` skips the dedup sort for callers that construct
+        sorted-unique positions themselves (the BSI plane import builds
+        them from disjoint plane ranges — re-sorting a billion positions
+        costs more than the store build)."""
         from pilosa_tpu.storage.frozen import FrozenContainers
 
         b = cls()  # store_kind stays the resolved default: DERIVED bitmaps
         # (intersect/union results) are ordinary mutable stores
-        positions = np.unique(np.asarray(positions, dtype=np.uint64))
+        positions = np.asarray(positions, dtype=np.uint64)
+        if not presorted:
+            positions = np.unique(positions)
         b.containers = FrozenContainers.from_positions(positions)
         return b
 
