@@ -351,6 +351,8 @@ class Field:
                           or int(vals.max()) > self.options.max):
             bad = vals[(vals < self.options.min) | (vals > self.options.max)]
             raise ValueError(f"value {int(bad[0])} out of range")
+        if cols.size == 0:
+            return []
         order = np.argsort(cols, kind="stable")
         cols, vals = cols[order], vals[order]
         if cols.size > 1:

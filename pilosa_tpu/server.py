@@ -1250,9 +1250,11 @@ class Server:
                 positions.append(pos)
             if fetch_failed:
                 continue
-            n_sets, n_clears, deltas = frag.merge_block_majority(
+            n_sets, n_clears, deltas, durable = frag.merge_block_majority(
                 blk, positions, majority_n=majority_n)
-            adopted |= (n_sets + n_clears) > 0
+            # small adoptions WAL-append inside the merge; only a large
+            # adoption asks for the one-snapshot-per-pass fallback
+            adopted |= not durable
             merged += 1
             for node, (peer_sets, peer_clears) in zip(voters, deltas):
                 for delta, clear in ((peer_sets, False), (peer_clears, True)):
@@ -1266,8 +1268,9 @@ class Server:
                     except ClientError:
                         pass
         if adopted:
-            # merge_block bulk-adds bypass the op-log; one snapshot per sync
-            # pass makes the merged state durable (same contract as the
-            # bulk import paths)
+            # only LARGE adoptions on WAL-attached fragments land here
+            # (durable=False): small ones WAL-appended inside
+            # merge_block_majority, volatile fragments owe nothing by
+            # contract — one snapshot per sync pass covers the rest
             frag.snapshot()
         return merged

@@ -844,9 +844,19 @@ class Fragment:
         — whenever any configured replica didn't vote).
 
         Applies the local sets AND clears in place, then returns
-        (n_local_sets, n_local_clears, deltas) where deltas[i] is the
-        (set_positions, clear_positions) pair the caller pushes to peer i
-        (fragment.go:1407-1417 emits both directions per replica).
+        (n_local_sets, n_local_clears, deltas, durable) where deltas[i] is
+        the (set_positions, clear_positions) pair the caller pushes to
+        peer i (fragment.go:1407-1417 emits both directions per replica).
+        `durable` reports whether the local changes are already persisted:
+        small adoptions WAL-append as redo records (writeOp,
+        roaring.go:977) instead of forcing the caller's per-pass snapshot
+        — adopting 10 bits into a 125M-row shard must not rewrite the
+        corpus — and volatile (frozen, un-snapshotted) fragments report
+        durable=True because their whole contract is opt-in durability:
+        a restart loses the base corpus too, and anti-entropy re-adopts
+        from the peers that still hold the pairs. Only a large adoption
+        on a WAL-attached fragment returns durable=False, asking the
+        caller for one snapshot per sync pass.
         Vectorized as sorted position-array set algebra: a 100-row block can
         hold up to 100 * 2^20 pairs, and building Python tuple-sets of those
         froze anti-entropy at BASELINE scale."""
@@ -867,18 +877,27 @@ class Fragment:
                            np.setdiff1d(posarr, target)))
         local_sets, local_clears = deltas[0]
         if local_sets.size:
-            # bulk adds/removes bypass the op-log; callers that need the
-            # merged state durable snapshot once per sync pass
-            # (server._sync_fragment), the same WAL contract as the bulk
-            # import paths
             self.storage.add_many(local_sets)
         if local_clears.size:
             self.storage.remove_many(local_clears)
-        if local_sets.size or local_clears.size:
+        durable = True
+        n_changed = int(local_sets.size) + int(local_clears.size)
+        if n_changed:
             changed = np.concatenate([local_sets, local_clears])
             for rid in np.unique(changed // sw):
                 self._touch(int(rid))
-        return int(local_sets.size), int(local_clears.size), deltas[1:]
+            if self._volatile:
+                pass  # volatile contract: durability is opt-in (docstring)
+            elif (self.storage.op_writer is not None
+                  and n_changed <= MAX_OP_N):
+                self.storage.append_ops(local_sets, local_clears)
+                self.op_n += n_changed
+                if self.op_n > MAX_OP_N:
+                    self._maybe_snapshot()  # bounds WAL growth as usual
+            else:
+                durable = False
+        return (int(local_sets.size), int(local_clears.size), deltas[1:],
+                durable)
 
     @_locked
     def merge_block(self, blk: int, peer_rows: np.ndarray, peer_cols: np.ndarray):
@@ -890,7 +909,8 @@ class Fragment:
         sw = np.uint64(SHARD_WIDTH)
         peer_pos = np.asarray(peer_rows, dtype=np.uint64) * sw \
             + np.asarray(peer_cols, dtype=np.uint64)
-        n_sets, _n_clears, deltas = self.merge_block_majority(blk, [peer_pos])
+        n_sets, _n_clears, deltas, _durable = self.merge_block_majority(
+            blk, [peer_pos])
         peer_sets, _peer_clears = deltas[0]
         return ((peer_sets // sw).astype(np.int64),
                 (peer_sets % sw).astype(np.int64),

@@ -520,6 +520,26 @@ class Bitmap:
             os.fsync(self.op_writer.fileno())
         self.op_n += 1
 
+    def append_ops(self, adds: np.ndarray, removes: np.ndarray) -> None:
+        """WAL-append bulk deltas as individual op records in ONE write
+        (writeOp, roaring/roaring.go:977) — the durability path for small
+        anti-entropy adoptions, where the alternative is a full snapshot
+        rewriting the whole fragment. Caller has already applied the
+        mutations; these are redo records for replay."""
+        if self.op_writer is None:
+            return
+        parts = []
+        for typ, vals in ((OP_ADD, adds), (OP_REMOVE, removes)):
+            for v in np.asarray(vals, dtype=np.uint64).tolist():
+                body = struct.pack("<BQ", typ, int(v))
+                parts.append(body + struct.pack("<I", fnv1a32(body)))
+        if not parts:
+            return
+        self.op_writer.write(b"".join(parts))
+        if self.op_sync:
+            os.fsync(self.op_writer.fileno())
+        self.op_n += len(parts)
+
     # -- queries ------------------------------------------------------------
 
     def contains_many(self, values: np.ndarray) -> np.ndarray:
