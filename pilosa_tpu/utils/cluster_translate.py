@@ -74,7 +74,39 @@ class ClusterTranslator:
         return ids[0]
 
     def translate_columns(self, index: str, keys: list[str], create: bool = True):
-        return [self.translate_column(index, k, create) for k in keys]
+        return self._translate_many(index, None, keys, create)
+
+    def _translate_many(self, index: str, field, keys: list[str],
+                        create: bool):
+        """Batched translation: primaries mint through the store's batched
+        path (one log write + one index commit for ALL minted keys);
+        replicas resolve local hits first, then forward the misses in ONE
+        RPC and install the returned mappings in one commit — a keyed bulk
+        import mints millions, and a per-key loop pays a commit (or a
+        round trip) each."""
+        kind = KIND_COLUMN if field is None else KIND_ROW
+        if self._primary_uri() is None:
+            if field is None:
+                return self.store.translate_columns(index, keys,
+                                                    create=create)
+            return self.store.translate_rows(index, field, keys,
+                                             create=create)
+        if field is None:
+            out = self.store.translate_columns(index, keys, create=False)
+        else:
+            out = self.store.translate_rows(index, field, keys,
+                                            create=False)
+        missing = [i for i, v in enumerate(out) if v is None]
+        if missing:
+            got = self._forward(index, field, [keys[i] for i in missing],
+                                create=create)
+            if got:
+                for i, id_ in zip(missing, got):
+                    if id_ is not None:
+                        self.store.ensure_mapping(
+                            kind, index, field or "", keys[i], id_)
+                        out[i] = id_
+        return out
 
     def translate_row(self, index: str, field: str, key: str, create: bool = True):
         id_ = self.store.translate_row(index, field, key, create=False)
@@ -91,7 +123,7 @@ class ClusterTranslator:
 
     def translate_rows(self, index: str, field: str, keys: list[str],
                        create: bool = True):
-        return [self.translate_row(index, field, k, create) for k in keys]
+        return self._translate_many(index, field, keys, create)
 
     # -- reverse translation ------------------------------------------------
 

@@ -1,11 +1,25 @@
 """Key translation: string keys <-> uint64 ids.
 
 Reference: translate.go — a single-writer append-only log replicated to
-followers, with an mmapped hash index (translate.go:359-433, 1,153 LoC).
-Here: an append-only binary log replayed into host dicts on open. The
-single-writer property is preserved at the cluster level: only the primary
-translates new keys; replicas tail the log over HTTP
-(/internal/translate/data) and serve reads.
+followers, with an mmapped robin-hood hash index so keys are NOT all
+resident (translate.go:359-433, 1,153 LoC). Here the same split: an
+append-only binary log is the replication/durability medium, and a
+NON-RESIDENT sqlite index derived from the log serves lookups — a
+100M-key corpus must not hold every key in Python dicts on every node
+(tens of GB of boxed strings), which is the regime the frozen column
+store exists for. The single-writer property is preserved at the cluster
+level: only the primary translates new keys; replicas tail the log over
+HTTP (/internal/translate/data) and serve reads.
+
+Index selection:
+  - `path=None` (ephemeral stores, tests): plain dicts.
+  - `path` set: sqlite sidecar `<path>.idx` + bounded LRU hot cache.
+    Override with PILOSA_TPU_TRANSLATE_INDEX=dict|sqlite.
+
+The sqlite index is DERIVATIVE: it records the log offset it has
+absorbed (`meta.log_pos`) and replays only the log tail on open, so a
+crash between log append and index commit heals on the next open and a
+clean reopen of a 100M-key store replays nothing.
 
 Record format (little-endian):
   kind u8 (0=column, 1=row) | index_len u16 | index | field_len u16 | field |
@@ -14,26 +28,194 @@ Record format (little-endian):
 
 from __future__ import annotations
 
-import io
 import os
+import sqlite3
 import struct
 import threading
-from typing import Optional
+from collections import OrderedDict
+from typing import Iterator, Optional
 
 KIND_COLUMN = 0
 KIND_ROW = 1
 
+# hot-key LRU bound per direction (fwd/rev): caps resident key bytes on
+# corpus-scale keyed indexes (~100MB at this cap) while keeping executor
+# hot paths dict-speed; misses fall through to sqlite at ~8us
+CACHE_MAX = 1 << 18
+
+
+class _DictIndex:
+    """Fully-resident index — the path=None (ephemeral) configuration."""
+
+    def __init__(self):
+        self._fwd: dict[tuple[int, str, str], dict[str, int]] = {}
+        self._rev: dict[tuple[int, str, str], dict[int, str]] = {}
+
+    def get(self, kind: int, index: str, field: str, key: str) -> Optional[int]:
+        return self._fwd.get((kind, index, field), {}).get(key)
+
+    def get_rev(self, kind: int, index: str, field: str,
+                id_: int) -> Optional[str]:
+        return self._rev.get((kind, index, field), {}).get(id_)
+
+    def put(self, kind: int, index: str, field: str, key: str, id_: int) -> None:
+        scope = (kind, index, field)
+        self._fwd.setdefault(scope, {})[key] = id_
+        self._rev.setdefault(scope, {})[id_] = key
+
+    def next_id(self, kind: int, index: str, field: str) -> int:
+        return len(self._fwd.get((kind, index, field), {})) + 1
+
+    def items(self, kind: int, index: str, field: str) -> Iterator[tuple[str, int]]:
+        return iter(self._fwd.get((kind, index, field), {}).items())
+
+    def log_pos(self) -> int:
+        return 0  # always replay the whole log
+
+    def set_log_pos(self, pos: int) -> None:
+        pass
+
+    def commit(self) -> None:
+        pass
+
+    def rollback(self) -> None:
+        pass  # in-memory puts stay applied: pre-sqlite semantics — the
+        # process still serves them; a restart replays the full log anyway
+
+    def close(self) -> None:
+        pass
+
+
+class _SqliteIndex:
+    """Non-resident index over the translate log (the mmapped-hash analog,
+    translate.go:359-433): sqlite B-tree pages page in on demand, a
+    bounded LRU keeps hot keys dict-speed, and `meta.log_pos` ties the
+    index to the log so opens replay only the un-absorbed tail."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._db = sqlite3.connect(path, check_same_thread=False)
+        # durability rides the LOG: on crash the index replays the tail
+        # from log_pos, so sqlite can skip its own fsyncs entirely
+        self._db.execute("PRAGMA journal_mode=WAL")
+        self._db.execute("PRAGMA synchronous=OFF")
+        self._db.execute(
+            "CREATE TABLE IF NOT EXISTS kv ("
+            " kind INTEGER, idx TEXT, field TEXT, key TEXT, id INTEGER,"
+            " PRIMARY KEY (kind, idx, field, key)) WITHOUT ROWID")
+        self._db.execute(
+            "CREATE UNIQUE INDEX IF NOT EXISTS kv_rev"
+            " ON kv (kind, idx, field, id)")
+        self._db.execute(
+            "CREATE TABLE IF NOT EXISTS meta (k TEXT PRIMARY KEY, v INTEGER)")
+        self._db.commit()
+        self._fwd_cache: OrderedDict = OrderedDict()
+        self._rev_cache: OrderedDict = OrderedDict()
+        self._next: dict[tuple[int, str, str], int] = {}
+
+    @staticmethod
+    def _cache_put(cache: OrderedDict, k, v) -> None:
+        cache[k] = v
+        cache.move_to_end(k)
+        if len(cache) > CACHE_MAX:
+            cache.popitem(last=False)
+
+    def get(self, kind: int, index: str, field: str, key: str) -> Optional[int]:
+        ck = (kind, index, field, key)
+        hit = self._fwd_cache.get(ck)
+        if hit is not None:
+            self._fwd_cache.move_to_end(ck)
+            return hit
+        row = self._db.execute(
+            "SELECT id FROM kv WHERE kind=? AND idx=? AND field=? AND key=?",
+            ck).fetchone()
+        if row is None:
+            return None
+        self._cache_put(self._fwd_cache, ck, int(row[0]))
+        return int(row[0])
+
+    def get_rev(self, kind: int, index: str, field: str,
+                id_: int) -> Optional[str]:
+        ck = (kind, index, field, id_)
+        hit = self._rev_cache.get(ck)
+        if hit is not None:
+            self._rev_cache.move_to_end(ck)
+            return hit
+        row = self._db.execute(
+            "SELECT key FROM kv WHERE kind=? AND idx=? AND field=? AND id=?",
+            ck).fetchone()
+        if row is None:
+            return None
+        self._cache_put(self._rev_cache, ck, row[0])
+        return row[0]
+
+    def put(self, kind: int, index: str, field: str, key: str, id_: int) -> None:
+        self._db.execute(
+            "INSERT OR IGNORE INTO kv (kind, idx, field, key, id)"
+            " VALUES (?, ?, ?, ?, ?)", (kind, index, field, key, id_))
+        self._cache_put(self._fwd_cache, (kind, index, field, key), id_)
+        self._cache_put(self._rev_cache, (kind, index, field, id_), key)
+        scope = (kind, index, field)
+        nxt = self._next.get(scope)
+        if nxt is None or id_ >= nxt:
+            self._next[scope] = id_ + 1
+
+    def next_id(self, kind: int, index: str, field: str) -> int:
+        scope = (kind, index, field)
+        nxt = self._next.get(scope)
+        if nxt is None:
+            row = self._db.execute(
+                "SELECT MAX(id) FROM kv WHERE kind=? AND idx=? AND field=?",
+                scope).fetchone()
+            nxt = (int(row[0]) + 1) if row and row[0] is not None else 1
+            self._next[scope] = nxt
+        return nxt
+
+    def items(self, kind: int, index: str, field: str) -> Iterator[tuple[str, int]]:
+        cur = self._db.execute(
+            "SELECT key, id FROM kv WHERE kind=? AND idx=? AND field=?",
+            (kind, index, field))
+        for key, id_ in cur:
+            yield key, int(id_)
+
+    def log_pos(self) -> int:
+        row = self._db.execute(
+            "SELECT v FROM meta WHERE k='log_pos'").fetchone()
+        return int(row[0]) if row else 0
+
+    def set_log_pos(self, pos: int) -> None:
+        self._db.execute(
+            "INSERT INTO meta (k, v) VALUES ('log_pos', ?)"
+            " ON CONFLICT(k) DO UPDATE SET v=excluded.v", (pos,))
+
+    def commit(self) -> None:
+        self._db.commit()
+
+    def rollback(self) -> None:
+        """Drop the open transaction AND the derived in-memory state —
+        the caches and next-id counters may hold puts the log rejected."""
+        self._db.rollback()
+        self._fwd_cache.clear()
+        self._rev_cache.clear()
+        self._next.clear()
+
+    def close(self) -> None:
+        self._db.commit()
+        self._db.close()
+
 
 class TranslateStore:
-    def __init__(self, path: Optional[str] = None):
+    def __init__(self, path: Optional[str] = None,
+                 index_kind: Optional[str] = None):
         self.path = path
         self._lock = threading.RLock()
         self._file = None
-        # (index,) -> {key: id} and inverse; rows keyed by (index, field)
-        self._col_fwd: dict[str, dict[str, int]] = {}
-        self._col_rev: dict[str, dict[int, str]] = {}
-        self._row_fwd: dict[tuple[str, str], dict[str, int]] = {}
-        self._row_rev: dict[tuple[str, str], dict[int, str]] = {}
+        if index_kind is None:
+            index_kind = os.environ.get(
+                "PILOSA_TPU_TRANSLATE_INDEX",
+                "sqlite" if path else "dict")
+        self.index_kind = index_kind
+        self._idx = None  # built in open()
         self.read_only = False  # True on replicas (non-primary)
 
     # -- lifecycle ----------------------------------------------------------
@@ -41,9 +223,39 @@ class TranslateStore:
     def open(self) -> "TranslateStore":
         if self.path:
             os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
-            if os.path.exists(self.path):
+        if self.index_kind == "sqlite" and self.path:
+            self._idx = _SqliteIndex(self.path + ".idx")
+        else:
+            self._idx = _DictIndex()
+        if self.path:
+            start = self._idx.log_pos()
+            size = (os.path.getsize(self.path)
+                    if os.path.exists(self.path) else 0)
+            if start > size:
+                # index is AHEAD of the log: a crash wrote the index
+                # before the log bytes hit disk (the log is flush()ed,
+                # not fsynced — writeback order is arbitrary), or the log
+                # was removed/replaced. The LOG is the source of truth,
+                # so rebuild the index from it rather than serve mappings
+                # the cluster never minted — and rather than staying down
+                # until an operator deletes the sidecar by hand.
+                self._idx.close()
+                if isinstance(self._idx, _SqliteIndex):
+                    for suffix in (".idx", ".idx-wal", ".idx-shm"):
+                        try:
+                            os.remove(self.path + suffix)
+                        except FileNotFoundError:
+                            pass
+                    self._idx = _SqliteIndex(self.path + ".idx")
+                else:
+                    self._idx = _DictIndex()
+                start = 0
+            if start < size:
                 with open(self.path, "rb") as f:
-                    self._replay(f.read())
+                    f.seek(start)
+                    self._replay(f.read(), base_offset=start)
+                self._idx.set_log_pos(size)
+                self._idx.commit()
             self._file = open(self.path, "ab")
         return self
 
@@ -51,83 +263,126 @@ class TranslateStore:
         if self._file is not None:
             self._file.close()
             self._file = None
+        if self._idx is not None:
+            self._idx.close()
+            self._idx = None
 
-    def _replay(self, data: bytes) -> None:
+    def _replay(self, data: bytes, base_offset: int = 0) -> None:
         pos = 0
         n = len(data)
         while pos < n:
             try:
                 kind, index, field, key, id_ = _unpack_record(data, pos)
             except (struct.error, ValueError):
-                raise ValueError(f"corrupt translate log at offset {pos}")
+                raise ValueError(
+                    f"corrupt translate log at offset {base_offset + pos}")
             pos = _record_end(data, pos)
-            self._apply(kind, index, field, key, id_)
-
-    def _apply(self, kind: int, index: str, field: str, key: str, id_: int) -> None:
-        if kind == KIND_COLUMN:
-            self._col_fwd.setdefault(index, {})[key] = id_
-            self._col_rev.setdefault(index, {})[id_] = key
-        else:
-            self._row_fwd.setdefault((index, field), {})[key] = id_
-            self._row_rev.setdefault((index, field), {})[id_] = key
+            self._idx.put(kind, index, field, key, id_)
 
     def _append(self, kind: int, index: str, field: str, key: str, id_: int) -> None:
         if self._file is not None:
-            self._file.write(_pack_record(kind, index, field, key, id_))
-            self._file.flush()
+            try:
+                self._file.write(_pack_record(kind, index, field, key, id_))
+                self._file.flush()
+                self._idx.set_log_pos(self._file.tell())
+            except Exception:
+                # the index must never durably hold mappings the log
+                # doesn't: drop the uncommitted puts (and caches) so a
+                # later unrelated commit can't persist them
+                self._idx.rollback()
+                raise
+        self._idx.commit()
 
     # -- translation (translate.go TranslateColumnsToUint64 etc.) -----------
 
     def translate_column(self, index: str, key: str, create: bool = True) -> Optional[int]:
         with self._lock:
-            fwd = self._col_fwd.setdefault(index, {})
-            id_ = fwd.get(key)
+            id_ = self._idx.get(KIND_COLUMN, index, "", key)
             if id_ is None and create:
                 if self.read_only:
                     raise ValueError("translate store is read-only (replica)")
-                id_ = len(fwd) + 1
-                self._apply(KIND_COLUMN, index, "", key, id_)
+                id_ = self._idx.next_id(KIND_COLUMN, index, "")
+                self._idx.put(KIND_COLUMN, index, "", key, id_)
                 self._append(KIND_COLUMN, index, "", key, id_)
             return id_
 
     def translate_columns(self, index: str, keys: list[str], create: bool = True) -> list[Optional[int]]:
-        return [self.translate_column(index, k, create) for k in keys]
+        return self._translate_batch(KIND_COLUMN, index, "", keys, create)
+
+    def _translate_batch(self, kind: int, index: str, field: str,
+                         keys: list[str], create: bool) -> list[Optional[int]]:
+        """Batch lookup/mint: ONE log write and ONE index commit for all
+        newly minted keys — a keyed bulk import mints millions, and a
+        commit per key turns the translate store into the import
+        bottleneck."""
+        with self._lock:
+            out: list[Optional[int]] = []
+            minted = []
+            for k in keys:
+                id_ = self._idx.get(kind, index, field, k)
+                if id_ is None and create:
+                    if self.read_only:
+                        raise ValueError(
+                            "translate store is read-only (replica)")
+                    id_ = self._idx.next_id(kind, index, field)
+                    self._idx.put(kind, index, field, k, id_)
+                    minted.append((kind, index, field, k, id_))
+                out.append(id_)
+            if minted:
+                if self._file is not None:
+                    try:
+                        self._file.write(
+                            b"".join(_pack_record(*r) for r in minted))
+                        self._file.flush()
+                        self._idx.set_log_pos(self._file.tell())
+                    except Exception:
+                        self._idx.rollback()  # see _append
+                        raise
+                self._idx.commit()
+            return out
 
     def translate_column_to_string(self, index: str, id_: int) -> Optional[str]:
-        return self._col_rev.get(index, {}).get(id_)
+        with self._lock:
+            return self._idx.get_rev(KIND_COLUMN, index, "", id_)
 
     def translate_row(self, index: str, field: str, key: str, create: bool = True) -> Optional[int]:
         with self._lock:
-            fwd = self._row_fwd.setdefault((index, field), {})
-            id_ = fwd.get(key)
+            id_ = self._idx.get(KIND_ROW, index, field, key)
             if id_ is None and create:
                 if self.read_only:
                     raise ValueError("translate store is read-only (replica)")
-                id_ = len(fwd) + 1
-                self._apply(KIND_ROW, index, field, key, id_)
+                id_ = self._idx.next_id(KIND_ROW, index, field)
+                self._idx.put(KIND_ROW, index, field, key, id_)
                 self._append(KIND_ROW, index, field, key, id_)
             return id_
 
     def translate_rows(self, index: str, field: str, keys: list[str], create: bool = True) -> list[Optional[int]]:
-        return [self.translate_row(index, field, k, create) for k in keys]
+        return self._translate_batch(KIND_ROW, index, field, keys, create)
 
     def translate_row_to_string(self, index: str, field: str, id_: int) -> Optional[str]:
-        return self._row_rev.get((index, field), {}).get(id_)
+        with self._lock:
+            return self._idx.get_rev(KIND_ROW, index, field, id_)
+
+    def column_items(self, index: str) -> list[tuple[str, int]]:
+        """All (key, id) column mappings of an index — test/debug surface,
+        NOT a hot path (walks the whole scope)."""
+        with self._lock:
+            return list(self._idx.items(KIND_COLUMN, index, ""))
 
     def ensure_mapping(self, kind: int, index: str, field: str, key: str,
                        id_: int) -> None:
         """Install a mapping minted by the primary (replica-side apply).
 
-        Memory-only: the on-disk log must stay a byte-prefix of the primary's
-        log so tailing (/internal/translate/data with offset=log_size) stays
-        aligned. Durable replication happens only through apply_log; mappings
-        installed here are recovered after restart by re-forwarding or
-        re-tailing."""
+        The on-disk LOG must stay a byte-prefix of the primary's log so
+        tailing (/internal/translate/data with offset=log_size) stays
+        aligned — so this never appends to the log. The index may persist
+        the mapping (it is derivative state, not part of the replicated
+        log); the log record itself arrives later via apply_log and
+        dedups on insert."""
         with self._lock:
-            fwd = (self._col_fwd.setdefault(index, {}) if kind == KIND_COLUMN
-                   else self._row_fwd.setdefault((index, field), {}))
-            if key not in fwd:
-                self._apply(kind, index, field, key, id_)
+            if self._idx.get(kind, index, field, key) is None:
+                self._idx.put(kind, index, field, key, id_)
+                self._idx.commit()
 
     # -- replication (replicas tail the primary's log;
     #    /internal/translate/data, translate.go:662) ------------------------
@@ -151,6 +406,8 @@ class TranslateStore:
             if self._file is not None:
                 self._file.write(data)
                 self._file.flush()
+                self._idx.set_log_pos(self._file.tell())
+            self._idx.commit()
 
 
 def _pack_record(kind: int, index: str, field: str, key: str, id_: int) -> bytes:

@@ -475,7 +475,7 @@ def test_cluster_keyed_index_consistent_ids(cluster3):
     # only a subset of keys, but never a conflicting id for the same key
     combined: dict[str, int] = {}
     for srv in cluster3:
-        for k, v in srv.translate._col_fwd.get("ki", {}).items():
+        for k, v in srv.translate.column_items("ki"):
             assert combined.setdefault(k, v) == v, (k, v, combined)
 
 

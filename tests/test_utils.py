@@ -1,6 +1,7 @@
 """Aux subsystem tests: stats, tracing, logger, attr store, translate store."""
 
 import io
+import os
 import time
 
 import pytest
@@ -338,3 +339,104 @@ def test_config_durations_and_tls(tmp_path):
     assert cfg2.anti_entropy.interval == 90.0
     assert cfg2.tls.certificate == "x" and not cfg2.tls.enabled
     assert "[tls]" in cfg2.to_toml()
+
+
+def test_translate_sqlite_index_no_replay_on_reopen(tmp_path, monkeypatch):
+    """The sqlite index absorbs the log incrementally: a clean reopen
+    replays NOTHING (meta.log_pos == log size), so opening a 100M-key
+    store is O(1), not O(keys) (the non-resident index of
+    translate.go:359-433)."""
+    import pilosa_tpu.utils.translate as tr
+
+    path = str(tmp_path / "keys")
+    t = TranslateStore(path, index_kind="sqlite").open()
+    for i in range(500):
+        t.translate_column("i", f"k{i}")
+    t.close()
+
+    def boom(self, data):
+        raise AssertionError("clean reopen must not replay the log")
+
+    monkeypatch.setattr(tr.TranslateStore, "_replay", boom)
+    t2 = TranslateStore(path, index_kind="sqlite").open()
+    assert t2.translate_column("i", "k250", create=False) == 251
+    assert t2.translate_column_to_string("i", 251) == "k250"
+    monkeypatch.undo()
+    # minting continues from the persisted max id
+    assert t2.translate_column("i", "fresh") == 501
+    t2.close()
+
+
+def test_translate_sqlite_index_heals_from_log_tail(tmp_path):
+    """Crash between log append and index commit: the next open replays
+    only the un-absorbed tail from meta.log_pos."""
+    path = str(tmp_path / "keys")
+    t = TranslateStore(path, index_kind="sqlite").open()
+    t.translate_column("i", "a")
+    t.close()
+    # simulate a lost index commit: rewind log_pos to 0 (index empty-ish is
+    # fine too — INSERT OR IGNORE dedups on replay)
+    import sqlite3
+
+    db = sqlite3.connect(path + ".idx")
+    db.execute("UPDATE meta SET v=0 WHERE k='log_pos'")
+    db.commit()
+    db.close()
+    t2 = TranslateStore(path, index_kind="sqlite").open()
+    assert t2.translate_column("i", "a", create=False) == 1
+    assert t2.translate_column("i", "b") == 2
+    t2.close()
+
+
+def test_translate_index_ahead_of_log_rebuilds(tmp_path):
+    """Index ahead of the log (crash wrote the index before the log hit
+    disk, or the log was replaced): the LOG is the source of truth — the
+    index rebuilds from it instead of serving mappings the cluster never
+    minted or refusing to open."""
+    from pilosa_tpu.utils.translate import _record_end
+
+    path = str(tmp_path / "keys")
+    t = TranslateStore(path, index_kind="sqlite").open()
+    for i in range(10):
+        t.translate_column("i", f"k{i}")
+    t.close()
+    # truncate the log at a record boundary, behind the absorbed offset
+    data = open(path, "rb").read()
+    pos = 0
+    for _ in range(4):
+        pos = _record_end(data, pos)
+    with open(path, "r+b") as f:
+        f.truncate(pos)
+    t2 = TranslateStore(path, index_kind="sqlite").open()
+    assert t2.translate_column("i", "k3", create=False) == 4
+    assert t2.translate_column("i", "k7", create=False) is None  # truncated away
+    assert t2.translate_column("i", "fresh") == 5  # minting resumes from log truth
+    t2.close()
+    # log deleted entirely but index left behind: same rule
+    os.remove(path)
+    t3 = TranslateStore(path, index_kind="sqlite").open()
+    assert t3.translate_column("i", "k3", create=False) is None
+    assert t3.translate_column("i", "first") == 1
+    t3.close()
+
+
+def test_translate_sqlite_replication_parity(tmp_path):
+    """Replica tailing works identically over the sqlite index."""
+    primary = TranslateStore(str(tmp_path / "p"), index_kind="sqlite").open()
+    for i in range(50):
+        primary.translate_column("i", f"c{i}")
+        primary.translate_row("i", "f", f"r{i}")
+    replica = TranslateStore(str(tmp_path / "r"), index_kind="sqlite").open()
+    replica.read_only = True
+    replica.apply_log(primary.log_bytes(0))
+    assert replica.translate_column("i", "c7", create=False) == 8
+    assert replica.translate_row_to_string("i", "f", 8) == "r7"
+    assert replica.log_size() == primary.log_size()
+    # ensure_mapping installs without touching the log (byte-prefix rule)
+    before = replica.log_size()
+    replica.ensure_mapping(0, "i", "", "minted-elsewhere", 999)
+    assert replica.log_size() == before
+    assert replica.translate_column("i", "minted-elsewhere",
+                                    create=False) == 999
+    primary.close()
+    replica.close()
