@@ -1,0 +1,26 @@
+"""The REAL Executor over a simulated two-slice multislice mesh.
+
+make_multislice_mesh maps one replica slice per TPU slice (DCN between
+slices, ICI within — SURVEY §5.8). The shared harness
+(__graft_entry__.run_multislice_dryrun) substitutes the slice bucketer
+on CPU test devices (which carry no slice topology) and drives the
+production path end-to-end: mesh construction, DeviceRunner,
+CountBatcher replica scatter, executor dispatch — Count(Intersect),
+32 concurrent batched counts, TopN, BSI Sum(Range), GroupBy, query
+stream, all asserted against host set algebra, plus the check that the
+data plane never shards over the replica axis (the DCN carries queries,
+not corpus).
+"""
+
+import pytest
+
+import __graft_entry__ as graft
+
+
+def test_executor_on_two_slice_multislice_mesh():
+    import jax
+
+    devs = jax.devices()
+    if len(devs) < 8:
+        pytest.skip("needs the 8-virtual-device test mesh")
+    graft.run_multislice_dryrun(devs[:8])
