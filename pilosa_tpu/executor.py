@@ -797,7 +797,7 @@ class Executor:
         scount = 0
         if tanimoto:
             # Tanimoto count bounds (fragment.go:1043-1060):
-            # tanimoto(a, b) >= T/100 requires |b| in
+            # tanimoto(a, b) > T/100 requires |b| in
             # (|src|*T/100, |src|*100/T) — rows outside the band are
             # skipped WITHOUT materialization. The band tests EXACT row
             # counts from container metadata, not merged cache counts: a
@@ -897,9 +897,11 @@ class Executor:
         keep = totals > 0
         if tanimoto:
             # scount arrives from the caller; cand_counts are EXACT here
-            # (the band recounted them)
-            keep &= 100 * totals >= tanimoto * (cand_counts + scount
-                                                - totals)
+            # (the band recounted them). STRICT, like the dense
+            # tanimoto_mask (reference fragment.go:1096-1100 drops
+            # equality-at-threshold rows)
+            keep &= 100 * totals > tanimoto * (cand_counts + scount
+                                               - totals)
         ids, counts = cand_ids[keep], totals[keep]
         if n is not None and ids.size > n:
             # top n by (count desc, id asc) — matches the dense walk
@@ -950,7 +952,9 @@ class Executor:
                 if tanimoto:
                     rcounts = np.asarray(popcount(slab)).sum(axis=1)
                     scount = int(np.asarray(popcount(src_dense)).sum())
-                    keep = 100 * counts >= tanimoto * (rcounts + scount - counts)
+                    # STRICT like tanimoto_mask / the sparse walk: the
+                    # distributed phase-2 recount must agree with phase 1
+                    keep = 100 * counts > tanimoto * (rcounts + scount - counts)
                     counts = np.where(keep, counts, 0)
             else:
                 counts = np.asarray(popcount(slab)).sum(axis=1)  # [R]
@@ -969,8 +973,13 @@ class Executor:
         previous = call.args.get("previous")
         if isinstance(previous, str):
             # keyed paging: previous is a row KEY (rows() RowKey handling,
-            # executor.go:2693); unknown key -> no lower bound
+            # executor.go:2693). An unknown/stale key must ERROR, not
+            # silently restart paging from the beginning (the client would
+            # re-receive the full result set)
+            prev_key = previous
             previous = self._translate_row(index, f, previous, create=False)
+            if previous is None:
+                raise ExecutionError(f"row key not found: {prev_key!r}")
         else:
             previous = call.uint_arg("previous")  # validated: `previous+1`
             # must not shift semantics for fractional inputs

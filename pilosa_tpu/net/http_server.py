@@ -368,6 +368,28 @@ class Handler:
             mm = getattr(ex, "minmax_batcher", None)
             if mm is not None:
                 snap["minMaxBatcher"] = mm.snapshot()
+        holder = getattr(self.api, "holder", None)
+        if holder is not None:
+            # volatility surface (frozen bulk loads are NOT durable until
+            # an explicit snapshot; mutations on them ride the same
+            # contract): operators see which fragments would lose
+            # acknowledged writes on restart, and how many such writes
+            # have been taken
+            vol = []
+            # list() copies: handler threads may be creating indexes/
+            # fields/views/fragments concurrently (holder.py walk rule)
+            for iname, idx in list(holder.indexes.items()):
+                for fname, fld in list(idx.fields.items()):
+                    for vname, view in list(fld.views.items()):
+                        for shard, frag in list(view.fragments.items()):
+                            if getattr(frag, "_volatile", False):
+                                vol.append({
+                                    "index": iname, "field": fname,
+                                    "view": vname, "shard": shard,
+                                    "mutations": frag.volatile_mutations,
+                                })
+            if vol:
+                snap["volatileFragments"] = vol
         return self._json(snap)
 
     def get_debug_pprof(self, params, query, body):

@@ -51,7 +51,7 @@ def tanimoto_counts(rows: jax.Array, src: jax.Array):
     """Fused per-row (intersection, row, src) counts for Tanimoto filtering.
 
     tanimoto(a, b) = |a∩b| / (|a| + |b| - |a∩b|); the reference keeps rows
-    where 100·tanimoto ≥ threshold (fragment.go:1121-1136). Division-free
+    where ceil(100·tanimoto) > threshold (fragment.go:1096-1100). Division-free
     form evaluated host-side or via tanimoto_mask.
     """
     inter = popcount(jnp.bitwise_and(rows, src[None]))
@@ -63,5 +63,9 @@ def tanimoto_counts(rows: jax.Array, src: jax.Array):
 @jax.jit
 def tanimoto_mask(inter: jax.Array, rcounts: jax.Array, scount: jax.Array,
                   threshold: jax.Array) -> jax.Array:
-    """Boolean keep-mask: 100·inter ≥ threshold·(rcounts + scount − inter)."""
-    return 100 * inter >= threshold * (rcounts + scount - inter)
+    """Boolean keep-mask: 100·inter > threshold·(rcounts + scount − inter).
+
+    STRICT, matching the reference's `ceil(100·count/union) <= T → skip`
+    (fragment.go:1096-1100): for integer T, ceil(x) > T ⟺ x > T, so a row
+    whose tanimoto equals exactly T/100 is dropped."""
+    return 100 * inter > threshold * (rcounts + scount - inter)

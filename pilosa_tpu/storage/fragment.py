@@ -46,6 +46,30 @@ SNAPSHOT_EXT = ".snapshotting"
 CACHE_EXT = ".cache"
 LOCK_EXT = ".lock"
 
+# (lock_file, mmap) pairs deliberately held past close() because zero-copy
+# numpy views over the mapping are still exported (see Fragment.close):
+# pinned here so refcounting can't close the fd behind our back. Each
+# open() reaps entries whose views have since died (mmap closes cleanly),
+# releasing their flocks; anything still referenced stays locked — at
+# worst for the rest of the process, the views' maximum lifetime.
+# _HELD_LOCKS_MU guards the list: a reap racing a close() must not drop
+# a freshly appended entry (that would release a flock under live views).
+_HELD_LOCKS: list = []
+_HELD_LOCKS_MU = threading.Lock()
+
+
+def _reap_held_locks() -> None:
+    with _HELD_LOCKS_MU:
+        alive = []
+        for lock_file, mm in _HELD_LOCKS:
+            try:
+                mm.close()
+            except BufferError:
+                alive.append((lock_file, mm))
+                continue
+            lock_file.close()  # releases the flock
+        _HELD_LOCKS[:] = alive
+
 
 def _locked(method):
     """Serialize a mutating Fragment method under the per-fragment write
@@ -130,6 +154,11 @@ class Fragment:
         # (a billion-row frozen corpus must not be rewritten as a side
         # effect of a small follow-up import); snapshot() clears it
         self._volatile = False
+        # mutation events taken while volatile (acknowledged writes that
+        # would be lost on restart until an explicit snapshot) — surfaced
+        # in /debug/vars volatileFragments so the volatility is visible
+        # to operators, not just a code comment
+        self.volatile_mutations = 0
         # Cached block checksums, invalidated per-block on writes
         # (fragment.go:1226-1305).
         self._block_checksums: dict[int, bytes] = {}
@@ -152,6 +181,7 @@ class Fragment:
         in the mmap until first access (LazyContainer), so holder open cost
         is proportional to container *metadata*, not data bytes.
         """
+        _reap_held_locks()  # release flocks whose mmap views have died
         os.makedirs(os.path.dirname(self.path), exist_ok=True)
         self._lock_file = open(self.path + LOCK_EXT, "ab")
         try:
@@ -218,15 +248,35 @@ class Fragment:
         # (exported buffers): those make close() impossible — drop our
         # reference instead and let refcounting reclaim the mapping when
         # the last view dies (reads through live views stay valid).
+        live_mm = None
         if self._mmap is not None:
             try:
                 self._mmap.close()
             except BufferError:
-                pass
+                # frozen-parsed stores hold zero-copy numpy views over the
+                # mapping themselves: drop OUR storage reference and retry
+                # — then only views handed out to EXTERNAL consumers
+                # (query results still referencing the flat arrays) keep
+                # the mapping alive
+                self.storage = Bitmap()
+                try:
+                    self._mmap.close()
+                except BufferError:
+                    live_mm = self._mmap
             self._mmap = None
         if self._lock_file is not None:
-            self._lock_file.close()  # releases the flock
-            self._lock_file = None
+            if live_mm is not None:
+                # HOLD the flock while views are live: releasing it would
+                # let another process rewrite/truncate the snapshot under
+                # still-referenced views (stale reads, or SIGBUS on
+                # truncate). Reaped by a later open() once the last view
+                # dies; held to process exit otherwise.
+                with _HELD_LOCKS_MU:
+                    _HELD_LOCKS.append((self._lock_file, live_mm))
+                self._lock_file = None
+            else:
+                self._lock_file.close()  # releases the flock
+                self._lock_file = None
         self.closed = True
 
     # -- mutation -----------------------------------------------------------
@@ -235,6 +285,8 @@ class Fragment:
         self.generation += 1
         self._row_gen[row_id] = self.generation
         self._block_checksums.pop(row_id // HASH_BLOCK_SIZE, None)
+        if self._volatile:
+            self.volatile_mutations += 1
 
     def row_generation(self, row_id: int) -> int:
         return max(self._row_gen.get(row_id, 0), self._bulk_gen)
@@ -758,6 +810,7 @@ class Fragment:
             self.storage.op_writer = self._op_file
             self.storage.op_sync = self.wal_fsync
         self._volatile = False  # persisted: WAL re-attached, durable again
+        self.volatile_mutations = 0
 
     def _remap_after_snapshot(self) -> None:
         """Swap storage onto the freshly-written file (the reference remaps

@@ -596,8 +596,12 @@ def test_keyed_rows_paging(keyed):
     assert set(keys) == {"x", "y", "z"}
     (page,) = e.execute("ki", f'Rows(field=f, previous="{keys[0]}")')
     assert page.row_keys == keys[1:]
-    (page,) = e.execute("ki", 'Rows(field=f, previous="nosuch")')
-    assert page.row_keys == keys  # unknown key: no lower bound
+    # unknown/stale previous key ERRORS (translate-or-error, ADVICE r4):
+    # silently restarting from the beginning would re-send the full set
+    # to a paging client
+    from pilosa_tpu.executor import ExecutionError
+    with pytest.raises(ExecutionError, match="nosuch"):
+        e.execute("ki", 'Rows(field=f, previous="nosuch")')
 
 
 def test_rows_previous_validation(wex):

@@ -451,3 +451,38 @@ def test_row_counts_overlay_after_single_bit_writes(tmp_path):
     f.bulk_import([5], [123])
     assert f.row_counts([0, 1, 2, 3, 5]).tolist() == [1, 2, 1, 1, 1]
     f.close()
+
+
+def test_close_with_live_mmap_views_holds_flock(tmp_path):
+    """ADVICE r4: close() must not release the flock while zero-copy views
+    over the snapshot mmap are still exported — another process could
+    rewrite/truncate the file under them. Without external views the lock
+    releases normally (same-process reopen works); with a live external
+    view the lock is held until the process exits."""
+    path = str(tmp_path / "fz")
+    # >= FROZEN_PARSE_MIN containers so reopen takes the zero-copy frozen
+    # parse (one bit in each of 4096 rows x 16 container subs)
+    rows = np.repeat(np.arange(4096, dtype=np.uint64), 16)
+    cols = np.tile(np.arange(16, dtype=np.uint64) * np.uint64(65536), 4096)
+    pos = np.sort(rows * np.uint64(SHARD_WIDTH) + cols)
+    f = Fragment(path, "i", "f", "standard", 0).open()
+    f.import_frozen(pos)
+    f.snapshot()
+    f.close()
+
+    # reopen parses the snapshot into a frozen store backed by the mmap
+    g = Fragment(path, "i", "f", "standard", 0).open()
+    from pilosa_tpu.storage.frozen import FrozenContainers
+    assert isinstance(g.storage.containers, FrozenContainers)
+    # case 1: no external views -> close releases the lock, reopen works
+    g.close()
+    h = Fragment(path, "i", "f", "standard", 0).open()
+    # case 2: an external zero-copy view outlives close -> flock held
+    view = h.storage.containers._lows[:10]  # mmap-backed slice
+    h.close()
+    with pytest.raises(RuntimeError, match="locked"):
+        Fragment(path, "i", "f", "standard", 0).open()
+    del view  # last view dies -> mapping reclaimed
+    k = Fragment(path, "i", "f", "standard", 0).open()
+    assert k.bit_count() == pos.size
+    k.close()

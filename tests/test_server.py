@@ -1140,3 +1140,25 @@ def test_import_iso_timestamps(server):
     status, out = jpost(u, "/index/ts/field/t/import", {
         "rowIDs": [1], "columnIDs": [7], "timestamps": ["not-a-time"]})
     assert status >= 400 and "invalid import timestamp" in json.dumps(out)
+
+
+def test_debug_vars_surfaces_volatile_fragments(server, tmp_path):
+    """ADVICE r4: frozen-loaded (volatile) fragments and their at-risk
+    mutation counts are visible in /debug/vars until a snapshot makes
+    them durable."""
+    import numpy as np
+
+    idx = server.holder.create_index("vi", track_existence=False)
+    f = idx.create_field("f")
+    rows = np.repeat(np.arange(50, dtype=np.uint64), 20)
+    cols = np.tile(np.arange(20, dtype=np.uint64), 50)
+    f.import_rows_frozen(rows, cols)
+    frag = f.view("standard").fragment(0)
+    frag.set_bit(5, 999)  # acknowledged write that is NOT yet durable
+    _, dv = http("GET", server.uri, "/debug/vars")
+    vf = json.loads(dv).get("volatileFragments")
+    assert vf == [{"index": "vi", "field": "f", "view": "standard",
+                   "shard": 0, "mutations": 1}]
+    frag.snapshot()
+    _, dv = http("GET", server.uri, "/debug/vars")
+    assert "volatileFragments" not in json.loads(dv)

@@ -58,7 +58,9 @@ def test_tanimoto():
     thr = 5
     mask = np.asarray(topn.tanimoto_mask(inter, rcounts, scount, np.int32(thr)))
     for i, c in enumerate(cols):
-        t = 100 * len(c & ssrc) >= thr * (len(c) + len(ssrc) - len(c & ssrc))
+        # STRICT (reference fragment.go:1096-1100): equality at the
+        # threshold is dropped
+        t = 100 * len(c & ssrc) > thr * (len(c) + len(ssrc) - len(c & ssrc))
         assert bool(mask[i]) == t
 
 
@@ -286,3 +288,22 @@ def test_topn_src_sparse_matches_dense(tmp_path):
         assert [tuple(p) for p in a2] == [(-nr, c) for c, nr in brute2]
     finally:
         h.close()
+
+
+def test_tanimoto_boundary_strict_parity():
+    """A row whose tanimoto equals EXACTLY threshold/100 is dropped by
+    both the dense mask and the sparse host walk (reference keeps only
+    ceil(100·count/union) > T, fragment.go:1096-1100)."""
+    import numpy as np
+    # inter=1, row=2, src=2 -> union=3, tanimoto=1/3; T=33: 100*1 > 33*3
+    # (100 > 99, kept); T=34: 100 < 102 (dropped). Exact equality case:
+    # inter=1, union=4, T=25 -> 100*1 == 25*4 -> DROPPED (strict).
+    inter = np.array([1], dtype=np.int32)
+    rcounts = np.array([3], dtype=np.int32)  # union = 3+2-1 = 4
+    scount = np.int32(2)
+    keep_25 = np.asarray(topn.tanimoto_mask(inter, rcounts, scount,
+                                            np.int32(25)))
+    assert not bool(keep_25[0])  # equality at threshold -> dropped
+    keep_24 = np.asarray(topn.tanimoto_mask(inter, rcounts, scount,
+                                            np.int32(24)))
+    assert bool(keep_24[0])
