@@ -97,6 +97,10 @@ class API:
         # api.validateShardOwnership, api.go:804)
         self.forward_import_fn = None
         self.forward_roaring_fn = None
+        # indirect liveness probe hook (memberlist indirect ping): probes
+        # the given uri's /status on a requester's behalf; wired by the
+        # server (returns False when unwired — a lone API can't vouch)
+        self.probe_peer_fn = None
         # slow-query logging (cluster.longQueryTime, api.go:1038; server
         # option server.go:121). 0 disables.
         self.long_query_time = 0.0
@@ -530,6 +534,15 @@ class API:
 
     def hosts(self) -> list[dict]:
         return [n.to_dict() for n in self.cluster.nodes]
+
+    def probe_peer(self, target_uri: str) -> bool:
+        """Probe a peer's /status on a requester's behalf (indirect ping)."""
+        if self.probe_peer_fn is None:
+            return False
+        try:
+            return bool(self.probe_peer_fn(target_uri))
+        except Exception:  # noqa: BLE001 — any failure means not-alive
+            return False
 
     def node(self) -> dict:
         n = self.cluster.local_node

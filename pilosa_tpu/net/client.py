@@ -177,6 +177,19 @@ class InternalClient:
         out = self._request("GET", uri, "/internal/nodes", timeout=timeout)
         return json.loads(out)
 
+    def probe_indirect(self, uri: str, target_uri: str,
+                       timeout: Optional[float] = None) -> bool:
+        """Ask peer `uri` to probe `target_uri` on our behalf (memberlist
+        indirect ping, gossip/gossip.go probe path): distinguishes a dead
+        node from a broken link between us and it."""
+        import urllib.parse
+
+        out = self._request(
+            "GET", uri,
+            "/internal/probe?uri=" + urllib.parse.quote(target_uri, safe=""),
+            timeout=timeout)
+        return bool(json.loads(out).get("alive")) if out else False
+
     def status(self, uri: str, timeout: Optional[float] = None) -> dict:
         out = self._request("GET", uri, "/status", timeout=timeout)
         return json.loads(out) if out else {}

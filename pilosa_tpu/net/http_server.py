@@ -54,6 +54,7 @@ ROUTES: list[tuple[str, re.Pattern, str]] = [
     ("POST", re.compile(r"^/internal/index/(?P<index>[^/]+)/field/(?P<field>[^/]+)/attr/diff$"), "post_row_attr_diff"),
     ("DELETE", re.compile(r"^/internal/index/(?P<index>[^/]+)/field/(?P<field>[^/]+)/remote-available-shards/(?P<shard>\d+)$"), "delete_remote_available_shard"),
     ("GET", re.compile(r"^/internal/nodes$"), "get_nodes"),
+    ("GET", re.compile(r"^/internal/probe$"), "get_internal_probe"),
     ("GET", re.compile(r"^/internal/shards/max$"), "get_shards_max"),
     ("GET", re.compile(r"^/internal/translate/data$"), "get_translate_data"),
     ("POST", re.compile(r"^/internal/translate/keys$"), "post_translate_keys"),
@@ -514,6 +515,17 @@ class Handler:
 
     def get_nodes(self, params, query, body):
         return self._json(self.api.hosts())
+
+    def get_internal_probe(self, params, query, body):
+        """Indirect liveness probe (memberlist indirect ping): probe the
+        given peer uri on the requester's behalf and report whether it
+        answered /status. Lets a suspecting node distinguish a dead peer
+        from a broken link between itself and that peer."""
+        target = self._arg(query, "uri")
+        if not target:
+            raise ApiError("uri is required")
+        alive = self.api.probe_peer(target)
+        return self._json({"alive": alive})
 
     def get_shards_max(self, params, query, body):
         return self._json({"standard": self.api.max_shards()})

@@ -124,6 +124,16 @@ class Server:
         self.liveness_threshold = liveness_threshold
         self.probe_timeout = probe_timeout
         self._probe_failures: dict[str, int] = {}
+        # consecutive successful probes of a DOWN node (anti-flap: one
+        # lucky probe must not flip a struggling peer back into placement
+        # only to flap out again next tick)
+        self._probe_successes: dict[str, int] = {}
+        # successes required to revive a down node (memberlist-style
+        # hysteresis; 1 = the old instant-revive behavior)
+        self.revive_threshold = 2
+        # peers asked to confirm a suspected-dead node before we mark it
+        # down (memberlist indirect ping fan-out)
+        self.indirect_probes = 2
         # node ids with an in-flight return-heal (single-flight per node)
         self._return_sync_running: set[str] = set()
         # join=True: this node is being added to an existing cluster —
@@ -218,6 +228,9 @@ class Server:
         self.api.long_query_time = self.long_query_time
         self.api.max_writes_per_request = self.max_writes_per_request
         self.api.logger = self.logger
+        self.api.probe_peer_fn = (
+            lambda target_uri: bool(
+                self.client.status(target_uri, timeout=self.probe_timeout)))
         if self.anti_entropy_interval > 0:
             self._schedule_anti_entropy()
         if self.cache_flush_interval > 0:
@@ -321,6 +334,8 @@ class Server:
         peer_ids = {n.id for n in peers}
         for stale in set(self._probe_failures) - peer_ids:
             del self._probe_failures[stale]
+        for stale in set(self._probe_successes) - peer_ids:
+            del self._probe_successes[stale]
         if not peers:
             return
 
@@ -346,27 +361,103 @@ class Server:
             threads.append(t)
         for t in threads:
             t.join(self.probe_timeout + 1.0)
+        suspects: list = []
         for node in peers:
             alive = results.get(node.id, False)
             if alive:
+                self._probe_failures.pop(node.id, None)
                 if self.cluster.is_down(node.id):
+                    # anti-flap hysteresis: a down node needs
+                    # revive_threshold CONSECUTIVE good probes before it
+                    # re-enters placement (memberlist's suspicion decay —
+                    # one lucky probe of a struggling peer must not flap
+                    # it up only to fall out again next tick)
+                    ok = self._probe_successes.get(node.id, 0) + 1
+                    if ok < self.revive_threshold:
+                        self._probe_successes[node.id] = ok
+                        continue
+                    self._probe_successes.pop(node.id, None)
                     self.logger.printf("liveness: node %s (%s) back up",
                                        node.id, node.uri)
                     self.cluster.mark_up(node.id)
                     self._on_node_return(node)
-                self._probe_failures.pop(node.id, None)
             else:
+                self._probe_successes.pop(node.id, None)
                 n = self._probe_failures.get(node.id, 0) + 1
                 self._probe_failures[node.id] = n
                 if (n >= self.liveness_threshold
                         and not self.cluster.is_down(node.id)):
-                    self.logger.printf(
-                        "liveness: node %s (%s) failed %d probes, marking "
-                        "down (cluster -> %s)", node.id, node.uri, n,
-                        "DEGRADED" if len(self.cluster.down_ids) + 1
-                        < self.cluster.replica_n else "STARTING")
-                    self.cluster.mark_down(node.id)
-                    self.stats.count("liveness/node_down")
+                    suspects.append(node)
+        if not suspects:
+            return
+        # SUSPECT phase: before declaring a peer dead, ask other live
+        # peers to probe it for us (memberlist indirect ping) — a broken
+        # link between us and the peer must not evict a node the rest of
+        # the cluster can reach. All suspects are checked concurrently
+        # (same rule as the direct probes: N suspects must not serialize
+        # N timeouts on the membership-tick thread).
+        refuted: dict[str, bool] = {}
+        checkers = []
+        for node in suspects:
+            t = threading.Thread(
+                target=lambda nd=node: refuted.__setitem__(
+                    nd.id, self._indirect_confirms_alive(nd, peers, results)),
+                daemon=True)
+            t.start()
+            checkers.append(t)
+        deadline = 3 * self.probe_timeout + 3.0
+        for t in checkers:
+            t.join(deadline)
+        for node in suspects:
+            if refuted.get(node.id):
+                self.logger.printf(
+                    "liveness: node %s (%s) suspected after %d failed "
+                    "probes but refuted by indirect probe (link problem, "
+                    "not node death)", node.id, node.uri,
+                    self._probe_failures.get(node.id, 0))
+                self._probe_failures.pop(node.id, None)
+                self.stats.count("liveness/suspect_refuted")
+                continue
+            self.logger.printf(
+                "liveness: node %s (%s) failed %d probes, marking "
+                "down (cluster -> %s)", node.id, node.uri,
+                self._probe_failures.get(node.id, 0),
+                "DEGRADED" if len(self.cluster.down_ids) + 1
+                < self.cluster.replica_n else "STARTING")
+            self.cluster.mark_down(node.id)
+            self.stats.count("liveness/node_down")
+
+    def _indirect_confirms_alive(self, target, peers, results) -> bool:
+        """Ask up to `indirect_probes` live peers whether THEY can reach
+        the suspected node (gossip/gossip.go probe path). True if any
+        vouches for it. Helpers are asked CONCURRENTLY (same rule as the
+        direct probes: N suspects must not serialize N timeouts on the
+        membership-tick thread), and the outer RPC deadline leaves room
+        for the helper's own nested probe_timeout — a genuine vouch for a
+        slow-but-alive node must not be discarded by our socket closing
+        first."""
+        helpers = [p for p in peers
+                   if p.id != target.id and results.get(p.id)
+                   and not self.cluster.is_down(p.id)][:self.indirect_probes]
+        if not helpers:
+            return False
+        outer_timeout = 2 * self.probe_timeout + 1.0
+        votes: dict[str, bool] = {}
+
+        def ask(helper):
+            try:
+                votes[helper.id] = self.client.probe_indirect(
+                    helper.uri, target.uri, timeout=outer_timeout)
+            except Exception:  # noqa: BLE001 — helper unreachable: no vote
+                votes[helper.id] = False
+
+        threads = [threading.Thread(target=ask, args=(h,), daemon=True)
+                   for h in helpers]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(outer_timeout + 1.0)
+        return any(votes.values())
 
     def _on_node_return(self, node) -> None:
         """Heal a peer that was probe-marked down and came back: broadcasts

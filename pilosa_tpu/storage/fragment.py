@@ -769,6 +769,11 @@ class Fragment:
         self._row_gen.clear()  # all rows considered dirty
         self._bulk_gen = self.generation
         self._block_checksums.clear()
+        if self._volatile:
+            # bulk writes bypass _touch: count them so /debug/vars'
+            # volatileFragments reflects EVERY acknowledged-but-not-
+            # durable write, not just the single-bit paths
+            self.volatile_mutations += 1
         self._maybe_snapshot()
 
     # -- snapshot / WAL compaction (fragment.go:1707-1781) ------------------
@@ -989,6 +994,8 @@ class Fragment:
         self._row_gen.clear()
         self._bulk_gen = self.generation
         self._block_checksums.clear()
+        if self._volatile:
+            self.volatile_mutations += 1  # see import_roaring
         self._maybe_snapshot()
 
     # -- identity -----------------------------------------------------------

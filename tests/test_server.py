@@ -327,6 +327,79 @@ def test_liveness_detects_crashed_node(cluster3):
     assert s0.cluster.node_by_id(s2.node_id).state == "READY"
 
 
+def test_suspect_refuted_by_indirect_probe(cluster3):
+    """A peer WE can't reach but other nodes can is NOT marked down: the
+    suspicion is refuted by an indirect probe through a live peer
+    (memberlist indirect ping — a broken link must not evict a healthy
+    node)."""
+    s0, s1, s2 = cluster3
+    orig = s0.client.status
+
+    def broken_link(uri, timeout=None):
+        if uri == s2.uri:
+            raise OSError("simulated one-way link failure")
+        return orig(uri, timeout=timeout)
+
+    s0.client.status = broken_link
+    try:
+        s0.probe_timeout = 1.0
+        for _ in range(s0.liveness_threshold + 2):
+            s0._probe_peers()
+        # s1 vouched for s2 over /internal/probe: still up, counter reset
+        assert not s0.cluster.is_down(s2.node_id)
+        assert s0._probe_failures.get(s2.node_id, 0) < s0.liveness_threshold
+        assert s0.cluster.state == "NORMAL"
+    finally:
+        s0.client.status = orig
+
+
+def test_down_node_revives_only_after_consecutive_successes(cluster3):
+    """Anti-flap hysteresis: a down node needs revive_threshold
+    CONSECUTIVE successful probes to re-enter placement; one lucky probe
+    between failures does not flap it up."""
+    s0, s1, s2 = cluster3
+    orig = s0.client.status
+    fail = {"on": True}
+
+    def flaky(uri, timeout=None):
+        if uri == s2.uri and fail["on"]:
+            raise OSError("down")
+        return orig(uri, timeout=timeout)
+
+    s0.client.status = flaky
+    # also break s1's view of s2 so the indirect probe can't refute
+    orig1 = s1.client.status
+
+    def down_for_s1(uri, timeout=None):
+        if uri == s2.uri:
+            raise OSError("down")
+        return orig1(uri, timeout=timeout)
+
+    s1.client.status = down_for_s1
+    # s0's indirect helper is s1, whose probe_peer_fn uses s1.client.status
+    try:
+        s0.probe_timeout = 1.0
+        for _ in range(s0.liveness_threshold):
+            s0._probe_peers()
+        assert s0.cluster.is_down(s2.node_id)
+        # one good probe: NOT yet revived (hysteresis)
+        fail["on"] = False
+        s0._probe_peers()
+        assert s0.cluster.is_down(s2.node_id)
+        # a failure in between resets the success streak
+        fail["on"] = True
+        s0._probe_peers()
+        fail["on"] = False
+        s0._probe_peers()
+        assert s0.cluster.is_down(s2.node_id)
+        # second consecutive success: revived
+        s0._probe_peers()
+        assert not s0.cluster.is_down(s2.node_id)
+    finally:
+        s0.client.status = orig
+        s1.client.status = orig1
+
+
 @pytest.fixture
 def cluster3_r3(tmp_path):
     """3 nodes, ReplicaN=3: every node owns every shard — the consensus
