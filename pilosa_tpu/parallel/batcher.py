@@ -21,6 +21,18 @@ request is the queue head — then promotes the next queued request to
 leader (or releases leadership if the queue drained). One batch per leader
 keeps tail latency fair: no thread serves strangers after its own query is
 answered. Errors wake every waiter in the failed batch.
+
+Pipelining: a batch's life is dispatch (enqueue the program on the device —
+JAX is async, this returns immediately) then finalize (fetch results — one
+full link round trip on a tunneled chip). Leadership hands off right after
+DISPATCH, so the next leader launches batch N+1 while batch N's results are
+still in flight: throughput is dispatch-rate-bound, not round-trip-bound.
+With an RTT of ~100 ms (observed on the axon tunnel) and one batch in
+flight, a 32-query batch caps at ~280 q/s no matter how fast the chip is;
+overlapped batches stack toward the chip's actual rate. In-flight depth is
+naturally bounded by the client thread count — every finalize runs on the
+thread that led that batch. Subclasses implement _dispatch/_finalize (or
+legacy one-shot _compute, which degrades to dispatch-and-fetch in one step).
 """
 
 from __future__ import annotations
@@ -38,6 +50,8 @@ import numpy as np
 from pilosa_tpu.ops.bitvector import popcount
 
 MAX_BATCH = 512
+_LEGACY = object()  # _dispatch sentinel: subclass only implements _compute
+_FAILED = object()  # dispatch raised; error already delivered to the batch
 # follower wait poll: bounds the hang window if a leader thread dies for a
 # non-exception reason (interpreter teardown, thread kill) — followers
 # re-check leader liveness and reclaim leadership
@@ -66,7 +80,8 @@ def _pow2(n: int) -> int:
 
 
 class _Req:
-    __slots__ = ("payload", "event", "result", "exc", "promoted", "done")
+    __slots__ = ("payload", "event", "result", "exc", "promoted", "done",
+                 "server")
 
     def __init__(self, payload):
         self.payload = payload
@@ -76,6 +91,10 @@ class _Req:
         self.promoted = False  # woken to take over leadership, not served
         self.done = False  # result/exc actually delivered (event alone is
         # ambiguous: promotion also sets it)
+        self.server: Optional[threading.Thread] = None  # thread serving the
+        # batch this request was popped into (set at pop; liveness checks
+        # must consult it, not the leadership slot — leadership hands off
+        # at dispatch while this batch's finalize is still in flight)
 
 
 class ContinuousBatcher:
@@ -110,18 +129,23 @@ class ContinuousBatcher:
                 with self._lock:
                     if req.done:
                         break  # delivered in the wait-timeout window
-                    t = self._leader_threads.get(key)
-                    if t is not None and t.is_alive():
-                        continue  # leader healthy (maybe mid-dispatch)
                     if req in self._pending.get(key, ()):
+                        t = self._leader_threads.get(key)
+                        if t is not None and t.is_alive():
+                            continue  # leader healthy (maybe mid-dispatch)
                         # dead leader, our request still queued: take over
                         self._leaders.add(key)
                         self._leader_threads[key] = threading.current_thread()
                         req.promoted = True
                         req.event.set()
                     else:
-                        # the dead leader took our request into its batch
-                        # and never delivered: error beats a silent hang
+                        # popped into a batch: its results may still be in
+                        # flight on the SERVING thread (leadership already
+                        # handed off at dispatch) — only that thread dying
+                        # means the result is never coming
+                        t = req.server
+                        if t is not None and t.is_alive():
+                            continue  # finalize in flight
                         req.exc = RuntimeError(
                             "batch leader died mid-compute")
                         req.event.set()
@@ -149,7 +173,10 @@ class ContinuousBatcher:
             if req.done:
                 break
             with self._lock:
-                t = self._leader_threads.get(key)
+                # in another leader's in-flight batch: that SERVING thread
+                # (not the current leadership holder) owes us the result
+                t = req.server if req.server is not None \
+                    else self._leader_threads.get(key)
                 if (t is None or not t.is_alive()) and not req.done:
                     req.exc = RuntimeError("batch leader died mid-compute")
                     break
@@ -162,8 +189,16 @@ class ContinuousBatcher:
             self._leader_threads[key] = threading.current_thread()
             q = self._pending[key]
             batch, q[:] = q[:self.max_batch], q[self.max_batch:]
+            for r in batch:  # liveness anchor for followers (see _Req)
+                r.server = threading.current_thread()
+        handle = _FAILED
         if batch:
-            self._run(key, batch)
+            try:
+                handle = self._dispatch(key, [r.payload for r in batch])
+            except BaseException as e:  # noqa: BLE001 — waiters must wake
+                self._deliver_exc(batch, e)
+        # leadership hands off HERE — after dispatch, before the blocking
+        # result fetch — so the next leader's batch overlaps this round trip
         with self._lock:
             q = self._pending[key]
             if q:
@@ -176,10 +211,13 @@ class ContinuousBatcher:
                 # slabs) are unbounded over a server's life, and a retired
                 # slab's key would otherwise linger forever
                 del self._pending[key]
+        if batch and handle is not _FAILED:
+            self._run(key, batch, handle)
 
-    def _run(self, key: tuple, batch: list[_Req]) -> None:
+    def _run(self, key: tuple, batch: list[_Req], handle) -> None:
         try:
-            results = self._compute(key, [r.payload for r in batch])
+            results = self._finalize(key, handle,
+                                     [r.payload for r in batch])
             if len(results) != len(batch):
                 # a length bug must surface as an exception delivered to
                 # EVERY waiter, not leave the unpaired ones blocked forever
@@ -195,10 +233,29 @@ class ContinuousBatcher:
                 r.done = True
                 r.event.set()
         except BaseException as e:  # noqa: BLE001 — waiters must wake
-            for r in batch:
-                r.exc = e
-                r.done = True
-                r.event.set()
+            self._deliver_exc(batch, e)
+
+    @staticmethod
+    def _deliver_exc(batch: list[_Req], e: BaseException) -> None:
+        for r in batch:
+            r.exc = e
+            r.done = True
+            r.event.set()
+
+    # -- compute hooks ----------------------------------------------------
+    # Subclasses either implement the pipelined pair — _dispatch launches
+    # device work and returns a handle WITHOUT fetching; _finalize blocks
+    # on the handle and unpacks per-payload results — or just legacy
+    # one-shot _compute (then dispatch is a no-op and finalize does all
+    # the work inside the round trip, losing overlap but staying correct).
+
+    def _dispatch(self, key: tuple, payloads: list):
+        return _LEGACY
+
+    def _finalize(self, key: tuple, handle, payloads: list) -> list:
+        if handle is _LEGACY:
+            return self._compute(key, payloads)
+        raise NotImplementedError
 
     def _compute(self, key: tuple, payloads: list) -> list:
         raise NotImplementedError
@@ -308,7 +365,7 @@ class CountBatcher(ContinuousBatcher):
             op, b = "id", a
         return self.submit((op, tuple(a.shape), str(a.dtype)), (a, b))
 
-    def _compute(self, key: tuple, payloads: list) -> list:
+    def _dispatch(self, key: tuple, payloads: list):
         op = key[0]
         slots: dict[int, int] = {}
         leaves: list = []
@@ -337,14 +394,34 @@ class CountBatcher(ContinuousBatcher):
         leaves = leaves + [leaves[0]] * (lp - len(leaves))
         if n_rep > 1:
             fn = _replica_counts_fn(self.runner.mesh, op)
-            parts = np.asarray(fn(tuple(leaves), ii, jj))
-        else:
-            parts = np.asarray(_batched_counts(tuple(leaves), ii, jj, op))
+            return fn(tuple(leaves), ii, jj)  # device array, not fetched
+        return _batched_counts(tuple(leaves), ii, jj, op)
+
+    def _finalize(self, key: tuple, handle, payloads: list) -> list:
+        parts = np.asarray(handle)  # blocks: the batch's one round trip
         counts = parts.astype(np.int64).sum(axis=-1)  # exact int64 finish
-        return [int(c) for c in counts[:k]]
+        return [int(c) for c in counts[:len(payloads)]]
 
 
 # -------------------------------------------------------------- BSI sums
+
+
+def _dedup_masks(payloads: list) -> tuple[list, list[int]]:
+    """Dedup identical mask objects (concurrent unfiltered Sums all pass
+    the same residency-cached exists array) and pow2-pad by repeating mask
+    0 so the jit cache stays small; returns (masks, per-payload index)."""
+    slots: dict[int, int] = {}
+    masks: list = []
+    idx = []
+    for _, m in payloads:
+        s = slots.get(id(m))
+        if s is None:
+            s = len(masks)
+            slots[id(m)] = s
+            masks.append(m)
+        idx.append(s)
+    kp = _pow2(len(masks))
+    return masks + [masks[0]] * (kp - len(masks)), idx
 
 
 @jax.jit
@@ -386,22 +463,14 @@ class MinMaxBatcher(ContinuousBatcher):
         return self.submit((id(planes), tuple(planes.shape), is_min),
                            (planes, mask))
 
-    def _compute(self, key: tuple, payloads: list) -> list:
+    def _dispatch(self, key: tuple, payloads: list):
         planes, is_min = payloads[0][0], key[2]
-        slots: dict[int, int] = {}
-        masks: list = []
-        idx = []
-        for _, m in payloads:
-            s = slots.get(id(m))
-            if s is None:
-                s = len(masks)
-                slots[id(m)] = s
-                masks.append(m)
-            idx.append(s)
-        kp = _pow2(len(masks))
-        masks = masks + [masks[0]] * (kp - len(masks))
-        out = np.asarray(_batched_min_max(planes, tuple(masks), is_min))
-        out = out.astype(np.int64)
+        masks, idx = _dedup_masks(payloads)
+        return _batched_min_max(planes, tuple(masks), is_min), idx
+
+    def _finalize(self, key: tuple, handle, payloads: list) -> list:
+        arrs, idx = handle
+        out = np.asarray(arrs).astype(np.int64)  # blocks: the round trip
         return [out[i] for i in idx]
 
 
@@ -416,23 +485,14 @@ class PlaneSumBatcher(ContinuousBatcher):
         return self.submit((id(planes), tuple(planes.shape)),
                            (planes, mask))
 
-    def _compute(self, key: tuple, payloads: list) -> list:
+    def _dispatch(self, key: tuple, payloads: list):
         planes = payloads[0][0]
-        # dedup identical mask objects (concurrent unfiltered Sums all
-        # pass the same residency-cached exists array)
-        slots: dict[int, int] = {}
-        masks: list = []
-        idx = []
-        for _, m in payloads:
-            s = slots.get(id(m))
-            if s is None:
-                s = len(masks)
-                slots[id(m)] = s
-                masks.append(m)
-            idx.append(s)
-        kp = _pow2(len(masks))
-        masks = masks + [masks[0]] * (kp - len(masks))
-        out = np.asarray(_batched_plane_sums(planes, tuple(masks)))
+        masks, idx = _dedup_masks(payloads)
+        return _batched_plane_sums(planes, tuple(masks)), idx
+
+    def _finalize(self, key: tuple, handle, payloads: list) -> list:
+        arrs, idx = handle
+        out = np.asarray(arrs)  # blocks: the batch's one round trip
         # finish the shard-chunk reduction in int64 (exact)
         totals = out.astype(np.int64).sum(axis=-1)  # [kp, depth+1]
         return [totals[i] for i in idx]

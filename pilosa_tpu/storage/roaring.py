@@ -9,13 +9,16 @@ dense-range materialization (the OffsetRange analog, roaring/roaring.go:320,
 used by fragment row reads, fragment.go:361), set algebra for merges, and
 serialization.
 
-In-memory model: two container kinds — a sorted uint16 numpy array
-(cardinality ≤ 4096, ARRAY_MAX_SIZE as roaring/roaring.go:1258) or a 1024-word
-uint64 little-endian bitmap. Run containers exist only on disk
-(roaring/roaring.go:56-62 containerRun): they are inflated on read and chosen
-at write time when the run encoding is smallest, which the format permits
-because container types are explicit in the descriptive header
-(docs/architecture.md: "Container types are NOT inferred").
+In-memory model: three container kinds, matching the reference's
+(roaring/roaring.go:56-62) — a sorted uint16 numpy array (cardinality ≤ 4096,
+ARRAY_MAX_SIZE as roaring/roaring.go:1258), a 1024-word uint64 little-endian
+bitmap, or an [nruns, 2] (start, last) run-interval array. Encoding is
+re-picked cheaply after mutation (array↔bitmap) and fully by `optimize()`
+(the countRuns heuristic, roaring/roaring.go:1261, 1594), which is what
+introduces runs; serialization writes whichever of the three is smallest,
+which the format permits because container types are explicit in the
+descriptive header (docs/architecture.md: "Container types are NOT
+inferred").
 
 File format (docs/architecture.md, roaring/roaring.go:812-985):
   bytes 0-1  magic 12348        (u16 LE)

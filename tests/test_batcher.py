@@ -6,7 +6,7 @@ import threading
 import numpy as np
 import pytest
 
-from pilosa_tpu.parallel.batcher import CountBatcher, _pow2
+from pilosa_tpu.parallel.batcher import ContinuousBatcher, CountBatcher, _pow2
 
 
 def _leaves(n=4, s=2, w=256, seed=0):
@@ -471,3 +471,78 @@ def test_replica_mesh_scatters_batch():
     for k in range(8):
         assert got[k] == int(
             np.bitwise_count(host[ii[k]] & host[jj[k]]).sum())
+
+
+def test_dispatch_overlaps_inflight_finalize():
+    """Leadership must hand off after _dispatch, before _finalize: batch
+    N+1's device launch overlaps batch N's result round trip (through a
+    ~100 ms tunnel this is the difference between batch/RTT and
+    dispatch-rate throughput)."""
+    dispatched = []
+    release = threading.Event()
+    overlap_seen = threading.Event()
+
+    class Slow(ContinuousBatcher):
+        def _dispatch(self, key, payloads):
+            dispatched.append(list(payloads))
+            if len(dispatched) >= 2:
+                overlap_seen.set()
+            return list(payloads)
+
+        def _finalize(self, key, handle, payloads):
+            # first batch's fetch blocks until a SECOND dispatch happened
+            if handle == dispatched[0] and not release.is_set():
+                assert overlap_seen.wait(10.0), \
+                    "no second dispatch while first finalize in flight"
+                release.set()
+            return [p * 2 for p in handle]
+
+    b = Slow(max_batch=1)  # force one payload per batch
+    results = {}
+
+    def client(v):
+        results[v] = b.submit(("k",), v)
+
+    ts = [threading.Thread(target=client, args=(v,)) for v in range(4)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=30)
+    assert results == {v: v * 2 for v in range(4)}
+    assert len(dispatched) == 4
+    assert release.is_set()
+
+
+def test_dispatch_failure_wakes_batch_and_promotes_next():
+    """An exception raised at dispatch time must error that batch's
+    waiters immediately and still hand leadership to the next batch."""
+    calls = []
+
+    class Flaky(ContinuousBatcher):
+        def _dispatch(self, key, payloads):
+            calls.append(list(payloads))
+            if len(calls) == 1:
+                raise RuntimeError("device rejected program")
+            return list(payloads)
+
+        def _finalize(self, key, handle, payloads):
+            return [p + 100 for p in handle]
+
+    b = Flaky(max_batch=1)
+    out = {}
+
+    def client(v):
+        try:
+            out[v] = b.submit(("k",), v)
+        except RuntimeError as e:
+            out[v] = e
+
+    ts = [threading.Thread(target=client, args=(v,)) for v in range(3)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=30)
+    vals = list(out.values())
+    assert sum(isinstance(v, RuntimeError) for v in vals) == 1
+    assert sorted(v for v in vals if isinstance(v, int)) == \
+        [v + 100 for v in sorted(out) if isinstance(out[v], int)]
