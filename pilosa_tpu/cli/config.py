@@ -70,6 +70,20 @@ class TracingConfig:
 
 
 @dataclass
+class GossipSection:
+    """[gossip] — SWIM UDP failure detector (server/config.go:126 defaults
+    Port to "14000"; seeds are host:port gossip addresses). port = -1 keeps
+    the default HTTP probe liveness; 0 binds an ephemeral port (tests);
+    period/probe-timeout scale the SWIM protocol clock
+    (parallel/gossip.py GossipConfig)."""
+    port: int = -1
+    seeds: list[str] = field(default_factory=list)
+    period: float = 1.0
+    probe_timeout: float = 0.5
+    push_pull_interval: float = 10.0
+
+
+@dataclass
 class MeshConfig:
     """Device-mesh section — the TPU analog of the reference's intra-node
     shard concurrency (executor.go:2283): slabs shard over a 1-D GSPMD mesh
@@ -108,6 +122,7 @@ class Config:
     diagnostics: DiagnosticsConfig = field(default_factory=DiagnosticsConfig)
     tracing: TracingConfig = field(default_factory=TracingConfig)
     mesh: MeshConfig = field(default_factory=MeshConfig)
+    gossip: GossipSection = field(default_factory=GossipSection)
 
     @property
     def host(self) -> str:
@@ -128,7 +143,7 @@ class Config:
     def _apply_dict(self, data: dict) -> None:
         for key, value in data.items():
             attr = key.replace("-", "_")
-            if attr in ("tls", "cluster", "anti_entropy", "metric", "diagnostics", "tracing", "mesh") and isinstance(value, dict):
+            if attr in ("tls", "cluster", "anti_entropy", "metric", "diagnostics", "tracing", "mesh", "gossip") and isinstance(value, dict):
                 sub = getattr(self, attr)
                 for k, v in value.items():
                     sk = k.replace("-", "_")
@@ -150,7 +165,7 @@ class Config:
 
     def _set_path(self, parts: list[str], raw: str) -> None:
         # try sub-config first (cluster_replicas -> cluster.replicas)
-        for sub_name in ("tls", "cluster", "anti_entropy", "metric", "diagnostics", "tracing", "mesh"):
+        for sub_name in ("tls", "cluster", "anti_entropy", "metric", "diagnostics", "tracing", "mesh", "gossip"):
             sub_parts = sub_name.split("_")
             if parts[: len(sub_parts)] == sub_parts and len(parts) > len(sub_parts):
                 sub = getattr(self, sub_name)
@@ -195,6 +210,13 @@ class Config:
             f'sampler-type = "{self.tracing.sampler_type}"',
             f"sampler-param = {self.tracing.sampler_param}",
             f'agent-host-port = "{self.tracing.agent_host_port}"',
+            "",
+            "[gossip]",
+            f"port = {self.gossip.port}",
+            f"seeds = [{', '.join(repr(h) for h in self.gossip.seeds)}]",
+            f"period = {self.gossip.period}",
+            f"probe-timeout = {self.gossip.probe_timeout}",
+            f"push-pull-interval = {self.gossip.push_pull_interval}",
             "",
             "[mesh]",
             f'devices = "{self.mesh.devices}"',

@@ -18,6 +18,14 @@ from pilosa_tpu import __version__
 from pilosa_tpu.cli.config import Config, load_config
 
 
+
+def _gossip_config(cfg: Config):
+    """SWIM clock from the [gossip] section."""
+    from pilosa_tpu.parallel.gossip import GossipConfig
+    return GossipConfig(period=cfg.gossip.period,
+                        probe_timeout=cfg.gossip.probe_timeout,
+                        push_pull_interval=cfg.gossip.push_pull_interval)
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="pilosa-tpu",
                                 description="TPU-native distributed bitmap index")
@@ -77,6 +85,10 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def cmd_server(args) -> int:
+    # SIGUSR1 dumps every thread's stack to stderr (hung-server triage —
+    # the /debug/pprof analog when HTTP itself is wedged)
+    import faulthandler
+    faulthandler.register(signal.SIGUSR1, all_threads=True)
     try:
         cfg = load_config(args.config)
     except (OSError, ValueError) as e:
@@ -128,6 +140,9 @@ def cmd_server(args) -> int:
         tls_certificate=cfg.tls.certificate,
         tls_key=cfg.tls.key,
         tls_skip_verify=cfg.tls.skip_verify,
+        gossip_port=cfg.gossip.port if cfg.gossip.port >= 0 else None,
+        gossip_seeds=cfg.gossip.seeds,
+        gossip_config=_gossip_config(cfg),
         tracing_sampler_type=cfg.tracing.sampler_type,
         tracing_sampler_param=cfg.tracing.sampler_param,
         tracing_endpoint=cfg.tracing.agent_host_port,

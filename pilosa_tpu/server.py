@@ -61,7 +61,10 @@ class Server:
                  tls_skip_verify: bool = False,
                  tracing_sampler_type: str = "off",
                  tracing_sampler_param: float = 0.0,
-                 tracing_endpoint: str = ""):
+                 tracing_endpoint: str = "",
+                 gossip_port: Optional[int] = None,
+                 gossip_seeds: Optional[list[str]] = None,
+                 gossip_config=None):
         self.data_dir = data_dir
         self.holder = Holder(data_dir)
         self.node_id = node_id or self._load_or_create_id()
@@ -111,6 +114,7 @@ class Server:
                                stats=self.stats)
         self.http = HTTPServer(self.handler, host=host, port=port,
                                tls_certificate=tls_certificate, tls_key=tls_key)
+        self._bind_host = host
         self.cluster_hosts = cluster_hosts or []
         self.long_query_time = long_query_time
         self.max_writes_per_request = max_writes_per_request
@@ -136,6 +140,14 @@ class Server:
         self.indirect_probes = 2
         # node ids with an in-flight return-heal (single-flight per node)
         self._return_sync_running: set[str] = set()
+        # optional SWIM gossip failure detector (gossip/gossip.go:42-541):
+        # gossip_port switches liveness from the HTTP probe loop to UDP
+        # probe/ack + suspicion + refutation; both drive the same
+        # mark_down/mark_up hooks. 0 = bind an ephemeral port.
+        self.gossip = None
+        self._gossip_port = gossip_port
+        self._gossip_seeds = gossip_seeds or []
+        self._gossip_config = gossip_config
         # join=True: this node is being added to an existing cluster —
         # cluster_hosts are seed URIs (the gossip-seeds analog). It announces
         # itself and stays STARTING until the coordinator's resize completes
@@ -231,6 +243,8 @@ class Server:
         self.api.probe_peer_fn = (
             lambda target_uri: bool(
                 self.client.status(target_uri, timeout=self.probe_timeout)))
+        if self._gossip_port is not None:
+            self._open_gossip()
         if self.anti_entropy_interval > 0:
             self._schedule_anti_entropy()
         if self.cache_flush_interval > 0:
@@ -270,9 +284,65 @@ class Server:
                         if self.cluster.state != STATE_RESIZING \
                                 and self.cluster.active_job is None:
                             self._apply_membership(reports)
-                self._probe_peers()
+                if self.gossip is None:
+                    # otherwise gossip is the failure detector; the HTTP
+                    # probe loop would fight its suspicion timing
+                    self._probe_peers()
         finally:
             self._schedule_membership_refresh()
+
+    # -- SWIM gossip failure detector (optional backend) --------------------
+
+    def _open_gossip(self) -> None:
+        """Start the UDP gossip endpoint and join the seeds. The node's
+        HTTP URI rides the alive record's meta (the NodeMeta channel the
+        reference uses for the same purpose, gossip/gossip.go:248-257), so
+        peers discovered purely by gossip can be admitted to membership."""
+        from pilosa_tpu.parallel.gossip import Gossip, parse_seed
+        self.gossip = Gossip(self.node_id, bind_host=self._bind_host,
+                             bind_port=self._gossip_port,
+                             meta={"uri": self.http.uri},
+                             config=self._gossip_config,
+                             on_alive=self._on_gossip_alive,
+                             on_dead=self._on_gossip_dead,
+                             logger=self.logger)
+        self.gossip.open(seeds=[parse_seed(s) for s in self._gossip_seeds])
+        self.logger.printf("gossip: listening on %s:%d (seeds: %s)",
+                           self.gossip.host, self.gossip.port,
+                           ",".join(self._gossip_seeds) or "none")
+
+    def _on_gossip_dead(self, member) -> None:
+        """Gossip declared a peer dead (suspicion expired un-refuted):
+        the NodeLeave -> route-around path (cluster.go:1690-1703)."""
+        if self.closed or member.id == self.node_id:
+            return
+        if any(n.id == member.id for n in self.cluster.nodes) \
+                and not self.cluster.is_down(member.id):
+            self.logger.printf("gossip: node %s dead (suspicion expired), "
+                               "marking down", member.id)
+            self.cluster.mark_down(member.id)
+            self.stats.count("liveness/node_down")
+
+    def _on_gossip_alive(self, member) -> None:
+        """A peer (re)entered alive state: revive it if it was down, or
+        admit a gossip-discovered node to membership (NotifyJoin,
+        gossip/gossip.go:335-342)."""
+        if self.closed or member.id == self.node_id:
+            return
+        node = next((n for n in self.cluster.nodes if n.id == member.id),
+                    None)
+        if node is None:
+            uri = member.meta.get("uri")
+            if uri and member.id not in self._removed_ids:
+                with self._resize_lock:
+                    if self.cluster.state != STATE_RESIZING \
+                            and self.cluster.active_job is None:
+                        self._apply_membership([{"id": member.id,
+                                                 "uri": uri}])
+        elif self.cluster.is_down(member.id):
+            self.logger.printf("gossip: node %s back up", member.id)
+            self.cluster.mark_up(member.id)
+            self._on_node_return(node)
 
     def refresh_membership(self) -> None:
         """Merge peer node lists from all configured hosts (the static-mode
@@ -442,6 +512,8 @@ class Server:
         if not helpers:
             return False
         outer_timeout = 2 * self.probe_timeout + 1.0
+        vouched = threading.Event()  # set by the FIRST positive vote
+        done = threading.Event()  # set when every helper has answered
         votes: dict[str, bool] = {}
 
         def ask(helper):
@@ -450,14 +522,21 @@ class Server:
                     helper.uri, target.uri, timeout=outer_timeout)
             except Exception:  # noqa: BLE001 — helper unreachable: no vote
                 votes[helper.id] = False
+            if votes[helper.id]:
+                vouched.set()
+            if len(votes) == len(helpers):
+                done.set()
 
-        threads = [threading.Thread(target=ask, args=(h,), daemon=True)
-                   for h in helpers]
-        for t in threads:
-            t.start()
-        for t in threads:
-            t.join(outer_timeout + 1.0)
-        return any(votes.values())
+        for h in helpers:
+            threading.Thread(target=ask, args=(h,), daemon=True).start()
+        # one vouch settles it — don't hold the membership tick hostage to
+        # the slowest helper's full timeout (a recurring-suspect peer would
+        # stall liveness detection for every OTHER peer each round)
+        deadline = time.monotonic() + outer_timeout + 1.0
+        while time.monotonic() < deadline:
+            if vouched.wait(0.05) or done.is_set():
+                break
+        return vouched.is_set() or any(votes.values())
 
     def _on_node_return(self, node) -> None:
         """Heal a peer that was probe-marked down and came back: broadcasts
@@ -531,6 +610,8 @@ class Server:
 
     def close(self) -> None:
         self.closed = True
+        if self.gossip is not None:
+            self.gossip.close()
         if self._bcast_thread is not None:
             self._bcast_queue.put(None)  # wake + stop the worker
             self._bcast_thread.join(timeout=2.0)

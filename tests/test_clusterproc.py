@@ -236,3 +236,78 @@ def test_three_process_cluster_sigstop_convergence(cluster_procs):
 
     assert wait_until(g_served, 30.0), \
         "returned node never served the missed write"
+
+
+def test_gossip_cluster_sigstop_liveness(tmp_path):
+    """Same three-OS-process fault drama, but with the SWIM UDP gossip
+    transport as the failure detector ([gossip] section) instead of HTTP
+    /status probes: SIGSTOP -> no UDP acks -> suspect -> dead -> cluster
+    DEGRADED; SIGCONT -> acks -> alive -> NORMAL. Asserts the optional
+    backend drives the same mark_down/mark_up plumbing end to end across
+    process boundaries (gossip/gossip.go:488-519 analog)."""
+    ports = free_ports(3)
+    gports = free_ports(3)
+    hosts = ", ".join(f'"http://127.0.0.1:{p}"' for p in ports)
+    procs = []
+    try:
+        for i, port in enumerate(ports):
+            cfg = tmp_path / f"g{i}.toml"
+            cfg.write_text(
+                f'data-dir = "{tmp_path / f"g{i}"}"\n'
+                f'bind = "127.0.0.1:{port}"\n'
+                "[cluster]\n"
+                "disabled = false\n"
+                "replicas = 2\n"
+                f"hosts = [{hosts}]\n"
+                "membership-interval = 0.5\n"
+                "[gossip]\n"
+                f"port = {gports[i]}\n"
+                f'seeds = ["127.0.0.1:{gports[0]}"]\n'
+                "period = 0.1\n"
+                "probe-timeout = 0.15\n"
+                "push-pull-interval = 0.5\n"
+                "[mesh]\n"
+                'devices = "none"\n'
+                'platform = "cpu"\n')
+            env = dict(os.environ)
+            env["PYTHONPATH"] = \
+                f"{REPO}:{os.path.expanduser('~')}/.axon_site"
+            env["JAX_PLATFORMS"] = "cpu"
+            p = subprocess.Popen(
+                [sys.executable, "-m", "pilosa_tpu.cli", "server",
+                 "--config", str(cfg)],
+                stdout=(tmp_path / f"g{i}.log").open("wb"),
+                stderr=subprocess.STDOUT, cwd=REPO, env=env)
+            procs.append(p)
+        p0, p1, p2 = ports
+        assert wait_until(lambda: all(node_ready(p) for p in ports), 90.0), \
+            "cluster never reached NORMAL/3-node"
+        # a write served while everyone is up
+        http("POST", p0, "/index/gi", {"options": {}})
+        http("POST", p0, "/index/gi/field/f", {"options": {"type": "set"}})
+        http("POST", p0, "/index/gi/query", b"Set(1, f=5)")
+        os.kill(procs[2].pid, signal.SIGSTOP)
+        assert wait_until(
+            lambda: cluster_state(p0) == "DEGRADED"
+            and cluster_state(p1) == "DEGRADED", 45.0), \
+            "gossip never marked the SIGSTOP'd node down"
+        # queries still answer while DEGRADED (placement routes around)
+        _, out = http("POST", p0, "/index/gi/query", b"Count(Row(f=5))")
+        assert out["results"] == [1]
+        os.kill(procs[2].pid, signal.SIGCONT)
+        assert wait_until(
+            lambda: cluster_state(p0) == "NORMAL"
+            and cluster_state(p1) == "NORMAL", 30.0), \
+            "gossip never revived the resumed node"
+    finally:
+        for p in procs:
+            try:
+                os.kill(p.pid, signal.SIGCONT)
+            except OSError:
+                pass
+            p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
