@@ -84,6 +84,7 @@ ENGINE_QUERIES = 100
 # QPS numbers are concurrent server loads; a single-stream loop over a
 # high-latency device link measures the link RTT, not the engine)
 EXEC_THREADS = int(os.environ.get("PILOSA_BENCH_THREADS", "32"))
+EXEC_THREADS_PEAK = int(os.environ.get("PILOSA_BENCH_THREADS_PEAK", "256"))
 HTTP_THREADS = 16
 
 METRIC = ("executor_intersect_count_qps" if EXEC_SHARDS == 128
@@ -321,18 +322,32 @@ def bench_executor(ex, row_bits) -> dict:
         ex.execute("b", q)
     single_s = (time.perf_counter() - t0) / 20
 
-    # concurrent throughput: EXEC_THREADS client threads, the serving QPS
+    # concurrent throughput: closed-loop client threads, the serving QPS
     # analog of the reference's concurrent query benchmarks (dispatches
-    # and fetches from different queries overlap on the link)
-    tpu_s = _concurrent_seconds_per_query(
+    # and fetches from different queries overlap on the link). Measured at
+    # EXEC_THREADS (continuity with earlier rounds) and at EXEC_THREADS_PEAK
+    # — over a ~100-190 ms tunnel a closed loop caps at in_flight/RTT, so
+    # peak serving needs enough clients to cover the link (the reference's
+    # Go server is benchmarked the same way: throughput at saturating
+    # concurrency). Headline = the better of the two.
+    tpu_s_base = _concurrent_seconds_per_query(
         EXEC_THREADS, max(8, ENGINE_QUERIES // 4),
         lambda tid, i: ex.execute("b", qs[(tid * 7 + i) % len(qs)]))
+    tpu_s_peak = None
+    if EXEC_THREADS_PEAK > EXEC_THREADS:
+        tpu_s_peak = _concurrent_seconds_per_query(
+            EXEC_THREADS_PEAK, max(8, ENGINE_QUERIES // 8),
+            lambda tid, i: ex.execute("b", qs[(tid * 7 + i) % len(qs)]))
+    if tpu_s_peak is not None and tpu_s_peak < tpu_s_base:
+        tpu_s, headline_threads = tpu_s_peak, EXEC_THREADS_PEAK
+    else:
+        tpu_s, headline_threads = tpu_s_base, EXEC_THREADS
 
     # CPU baseline: the same dense AND+popcount work in numpy (per query:
     # two [S, W] operands), scaled from a slice. Measured BOTH single-core
-    # and under the same client concurrency (numpy ufuncs release the GIL,
-    # so this is the all-cores Go-server analog); the stronger one is the
-    # baseline.
+    # and under the HEADLINE's client concurrency (numpy ufuncs release
+    # the GIL, so this is the all-cores Go-server analog); the stronger
+    # one is the baseline.
     small = min(16, EXEC_SHARDS)
     rng = np.random.default_rng(5)
     a = rng.integers(0, 2**32, size=(small, WORDS_PER_SHARD), dtype=np.uint32)
@@ -343,7 +358,7 @@ def bench_executor(ex, row_bits) -> dict:
         np.bitwise_count(a & b).sum()
     cpu_s = (time.perf_counter() - t0) / 5 * (EXEC_SHARDS / small)
     cpu_conc_s = _concurrent_seconds_per_query(
-        EXEC_THREADS, 3,
+        headline_threads, 3,
         lambda tid, i: np.bitwise_count(a & b).sum(),
     ) * (EXEC_SHARDS / small)
     cpu_best_s = min(cpu_s, cpu_conc_s)
@@ -355,15 +370,23 @@ def bench_executor(ex, row_bits) -> dict:
         "vs_baseline": round(cpu_best_s / tpu_s, 2),
         "tpu_ms_per_query": round(tpu_s * 1e3, 4),
         "single_stream_ms_per_query": round(single_s * 1e3, 4),
-        "concurrency": EXEC_THREADS,
+        "concurrency": headline_threads,
+        "qps_at_base_concurrency": {"clients": EXEC_THREADS,
+                                    "qps": round(1.0 / tpu_s_base, 2)},
         "cpu_numpy_ms_per_query": round(cpu_s * 1e3, 4),
         "cpu_numpy_concurrent_ms_per_query": round(cpu_conc_s * 1e3, 4),
         "columns_per_operand": EXEC_SHARDS * SHARD_WIDTH,
         "path": "Executor.execute (parse+compile+residency+device+merge), "
-                f"{EXEC_THREADS} concurrent clients; baseline is the "
-                "BEST of single-core and same-concurrency numpy on the "
-                "same dense work",
+                f"closed-loop clients at {EXEC_THREADS}"
+                + (f" and {EXEC_THREADS_PEAK} (headline = better)"
+                   if tpu_s_peak is not None else "")
+                + "; baseline is the BEST of single-core and "
+                "headline-concurrency numpy on the same dense work",
     }
+    if tpu_s_peak is not None:
+        out["qps_at_peak_concurrency"] = {
+            "clients": EXEC_THREADS_PEAK,
+            "qps": round(1.0 / tpu_s_peak, 2)}
     if EXEC_SHARDS == 128:  # proxy measured at this exact shape (1% rows)
         _attach_go_ref(out, "exec_128shard_1pct", tpu_s)
     return out

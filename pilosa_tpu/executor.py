@@ -257,11 +257,14 @@ class Executor:
         return tuple(out)
 
     def _row_leaf_dev(self, index: Index, field_name: str, view_name: str,
-                      shards, row_id: int):
+                      shards, row_id: int, gens: tuple = None):
         """HBM-resident [S(padded), W] device array for one row via the
         residency manager — shared by bitmap programs, BSI planes and TopN
-        recounts."""
-        gens = self._leaf_gens(index, field_name, view_name, shards, row_id)
+        recounts. `gens` skips the per-shard generation scan when the
+        caller already computed it (GroupBy slab keys)."""
+        if gens is None:
+            gens = self._leaf_gens(index, field_name, view_name, shards,
+                                   row_id)
         key = ("row", index.name, field_name, view_name, row_id,
                tuple(shards), gens)
         return self.residency.leaf(key, lambda: np.stack([
@@ -1061,9 +1064,20 @@ class Executor:
             row_ids = list(self._execute_rows(index, rc, shards))
             if not row_ids:
                 return GroupCounts([])
-            slab = jnp.stack([
-                self._row_leaf_dev(index, fname, VIEW_STANDARD, shards, rid)
-                for rid in row_ids])
+            # the stacked [R, S', W] axis slab is itself residency-cached
+            # (gen-keyed like its component leaves): repeat GroupBys skip
+            # the R-operand device stack, which over a tunneled link costs
+            # more than the counting dispatches themselves
+            gens = tuple(
+                self._leaf_gens(index, fname, VIEW_STANDARD, shards, rid)
+                for rid in row_ids)
+            slab = self.residency.leaf(
+                ("rows_slab", index.name, fname, VIEW_STANDARD,
+                 tuple(shards), tuple(row_ids), gens),
+                lambda f=fname, rids=row_ids, g=gens: jnp.stack([
+                    self._row_leaf_dev(index, f, VIEW_STANDARD, shards,
+                                       rid, gens=gi)
+                    for rid, gi in zip(rids, g)]))
             axes.append((fname, row_ids, slab))
 
         # prefixes per dispatch: the [chunk, R, S, W] intermediate is fused

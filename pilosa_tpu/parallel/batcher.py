@@ -11,9 +11,9 @@ This is the TPU answer to the reference's goroutine-per-shard fan-out
 (executor.go:2283): instead of more host threads, coalesce the queries
 themselves. A leader thread grabs every compatible pending query, runs ONE
 kernel computing all K results, and distributes them. Batches form *while
-the previous dispatch executes* — continuous batching: a lone query runs
-immediately (zero added latency, no timers), and under concurrency the
-batch size adapts to the arrival rate.
+the previous dispatch executes* — continuous batching: a lone query pays
+at most one admission tick (~0.5 ms, see _ADMISSION_S), and under
+concurrency the batch size adapts to the arrival rate.
 
 Leadership protocol (shared by all batchers): the first arrival for a
 compatibility key becomes leader and serves exactly ONE batch — its own
@@ -22,14 +22,18 @@ leader (or releases leadership if the queue drained). One batch per leader
 keeps tail latency fair: no thread serves strangers after its own query is
 answered. Errors wake every waiter in the failed batch.
 
-Pipelining: a batch's life is dispatch (enqueue the program on the device —
-JAX is async, this returns immediately) then finalize (fetch results — one
-full link round trip on a tunneled chip). Leadership hands off right after
-DISPATCH, so the next leader launches batch N+1 while batch N's results are
-still in flight: throughput is dispatch-rate-bound, not round-trip-bound.
-With an RTT of ~100 ms (observed on the axon tunnel) and one batch in
-flight, a 32-query batch caps at ~280 q/s no matter how fast the chip is;
-overlapped batches stack toward the chip's actual rate. In-flight depth is
+Pipelining: a batch's life is dispatch (enqueue the program on the device)
+then finalize (fetch results — one full link round trip on a tunneled
+chip). Leadership hands off BEFORE dispatch: the moment a leader cuts its
+batch from the queue, the next queued request is promoted, so batch N+1's
+admission window and dispatch overlap batch N's dispatch and round trip.
+This matters twice over on a tunneled chip: the round trip is ~100-190 ms
+(observed on the axon tunnel, drifting), and the dispatch itself — shipping
+the batch's index arrays host→device — costs a link transfer (~60 ms
+observed), so serializing dispatches caps the dispatch rate at ~15/s
+regardless of chip speed. With overlap, throughput is arrival-bound.
+A short admission window (see _ADMISSION_S) aggregates the resubmit burst
+that follows each delivered batch into one dispatch. In-flight depth is
 naturally bounded by the client thread count — every finalize runs on the
 thread that led that batch. Subclasses implement _dispatch/_finalize (or
 legacy one-shot _compute, which degrades to dispatch-and-fetch in one step).
@@ -38,6 +42,7 @@ legacy one-shot _compute, which degrades to dispatch-and-fetch in one step).
 from __future__ import annotations
 
 import functools
+import os
 import threading
 import time
 from collections import defaultdict
@@ -56,6 +61,11 @@ _FAILED = object()  # dispatch raised; error already delivered to the batch
 # non-exception reason (interpreter teardown, thread kill) — followers
 # re-check leader liveness and reclaim leadership
 _WAIT_POLL_S = 5.0
+# admission window ceiling (seconds): how long a new leader will wait for
+# the post-finalize resubmit burst to land before cutting its batch. The
+# loop exits early on an arrival lull, so a lone query pays one ~0.5 ms
+# tick, not the full window. 0 disables (cut immediately).
+_ADMISSION_S = float(os.environ.get("PILOSA_TPU_BATCH_WINDOW_MS", "4")) / 1e3
 
 # shard chunk for device-side partial count reductions: each chunk's total
 # is < 2016 shards x 2^20 bits < 2^31, so int32 partials cannot wrap; the
@@ -102,6 +112,7 @@ class ContinuousBatcher:
 
     def __init__(self, max_batch: int = MAX_BATCH):
         self.max_batch = max_batch
+        self.admission_s = _ADMISSION_S
         self._lock = threading.Lock()
         self._pending: dict[tuple, list[_Req]] = defaultdict(list)
         self._leaders: set[tuple] = set()
@@ -187,20 +198,36 @@ class ContinuousBatcher:
     def _serve_one_batch(self, key: tuple) -> None:
         with self._lock:
             self._leader_threads[key] = threading.current_thread()
+        # admission window: when a finalize delivers K results, those K
+        # clients resubmit near-simultaneously — wait out the burst (until
+        # an arrival lull, one sleep tick with no growth) so it lands in
+        # ONE dispatch instead of K tiny ones, each paying the fixed
+        # dispatch cost. A lone query waits a single tick (~0.5 ms).
+        if self.admission_s > 0:
+            deadline = time.perf_counter() + self.admission_s
+            last = -1
+            while True:
+                with self._lock:
+                    n = len(self._pending.get(key, ()))
+                # lull = no growth over one tick; `last` starts at -1 so a
+                # lone query still waits exactly one tick, and a leader
+                # whose queue was emptied by a concurrent cut (reclaim
+                # races) exits after one tick instead of the full window
+                if (n >= self.max_batch or n == last
+                        or time.perf_counter() >= deadline):
+                    break
+                last = n
+                time.sleep(0.0005)
+        with self._lock:
             q = self._pending[key]
             batch, q[:] = q[:self.max_batch], q[self.max_batch:]
             for r in batch:  # liveness anchor for followers (see _Req)
                 r.server = threading.current_thread()
-        handle = _FAILED
-        if batch:
-            try:
-                handle = self._dispatch(key, [r.payload for r in batch])
-            except BaseException as e:  # noqa: BLE001 — waiters must wake
-                self._deliver_exc(batch, e)
-        # leadership hands off HERE — after dispatch, before the blocking
-        # result fetch — so the next leader's batch overlaps this round trip
-        with self._lock:
-            q = self._pending[key]
+            # leadership hands off HERE — before dispatch — so the next
+            # leader's admission+dispatch overlaps this batch's dispatch
+            # AND its result round trip (dispatch itself costs ~a link
+            # transfer on a tunneled chip; serializing dispatches caps the
+            # dispatch rate and with it the whole serving throughput)
             if q:
                 q[0].promoted = True
                 q[0].event.set()  # leadership stays marked; they continue
@@ -211,6 +238,12 @@ class ContinuousBatcher:
                 # slabs) are unbounded over a server's life, and a retired
                 # slab's key would otherwise linger forever
                 del self._pending[key]
+        handle = _FAILED
+        if batch:
+            try:
+                handle = self._dispatch(key, [r.payload for r in batch])
+            except BaseException as e:  # noqa: BLE001 — waiters must wake
+                self._deliver_exc(batch, e)
         if batch and handle is not _FAILED:
             self._run(key, batch, handle)
 

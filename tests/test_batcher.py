@@ -474,10 +474,12 @@ def test_replica_mesh_scatters_batch():
 
 
 def test_dispatch_overlaps_inflight_finalize():
-    """Leadership must hand off after _dispatch, before _finalize: batch
-    N+1's device launch overlaps batch N's result round trip (through a
-    ~100 ms tunnel this is the difference between batch/RTT and
-    dispatch-rate throughput)."""
+    """Leadership hands off BEFORE _dispatch: batch N+1's admission and
+    device launch overlap batch N's dispatch and result round trip, so
+    _dispatch may run concurrently for the same key (through a ~100 ms
+    tunnel this is the difference between ~15 serialized dispatches/s and
+    arrival-bound throughput). This test pins the weaker invariant that a
+    later batch's dispatch need not wait for an in-flight finalize."""
     dispatched = []
     release = threading.Event()
     overlap_seen = threading.Event()
