@@ -79,6 +79,7 @@ TOPN_ROWS = 100_000
 TOPN_N = 1000
 BSI_SHARDS = 16
 HTTP_QUERIES = 200
+BSI_THREADS = 16
 ENGINE_QUERIES = 100
 # serving throughput is measured under concurrent clients (the reference's
 # QPS numbers are concurrent server loads; a single-stream loop over a
@@ -86,6 +87,8 @@ ENGINE_QUERIES = 100
 EXEC_THREADS = int(os.environ.get("PILOSA_BENCH_THREADS", "32"))
 EXEC_THREADS_PEAK = int(os.environ.get("PILOSA_BENCH_THREADS_PEAK", "256"))
 HTTP_THREADS = 16
+HTTP_THREADS_PEAK = int(os.environ.get("PILOSA_BENCH_HTTP_THREADS_PEAK", "128"))
+BSI_THREADS_PEAK = int(os.environ.get("PILOSA_BENCH_BSI_THREADS_PEAK", "128"))
 
 METRIC = ("executor_intersect_count_qps" if EXEC_SHARDS == 128
           else f"executor_intersect_count_qps_{EXEC_SHARDS}shards")
@@ -157,6 +160,38 @@ def _concurrent_seconds_per_query(n_threads: int, per_thread: int,
     if errors:
         raise errors[0]
     return wall / (n_threads * per_thread)
+
+
+def _measure_base_peak(base_threads: int, peak_threads: int,
+                       per_thread_base: int, per_thread_peak: int,
+                       run_query, on_base_done=None) -> tuple:
+    """Closed-loop serving at a base concurrency (continuity with earlier
+    rounds) and — when peak_threads > base_threads — at a saturating one:
+    over a ~100-190 ms tunnel a closed loop caps at in_flight/RTT, so peak
+    serving needs enough clients to cover the link (the reference's Go
+    server is benchmarked the same way: throughput at saturating
+    concurrency). Returns (headline_s, headline_threads, base_s, peak_s)
+    where peak_s is None when the peak run was skipped; headline = the
+    better of the two runs. `on_base_done` fires between the runs
+    (stage-local instrumentation snapshots)."""
+    base_s = _concurrent_seconds_per_query(base_threads, per_thread_base,
+                                           run_query)
+    if on_base_done is not None:
+        on_base_done()
+    if peak_threads <= base_threads:
+        return base_s, base_threads, base_s, None
+    peak_s = _concurrent_seconds_per_query(peak_threads, per_thread_peak,
+                                           run_query)
+    if peak_s < base_s:
+        return peak_s, peak_threads, base_s, peak_s
+    return base_s, base_threads, base_s, peak_s
+
+
+def _conc_path(base_threads: int, peak_threads: int, peak_ran: bool) -> str:
+    """Provenance fragment naming exactly the concurrencies measured."""
+    return (f"closed-loop clients at {base_threads}"
+            + (f" and {peak_threads} (headline = better)"
+               if peak_ran else ""))
 
 
 def _init_backend_with_retry(deadline: float):
@@ -324,24 +359,12 @@ def bench_executor(ex, row_bits) -> dict:
 
     # concurrent throughput: closed-loop client threads, the serving QPS
     # analog of the reference's concurrent query benchmarks (dispatches
-    # and fetches from different queries overlap on the link). Measured at
-    # EXEC_THREADS (continuity with earlier rounds) and at EXEC_THREADS_PEAK
-    # — over a ~100-190 ms tunnel a closed loop caps at in_flight/RTT, so
-    # peak serving needs enough clients to cover the link (the reference's
-    # Go server is benchmarked the same way: throughput at saturating
-    # concurrency). Headline = the better of the two.
-    tpu_s_base = _concurrent_seconds_per_query(
-        EXEC_THREADS, max(8, ENGINE_QUERIES // 4),
+    # and fetches from different queries overlap on the link); see
+    # _measure_base_peak for the base-vs-saturating protocol
+    tpu_s, headline_threads, tpu_s_base, tpu_s_peak = _measure_base_peak(
+        EXEC_THREADS, EXEC_THREADS_PEAK,
+        max(8, ENGINE_QUERIES // 4), max(8, ENGINE_QUERIES // 8),
         lambda tid, i: ex.execute("b", qs[(tid * 7 + i) % len(qs)]))
-    tpu_s_peak = None
-    if EXEC_THREADS_PEAK > EXEC_THREADS:
-        tpu_s_peak = _concurrent_seconds_per_query(
-            EXEC_THREADS_PEAK, max(8, ENGINE_QUERIES // 8),
-            lambda tid, i: ex.execute("b", qs[(tid * 7 + i) % len(qs)]))
-    if tpu_s_peak is not None and tpu_s_peak < tpu_s_base:
-        tpu_s, headline_threads = tpu_s_peak, EXEC_THREADS_PEAK
-    else:
-        tpu_s, headline_threads = tpu_s_base, EXEC_THREADS
 
     # CPU baseline: the same dense AND+popcount work in numpy (per query:
     # two [S, W] operands), scaled from a slice. Measured BOTH single-core
@@ -377,9 +400,8 @@ def bench_executor(ex, row_bits) -> dict:
         "cpu_numpy_concurrent_ms_per_query": round(cpu_conc_s * 1e3, 4),
         "columns_per_operand": EXEC_SHARDS * SHARD_WIDTH,
         "path": "Executor.execute (parse+compile+residency+device+merge), "
-                f"closed-loop clients at {EXEC_THREADS}"
-                + (f" and {EXEC_THREADS_PEAK} (headline = better)"
-                   if tpu_s_peak is not None else "")
+                + _conc_path(EXEC_THREADS, EXEC_THREADS_PEAK,
+                             tpu_s_peak is not None)
                 + "; baseline is the BEST of single-core and "
                 "headline-concurrency numpy on the same dense work",
     }
@@ -396,7 +418,7 @@ def build_topn_index(holder):
     """Index 'b' / field 't': TOPN_ROWS rows with a heavy-tailed size
     distribution over TOPN_SHARDS shards (the ranked-cache showcase,
     docs/examples.md:320-331)."""
-    idx = holder.index("b")
+    idx = holder.index("b") or holder.create_index("b")
     t = idx.create_field("t")
     rng = np.random.default_rng(11)
     rows, cols = [], []
@@ -527,7 +549,7 @@ def build_bsi_index(holder):
     BSI_SHARDS shards."""
     from pilosa_tpu.models import FieldOptions, FieldType
 
-    idx = holder.index("b")
+    idx = holder.index("b") or holder.create_index("b")
     v = idx.create_field("v", FieldOptions(type=FieldType.INT,
                                            min=0, max=1023))
     rng = np.random.default_rng(13)
@@ -551,14 +573,21 @@ def bench_bsi(ex, vals) -> dict:
     p50 = sorted(lat)[len(lat) // 2]
 
     # concurrent aggregation throughput: varying thresholds coalesce via
-    # the PlaneSumBatcher (each query still pays its own compare sweep)
-    before = ex.sum_batcher.snapshot()["batches"] if ex.sum_batcher else 0
-    conc_s = _concurrent_seconds_per_query(
-        16, 6,
+    # the PlaneSumBatcher (each query still pays its own compare sweep);
+    # see _measure_base_peak for the base-vs-saturating protocol. Batch
+    # counts are snapshotted per run so concurrent_batches describes the
+    # HEADLINE run only.
+    marks = [ex.sum_batcher.snapshot()["batches"] if ex.sum_batcher else 0]
+    snap = lambda: marks.append(  # noqa: E731 — boundary instrumentation
+        ex.sum_batcher.snapshot()["batches"] if ex.sum_batcher else 0)
+    conc_s, conc_threads, conc_s_base, conc_s_peak = _measure_base_peak(
+        BSI_THREADS, BSI_THREADS_PEAK, 6, 6,
         lambda tid, i: ex.execute(
-            "b", f"Sum(Range(v > {128 + 8 * ((tid * 6 + i) % 96)}), field=v)"))
-    batches = (ex.sum_batcher.snapshot()["batches"] - before
-               if ex.sum_batcher else 0)
+            "b", f"Sum(Range(v > {128 + 8 * ((tid * 6 + i) % 96)}), field=v)"),
+        on_base_done=snap)
+    snap()
+    batches = (marks[2] - marks[1] if conc_threads != BSI_THREADS
+               else marks[1] - marks[0])
 
     t0 = time.perf_counter()
     for i in range(3):
@@ -574,9 +603,14 @@ def bench_bsi(ex, vals) -> dict:
         "vs_baseline": round(cpu_s / p50, 2),
         "columns": BSI_SHARDS * SHARD_WIDTH,
         "concurrent_qps": round(1.0 / conc_s, 2),
+        "concurrent_clients": conc_threads,
+        "concurrent_qps_at_base": {"clients": BSI_THREADS,
+                                   "qps": round(1.0 / conc_s_base, 2)},
         "concurrent_batches": batches,
         "path": "Executor Sum(Range) BSI plane kernels; concurrent_qps = "
-                "16 clients, varying thresholds, PlaneSumBatcher coalesced",
+                + _conc_path(BSI_THREADS, BSI_THREADS_PEAK,
+                             conc_s_peak is not None)
+                + ", varying thresholds, PlaneSumBatcher coalesced",
     }
     if BSI_SHARDS == 16:  # proxy measured at this exact shape
         _attach_go_ref(out, "bsi_sum_range_16shard", conc_s)
@@ -640,9 +674,12 @@ def bench_http(tmpdir) -> dict:
             post("/index/h/query", q)
         single_s = (time.perf_counter() - t0) / 10
 
-        # concurrent clients (the threaded server's actual serving mode)
-        per_q = _concurrent_seconds_per_query(
-            HTTP_THREADS, HTTP_QUERIES // HTTP_THREADS,
+        # concurrent clients (the threaded server's actual serving mode);
+        # see _measure_base_peak for the base-vs-saturating protocol
+        per_q, conc, per_q_base, per_q_peak = _measure_base_peak(
+            HTTP_THREADS, HTTP_THREADS_PEAK,
+            HTTP_QUERIES // HTTP_THREADS,
+            max(2, HTTP_QUERIES // HTTP_THREADS_PEAK),
             lambda tid, i: post("/index/h/query", q))
         return {
             "metric": "http_count_qps",
@@ -651,9 +688,12 @@ def bench_http(tmpdir) -> dict:
             "vs_baseline": 0.0,  # no HTTP-path numpy equivalent
             "tpu_ms_per_query": round(per_q * 1e3, 4),
             "single_stream_ms_per_query": round(single_s * 1e3, 4),
-            "concurrency": HTTP_THREADS,
+            "concurrency": conc,
+            "qps_at_base_concurrency": {"clients": HTTP_THREADS,
+                                        "qps": round(1.0 / per_q_base, 2)},
             "path": "HTTP loopback: wire + parse + execute, "
-                    f"{HTTP_THREADS} concurrent clients",
+                    + _conc_path(HTTP_THREADS, HTTP_THREADS_PEAK,
+                                 per_q_peak is not None),
         }
     finally:
         srv.close()
@@ -661,6 +701,7 @@ def bench_http(tmpdir) -> dict:
 
 DIST_SHARDS = 16
 DIST_THREADS = 8
+DIST_THREADS_PEAK = int(os.environ.get("PILOSA_BENCH_DIST_THREADS_PEAK", "64"))
 DIST_QUERIES = 96
 
 
@@ -717,8 +758,10 @@ def bench_distributed(tmpdir) -> dict:
         out1 = post(uris[1], "/index/d/query", q)
         assert out1["results"][0] == expect, out1
 
-        per_q = _concurrent_seconds_per_query(
-            DIST_THREADS, DIST_QUERIES // DIST_THREADS,
+        per_q, conc, per_q_base, per_q_peak = _measure_base_peak(
+            DIST_THREADS, DIST_THREADS_PEAK,
+            DIST_QUERIES // DIST_THREADS,
+            max(2, DIST_QUERIES // DIST_THREADS_PEAK),
             lambda tid, i: post(uris[0], "/index/d/query", q))
         return {
             "metric": "distributed_count_qps_16shard_2node",
@@ -726,9 +769,13 @@ def bench_distributed(tmpdir) -> dict:
             "unit": "queries/s",
             "vs_baseline": 0.0,  # overhead metric; no numpy equivalent
             "tpu_ms_per_query": round(per_q * 1e3, 4),
-            "concurrency": DIST_THREADS,
+            "concurrency": conc,
+            "qps_at_base_concurrency": {"clients": DIST_THREADS,
+                                        "qps": round(1.0 / per_q_base, 2)},
             "path": "2-node mapReduce fan-out: local device shards + "
-                    "HTTP scatter-gather (executor.go:2183 analog)",
+                    "HTTP scatter-gather (executor.go:2183 analog); "
+                    + _conc_path(DIST_THREADS, DIST_THREADS_PEAK,
+                                 per_q_peak is not None),
         }
     finally:
         for s in servers:

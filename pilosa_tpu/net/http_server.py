@@ -578,6 +578,14 @@ class _RequestHandler(BaseHTTPRequestHandler):
         pass
 
 
+class _Server(ThreadingHTTPServer):
+    # listen backlog: the stdlib default of 5 resets connections under a
+    # concurrent-client burst (the Go reference's net/http listener has no
+    # such cap); raised so serving benchmarks and real fan-in don't shed
+    # connections at accept time
+    request_queue_size = 1024
+
+
 class HTTPServer:
     """Threaded HTTP server wrapper with lifecycle (Handler.Serve,
     http/handler.go:150)."""
@@ -585,13 +593,7 @@ class HTTPServer:
     def __init__(self, handler: Handler, host: str = "localhost", port: int = 0,
                  tls_certificate: str = "", tls_key: str = ""):
         cls = type("BoundHandler", (_RequestHandler,), {"handler": handler})
-        # listen backlog: the stdlib default of 5 resets connections under
-        # a concurrent-client burst (the Go reference's net/http listener
-        # has no such cap); raised so serving benchmarks and real fan-in
-        # don't shed connections at accept time
-        srv_cls = type("BoundServer", (ThreadingHTTPServer,),
-                       {"request_queue_size": 1024})
-        self._srv = srv_cls((host, port), cls)
+        self._srv = _Server((host, port), cls)
         self._scheme = "http"
         if tls_certificate and tls_key:  # getListener (server/server.go:375-393)
             import ssl

@@ -102,9 +102,10 @@ class _Req:
         self.done = False  # result/exc actually delivered (event alone is
         # ambiguous: promotion also sets it)
         self.server: Optional[threading.Thread] = None  # thread serving the
-        # batch this request was popped into (set at pop; liveness checks
-        # must consult it, not the leadership slot — leadership hands off
-        # at dispatch while this batch's finalize is still in flight)
+        # batch this request was popped into (set at the cut; liveness
+        # checks must consult it, not the leadership slot — leadership
+        # hands off at the cut, BEFORE dispatch, while this batch's
+        # dispatch and finalize are still in flight on this thread)
 
 
 class ContinuousBatcher:
@@ -150,10 +151,11 @@ class ContinuousBatcher:
                         req.promoted = True
                         req.event.set()
                     else:
-                        # popped into a batch: its results may still be in
-                        # flight on the SERVING thread (leadership already
-                        # handed off at dispatch) — only that thread dying
-                        # means the result is never coming
+                        # popped into a batch: its dispatch/results may
+                        # still be in flight on the SERVING thread
+                        # (leadership already handed off at the cut) —
+                        # only that thread dying means the result is
+                        # never coming
                         t = req.server
                         if t is not None and t.is_alive():
                             continue  # finalize in flight
