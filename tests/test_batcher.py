@@ -515,6 +515,46 @@ def test_dispatch_overlaps_inflight_finalize():
     assert release.is_set()
 
 
+def test_dispatches_overlap_for_same_key():
+    """The strong invariant of handoff-before-dispatch: a slow _dispatch
+    does not serialize the dispatch rate. While batch N's _dispatch is
+    still executing, batch N+1's _dispatch starts (each dispatch costs ~a
+    link transfer on a tunneled chip; serialized dispatches capped serving
+    at ~15 batches/s regardless of chip speed — see module docstring)."""
+    both_in = threading.Event()
+    n_inside = [0]
+    lock = threading.Lock()
+
+    class SlowDispatch(ContinuousBatcher):
+        def _dispatch(self, key, payloads):
+            with lock:
+                n_inside[0] += 1
+                if n_inside[0] >= 2:
+                    both_in.set()
+            # blocks until TWO dispatches are inside concurrently: times
+            # out (and fails) if dispatches are serialized per key
+            assert both_in.wait(10.0), \
+                "second dispatch never started while first was in flight"
+            return list(payloads)
+
+        def _finalize(self, key, handle, payloads):
+            return [p + 1 for p in handle]
+
+    b = SlowDispatch(max_batch=1)  # force one payload per batch
+    results = {}
+
+    def client(v):
+        results[v] = b.submit(("k",), v)
+
+    ts = [threading.Thread(target=client, args=(v,)) for v in range(2)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=30)
+    assert results == {0: 1, 1: 2}
+    assert n_inside[0] == 2
+
+
 def test_dispatch_failure_wakes_batch_and_promotes_next():
     """An exception raised at dispatch time must error that batch's
     waiters immediately and still hand leadership to the next batch."""
