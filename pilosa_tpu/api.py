@@ -449,6 +449,21 @@ class API:
             except Exception as e:  # noqa: BLE001 — surface as a 502, not 500
                 raise ApiError(
                     f"forwarding import to {group['uri']}: {e}", status=502)
+        if by_node:
+            # first-hand knowledge: the forwarded batches landed on their
+            # owners, so those shards exist cluster-wide — merge them into
+            # this coordinator's availability view now; the owners' async
+            # announcements still propagate to the other nodes
+            # (AddRemoteAvailableShards, field.go:283). ONLY shards with no
+            # local owner: for a shard this node owns, the local import
+            # below must do the (non-quiet) add so the create-shard
+            # announcement fires — a quiet pre-add would swallow it.
+            idx = self.holder.index(index_name)
+            f = idx.field(field_name) if idx is not None else None
+            if f is not None:
+                for shard, owners in owners_by_shard.items():
+                    if all(n.id != self.cluster.local_id for n in owners):
+                        f.add_available_shard(shard, quiet=True)
         return ([a_ids[i] for i in local_idx],
                 [column_ids[i] for i in local_idx],
                 [extra[i] for i in local_idx] if extra else None)

@@ -1453,6 +1453,20 @@ class Executor:
                 except ClientError as e:
                     raise ExecutionError(f"replica write failed: {e}")
             result = r if result is None else (result or r)
+        if (call.name == "Set"
+                and all(n.id != self.cluster.local_id for n in targets)):
+            # first-hand knowledge: the Set just landed on the shard's
+            # replicas, so the shard exists cluster-wide — merge it into
+            # this coordinator's availability view NOW rather than waiting
+            # for the owners' async create-shard announcement
+            # (AddRemoteAvailableShards, field.go:283). Only when every
+            # replica is remote: a local replica's own set_bit must do the
+            # (non-quiet) add so the announcement fires; a quiet pre-add
+            # would swallow it. Clear never creates shards (clear_bit
+            # deliberately doesn't mark availability).
+            f = index.field(call.field_arg())
+            if f is not None:
+                f.add_available_shard(col // SHARD_WIDTH, quiet=True)
         return result
 
     def _reduce(self, call: Call, partials: list, index: Optional[Index] = None,

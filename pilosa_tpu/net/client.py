@@ -11,9 +11,10 @@ from __future__ import annotations
 import base64
 import http.client
 import json
+import socket
 import ssl
-import urllib.error
-import urllib.request
+import threading
+import urllib.parse
 from typing import Optional
 
 from pilosa_tpu.utils import qctx, tracing
@@ -30,6 +31,12 @@ class InternalClient:
     def __init__(self, timeout: float = 30.0, tls_skip_verify: bool = False):
         self.timeout = timeout
         self._ssl_ctx: Optional[ssl.SSLContext] = None
+        # per-thread keep-alive connections keyed by (scheme, host:port):
+        # the fan-out paths (remote query scatter, anti-entropy block
+        # exchange, import forwarding) issue many small RPCs to the same
+        # peers, and a fresh TCP handshake per RPC is pure overhead (the
+        # reference's http.Client pools connections the same way)
+        self._local = threading.local()
         if tls_skip_verify:  # server/config.go:31 tls.skip-verify
             self._ssl_ctx = ssl.create_default_context()
             self._ssl_ctx.check_hostname = False
@@ -58,31 +65,86 @@ class InternalClient:
                 raise qctx.QueryTimeoutError("query deadline exceeded")
             headers[qctx.DEADLINE_HEADER] = f"{rem:.3f}"
             sock_timeout = min(sock_timeout, rem + 0.25)
-        req = urllib.request.Request(
-            uri + path, data=body, method=method, headers=headers)
-        try:
-            with urllib.request.urlopen(
-                    req, timeout=sock_timeout, context=self._ssl_ctx) as resp:
-                return resp.read()
-        except urllib.error.HTTPError as e:
-            detail = e.read().decode(errors="replace")
-            code = ""
+        split = urllib.parse.urlsplit(uri)
+        key = (split.scheme, split.netloc)
+        # one retry, only for failure modes a STALE kept-alive connection
+        # produces (peer closed it between requests); timeouts and
+        # mid-response errors are not retried — the query deadline applies
+        # and the peer may have executed a side effect
+        for attempt in (0, 1):
+            conn, fresh = self._conn_for(key, sock_timeout)
             try:
-                code = json.loads(detail).get("code", "")
-            except (ValueError, AttributeError):
-                pass
-            raise ClientError(f"{method} {path}: {e.code}: {detail}",
-                              status=e.code, code=code)
-        except TimeoutError as e:
-            raise ClientError(f"{method} {path}: timed out: {e}")
-        except urllib.error.URLError as e:
-            raise ClientError(f"{method} {path}: {e.reason}")
-        except (OSError, http.client.HTTPException) as e:
-            # raw socket errors (ConnectionResetError mid-response) and
-            # http.client errors (IncompleteRead after headers) escape
-            # urllib's URLError wrapping; peers are unreliable by
-            # contract, so normalize them too
-            raise ClientError(f"{method} {path}: {type(e).__name__}: {e}")
+                conn.request(method, path, body=body, headers=headers)
+                resp = conn.getresponse()
+            except socket.timeout as e:
+                self._drop_conn(key)
+                raise ClientError(f"{method} {path}: timed out: {e}")
+            except (ConnectionError, BrokenPipeError,
+                    http.client.BadStatusLine,
+                    http.client.CannotSendRequest,
+                    http.client.RemoteDisconnected) as e:
+                self._drop_conn(key)
+                if fresh or attempt:
+                    raise ClientError(
+                        f"{method} {path}: {type(e).__name__}: {e}")
+                continue  # stale keep-alive: one reconnect retry
+            except (OSError, http.client.HTTPException) as e:
+                self._drop_conn(key)
+                raise ClientError(f"{method} {path}: {type(e).__name__}: {e}")
+            # response headers arrived: the peer received and processed
+            # the request, so NOTHING from here on may retry (a re-send
+            # would double-execute side effects); read-phase failures are
+            # terminal errors
+            try:
+                data = resp.read()
+            except socket.timeout as e:
+                self._drop_conn(key)
+                raise ClientError(f"{method} {path}: timed out: {e}")
+            except (OSError, http.client.HTTPException) as e:
+                # resets mid-body, IncompleteRead after headers; peers are
+                # unreliable by contract, so normalize them
+                self._drop_conn(key)
+                raise ClientError(f"{method} {path}: {type(e).__name__}: {e}")
+            if resp.will_close:
+                self._drop_conn(key)
+            if resp.status >= 400:
+                detail = data.decode(errors="replace")
+                code = ""
+                try:
+                    code = json.loads(detail).get("code", "")
+                except (ValueError, AttributeError):
+                    pass
+                raise ClientError(f"{method} {path}: {resp.status}: {detail}",
+                                  status=resp.status, code=code)
+            return data
+
+    def _conn_for(self, key: tuple, sock_timeout: float):
+        """(connection, fresh) for this thread; `fresh` = just created (a
+        send failure on it is a real error, not a stale keep-alive)."""
+        pool = getattr(self._local, "conns", None)
+        if pool is None:
+            pool = self._local.conns = {}
+        conn = pool.get(key)
+        fresh = conn is None
+        if fresh:
+            scheme, netloc = key
+            if scheme == "https":
+                conn = http.client.HTTPSConnection(
+                    netloc, timeout=sock_timeout, context=self._ssl_ctx)
+            else:
+                conn = http.client.HTTPConnection(
+                    netloc, timeout=sock_timeout)
+            pool[key] = conn
+        conn.timeout = sock_timeout
+        if conn.sock is not None:  # already connected: apply per-request
+            conn.sock.settimeout(sock_timeout)
+        return conn, fresh
+
+    def _drop_conn(self, key: tuple) -> None:
+        pool = getattr(self._local, "conns", None)
+        conn = pool.pop(key, None) if pool else None
+        if conn is not None:
+            conn.close()
 
     def _json(self, method: str, uri: str, path: str, payload=None) -> dict:
         body = json.dumps(payload).encode() if payload is not None else None
@@ -182,7 +244,6 @@ class InternalClient:
         """Ask peer `uri` to probe `target_uri` on our behalf (memberlist
         indirect ping, gossip/gossip.go probe path): distinguishes a dead
         node from a broken link between us and it."""
-        import urllib.parse
 
         out = self._request(
             "GET", uri,

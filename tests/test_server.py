@@ -267,10 +267,16 @@ def test_cluster_distributed_topn_and_sum(cluster3):
     for c in range(3):
         jpost(s0.uri, "/index/i/query", raw=f"Set({c * SHARD_WIDTH + 1}, f=2)".encode())
         jpost(s0.uri, "/index/i/query", raw=f"Set({c * SHARD_WIDTH + 1}, v=10)".encode())
-    _, out = jpost(cluster3[1].uri, "/index/i/query", raw=b"TopN(f, n=2)")
-    assert out["results"][0] == [{"id": 1, "count": 6}, {"id": 2, "count": 3}]
-    _, out = jpost(cluster3[2].uri, "/index/i/query", raw=b"Sum(field=v)")
-    assert out["results"][0] == {"value": 30, "count": 3}
+    # nodes 1/2 are not replicas of every shard: they learn of the new
+    # shards via the async create-shard announcement, so poll for
+    # convergence (the cross-node visibility contract is eventual, like
+    # the reference's gossiped CreateShardMessage)
+    assert wait_until(lambda: jpost(
+        cluster3[1].uri, "/index/i/query", raw=b"TopN(f, n=2)"
+    )[1]["results"][0] == [{"id": 1, "count": 6}, {"id": 2, "count": 3}])
+    assert wait_until(lambda: jpost(
+        cluster3[2].uri, "/index/i/query", raw=b"Sum(field=v)"
+    )[1]["results"][0] == {"value": 30, "count": 3})
 
 
 def test_liveness_detects_crashed_node(cluster3):
@@ -525,12 +531,16 @@ def test_cluster_groupby_limit_correctness(cluster3):
     jpost(s0.uri, "/index/i/query", raw=b"Set(1, f=1)")
     for k in range(4):
         jpost(s0.uri, "/index/i/query", raw=f"Set({k * SHARD_WIDTH + 2}, f=2)".encode())
-    _, out = jpost(cluster3[2].uri, "/index/i/query",
-                   raw=b"GroupBy(Rows(field=f), limit=2)")
-    assert out["results"][0] == [
+    # node 2 learns of the new shards via the async create-shard
+    # announcement — poll for convergence (eventual visibility, like the
+    # reference's gossiped CreateShardMessage)
+    assert wait_until(lambda: jpost(
+        cluster3[2].uri, "/index/i/query",
+        raw=b"GroupBy(Rows(field=f), limit=2)",
+    )[1]["results"][0] == [
         {"group": [{"field": "f", "rowID": 1}], "count": 1},
         {"group": [{"field": "f", "rowID": 2}], "count": 4},
-    ]
+    ])
 
 
 def test_cluster_keyed_index_consistent_ids(cluster3):
