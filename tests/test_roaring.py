@@ -291,3 +291,58 @@ def test_time_view_row_rss_kb_not_mb(tmp_path):
     total_bytes = sum(c.data.nbytes for c in frag.storage.containers.values())
     assert total_bytes < 1024, total_bytes  # 16 runs x 4B, not 16 x 8KiB
     frag.close()
+
+
+def test_container_op_matrix_all_kind_pairs():
+    """Exhaustive op parity over every encoding pair — the analog of the
+    reference's 45 hand-specialized kernels (roaring.go:2162-3771): for
+    each (kind_a, kind_b) in {array, bitmap, run}^2 and each op, `op` and
+    `op_count` must agree with python-set algebra, the result's encoding
+    must be consistent with its cardinality (array iff <= ARRAY_MAX_SIZE,
+    unless run-encoded), and the inputs must be left untouched."""
+    from pilosa_tpu.storage.roaring import Container
+
+    rng = np.random.default_rng(77)
+    shapes = {
+        # sparse values -> array kind
+        "array": np.unique(rng.integers(0, 1 << 16, 700)).astype(np.uint16),
+        # dense scatter -> bitmap kind
+        "bitmap": np.unique(rng.integers(0, 1 << 16, 20000)).astype(np.uint16),
+        # few long intervals -> run kind
+        "run": np.concatenate([
+            np.arange(50, 9000, dtype=np.uint16),
+            np.arange(20000, 41000, dtype=np.uint16),
+            np.arange(65500, 65536, dtype=np.uint16),
+        ]),
+    }
+    conts, models = {}, {}
+    for want_kind, vals in shapes.items():
+        c = Container.from_values(vals).optimize()
+        assert c.kind == want_kind, (want_kind, c.kind)
+        conts[want_kind] = c
+        models[want_kind] = set(vals.tolist())
+
+    op_model = {
+        "and": lambda a, b: a & b,
+        "or": lambda a, b: a | b,
+        "xor": lambda a, b: a ^ b,
+        "andnot": lambda a, b: a - b,
+    }
+    for ka, a in conts.items():
+        for kb, b in conts.items():
+            for opname, fn in op_model.items():
+                expect = fn(models[ka], models[kb])
+                out = a.op(b, opname)
+                assert set(out.values().tolist()) == expect, \
+                    (ka, kb, opname)
+                assert out.n == len(expect)
+                if out.kind != "run":  # encoding/cardinality consistency
+                    from pilosa_tpu.storage.roaring import ARRAY_MAX_SIZE
+                    assert out.kind == (
+                        "array" if out.n <= ARRAY_MAX_SIZE else "bitmap"), \
+                        (ka, kb, opname, out.kind, out.n)
+                assert a.op_count(b, opname) == len(expect), \
+                    (ka, kb, opname)
+                # inputs must be untouched (ops are pure)
+                assert set(a.values().tolist()) == models[ka]
+                assert set(b.values().tolist()) == models[kb]
