@@ -1,5 +1,9 @@
 #!/bin/bash
-# Probe the axon TPU tunnel every 120s; log transitions to benches/tpu_watch.log
+# Probe the axon TPU tunnel every 120s; log transitions to benches/tpu_watch.log.
+# On recovery (first UP after any down), auto-capture a full bench.py run into
+# benches/bench_ckpt_autorecovery.jsonl (one capture per recovery window).
+cd "$(dirname "$0")/.."
+was_down=1
 while true; do
   ts=$(date -u +%H:%M:%S)
   if timeout 75 python -c "
@@ -8,9 +12,19 @@ assert jax.default_backend() not in ('cpu',), jax.default_backend()
 import jax.numpy as jnp
 (jnp.ones((8,8))@jnp.ones((8,8))).block_until_ready()
 " >/dev/null 2>&1; then
-    echo "$ts UP" >> /root/repo/benches/tpu_watch.log
+    echo "$ts UP" >> benches/tpu_watch.log
+    if [ "$was_down" = 1 ]; then
+      echo "$ts recovery: capturing bench" >> benches/tpu_watch.log
+      PILOSA_BENCH_DEADLINE_S=900 PILOSA_BENCH_CKPT=benches/bench_ckpt_autorecovery.jsonl \
+        timeout 2400 python bench.py \
+        > benches/tpu_bench_autorecovery.json 2>> benches/tpu_watch.log \
+        && echo "$(date -u +%H:%M:%S) capture done" >> benches/tpu_watch.log \
+        || echo "$(date -u +%H:%M:%S) capture FAILED" >> benches/tpu_watch.log
+    fi
+    was_down=0
   else
-    echo "$ts down" >> /root/repo/benches/tpu_watch.log
+    echo "$ts down" >> benches/tpu_watch.log
+    was_down=1
   fi
   sleep 120
 done
