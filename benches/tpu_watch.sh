@@ -3,7 +3,7 @@
 # On recovery (first UP after any down), auto-capture a full bench.py run into
 # benches/bench_ckpt_autorecovery.jsonl (one capture per recovery window).
 cd "$(dirname "$0")/.."
-was_down=1
+was_down=0  # capture only after a genuine down->up transition
 while true; do
   ts=$(date -u +%H:%M:%S)
   if timeout 75 python -c "
@@ -15,11 +15,17 @@ import jax.numpy as jnp
     echo "$ts UP" >> benches/tpu_watch.log
     if [ "$was_down" = 1 ]; then
       echo "$ts recovery: capturing bench" >> benches/tpu_watch.log
-      PILOSA_BENCH_DEADLINE_S=900 PILOSA_BENCH_CKPT=benches/bench_ckpt_autorecovery.jsonl \
-        timeout 2400 python bench.py \
-        > benches/tpu_bench_autorecovery.json 2>> benches/tpu_watch.log \
-        && echo "$(date -u +%H:%M:%S) capture done" >> benches/tpu_watch.log \
-        || echo "$(date -u +%H:%M:%S) capture FAILED" >> benches/tpu_watch.log
+      # temp + mv: a failed/timed-out capture must not clobber the last
+      # good artifact; the checkpoint file appends, so it keeps history
+      if PILOSA_BENCH_DEADLINE_S=900 PILOSA_BENCH_CKPT=benches/bench_ckpt_autorecovery.jsonl \
+          timeout 2400 python bench.py \
+          > benches/tpu_bench_autorecovery.json.tmp 2>> benches/tpu_watch.log; then
+        mv benches/tpu_bench_autorecovery.json.tmp benches/tpu_bench_autorecovery.json
+        echo "$(date -u +%H:%M:%S) capture done" >> benches/tpu_watch.log
+      else
+        rm -f benches/tpu_bench_autorecovery.json.tmp
+        echo "$(date -u +%H:%M:%S) capture FAILED" >> benches/tpu_watch.log
+      fi
     fi
     was_down=0
   else

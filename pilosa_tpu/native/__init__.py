@@ -88,6 +88,19 @@ def _configure(h: ctypes.CDLL) -> None:
                                         ctypes.c_uint64, u32p]
     h.pt_oplog_parse.restype = ctypes.c_size_t
     h.pt_oplog_parse.argtypes = [u8p, ctypes.c_size_t, u8p, u64p]
+    h.pt_run_op.restype = ctypes.c_size_t
+    h.pt_run_op.argtypes = [u16p, ctypes.c_size_t, u16p, ctypes.c_size_t,
+                            u16p, ctypes.c_int]
+    h.pt_run_op_count.restype = ctypes.c_uint64
+    h.pt_run_op_count.argtypes = [u16p, ctypes.c_size_t, u16p,
+                                  ctypes.c_size_t, ctypes.c_int]
+    h.pt_run_filter_array.restype = ctypes.c_size_t
+    h.pt_run_filter_array.argtypes = [u16p, ctypes.c_size_t, u16p,
+                                      ctypes.c_size_t, u16p, ctypes.c_int]
+    h.pt_run_and_count_bits.restype = ctypes.c_uint64
+    h.pt_run_and_count_bits.argtypes = [u16p, ctypes.c_size_t, u64p]
+    h.pt_run_to_bits.restype = None
+    h.pt_run_to_bits.argtypes = [u16p, ctypes.c_size_t, u64p]
 
 
 def available() -> bool:
@@ -199,6 +212,82 @@ def positions_to_dense(positions: np.ndarray, start: int, width: int) -> np.ndar
         return out
     h.pt_positions_to_dense(_ptr(positions, ctypes.c_uint64), positions.size,
                             start, width, _ptr(out, ctypes.c_uint32))
+    return out
+
+
+_RUN_KINDS = {"and": 0, "or": 1, "andnot": 2, "xor": 3}
+
+
+def run_op(a: np.ndarray, b: np.ndarray, kind: str):
+    """Interval algebra on two [n, 2] uint16 run lists; returns the result
+    intervals [k, 2], or None when the native lib is unavailable (callers
+    fall back to their dense path)."""
+    h = lib()
+    if h is None:
+        return None
+    a = np.ascontiguousarray(a, dtype=np.uint16)
+    b = np.ascontiguousarray(b, dtype=np.uint16)
+    na, nb = a.shape[0], b.shape[0]
+    out = np.empty((na + nb + 1, 2), dtype=np.uint16)
+    k = h.pt_run_op(_ptr(a, ctypes.c_uint16), na, _ptr(b, ctypes.c_uint16),
+                    nb, _ptr(out, ctypes.c_uint16), _RUN_KINDS[kind])
+    return out[:k].copy()
+
+
+def run_op_count(a: np.ndarray, b: np.ndarray, kind: str):
+    """Member count of op(a, b) over run lists; None without the lib."""
+    h = lib()
+    if h is None:
+        return None
+    a = np.ascontiguousarray(a, dtype=np.uint16)
+    b = np.ascontiguousarray(b, dtype=np.uint16)
+    return int(h.pt_run_op_count(_ptr(a, ctypes.c_uint16), a.shape[0],
+                                 _ptr(b, ctypes.c_uint16), b.shape[0],
+                                 _RUN_KINDS[kind]))
+
+
+def run_filter_array(runs: np.ndarray, vals: np.ndarray, keep_inside: bool):
+    """Sorted uint16 values inside (or outside) the intervals — array∧run /
+    array∖run in one pass; None without the lib."""
+    h = lib()
+    if h is None:
+        return None
+    runs = np.ascontiguousarray(runs, dtype=np.uint16)
+    vals = np.ascontiguousarray(vals, dtype=np.uint16)
+    out = np.empty(vals.size, dtype=np.uint16)
+    k = h.pt_run_filter_array(_ptr(runs, ctypes.c_uint16), runs.shape[0],
+                              _ptr(vals, ctypes.c_uint16), vals.size,
+                              _ptr(out, ctypes.c_uint16),
+                              1 if keep_inside else 0)
+    return out[:k].copy()
+
+
+def run_and_count_bits(runs: np.ndarray, words: np.ndarray):
+    """popcount of the uint64[1024] bitmap restricted to the intervals;
+    None without the lib."""
+    h = lib()
+    if h is None:
+        return None
+    runs = np.ascontiguousarray(runs, dtype=np.uint16)
+    words = np.ascontiguousarray(words, dtype=np.uint64)
+    return int(h.pt_run_and_count_bits(_ptr(runs, ctypes.c_uint16),
+                                       runs.shape[0],
+                                       _ptr(words, ctypes.c_uint64)))
+
+
+def run_to_bits(runs: np.ndarray) -> np.ndarray:
+    """[n, 2] intervals -> uint64[1024] bitmap (numpy fallback included:
+    this one backs the storage layer's dense materialization)."""
+    h = lib()
+    runs = np.ascontiguousarray(runs, dtype=np.uint16)
+    out = np.zeros(1024, dtype=np.uint64)
+    if h is None:
+        bits = np.zeros(1 << 16, dtype=np.uint8)
+        for s, e in runs.astype(np.int32):
+            bits[s:e + 1] = 1
+        return np.packbits(bits, bitorder="little").view("<u8").copy()
+    h.pt_run_to_bits(_ptr(runs, ctypes.c_uint16), runs.shape[0],
+                     _ptr(out, ctypes.c_uint64))
     return out
 
 

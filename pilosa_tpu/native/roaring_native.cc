@@ -182,4 +182,125 @@ size_t pt_oplog_parse(const uint8_t* data, size_t n, uint8_t* types,
   return (pos == n) ? count : (size_t)-1;
 }
 
+// ------------------------------------------------------------- run kernels
+
+// Run containers: [n][2] uint16 (start, last) inclusive intervals, sorted,
+// disjoint, non-adjacent — the reference's interval16 encoding
+// (roaring/roaring.go:1261, op kernels 3549-3771). int32 internally so the
+// inclusive end 65535 never wraps.
+
+static inline size_t pt_emit_run_(uint16_t* out, size_t k, int32_t s,
+                                  int32_t e) {
+  if (s > e) return k;
+  if (k > 0 && (int32_t)out[2 * k - 1] + 1 == s) {  // coalesce adjacent
+    out[2 * k - 1] = (uint16_t)e;
+    return k;
+  }
+  out[2 * k] = (uint16_t)s;
+  out[2 * k + 1] = (uint16_t)e;
+  return k + 1;
+}
+
+// Boundary sweep computing op(a, b) over interval lists. kind: 0=and 1=or
+// 2=andnot 3=xor. With `out` non-null, writes result intervals and returns
+// their count (`out` must hold 2*(na+nb+1) uint16 pairs, the xor worst
+// case); with `out` null, returns the MEMBER count instead. One driver so
+// Container.op and Container.op_count can never desynchronize. O(na + nb).
+static uint64_t pt_run_sweep_(const uint16_t* a, size_t na, const uint16_t* b,
+                              size_t nb, uint16_t* out, int kind) {
+  const int32_t END = 1 << 16;
+  size_t ia = 0, ib = 0, k = 0;
+  uint64_t total = 0;
+  int32_t pos = 0;
+  while (pos < END) {
+    int32_t as = ia < na ? (int32_t)a[2 * ia] : END + 1;
+    int32_t ae = ia < na ? (int32_t)a[2 * ia + 1] : END + 1;
+    int32_t bs = ib < nb ? (int32_t)b[2 * ib] : END + 1;
+    int32_t be = ib < nb ? (int32_t)b[2 * ib + 1] : END + 1;
+    bool in_a = as <= pos && pos <= ae;
+    bool in_b = bs <= pos && pos <= be;
+    int32_t nxt = END;
+    if (in_a) { if (ae + 1 < nxt) nxt = ae + 1; }
+    else if (as < nxt) nxt = as;
+    if (in_b) { if (be + 1 < nxt) nxt = be + 1; }
+    else if (bs < nxt) nxt = bs;
+    bool val;
+    switch (kind) {
+      case 0: val = in_a && in_b; break;
+      case 1: val = in_a || in_b; break;
+      case 2: val = in_a && !in_b; break;
+      default: val = in_a != in_b; break;
+    }
+    if (val) {
+      if (out) k = pt_emit_run_(out, k, pos, nxt - 1);
+      else total += (uint64_t)(nxt - pos);
+    }
+    if (in_a && nxt == ae + 1) ia++;
+    if (in_b && nxt == be + 1) ib++;
+    pos = nxt;
+  }
+  return out ? (uint64_t)k : total;
+}
+
+size_t pt_run_op(const uint16_t* a, size_t na, const uint16_t* b, size_t nb,
+                 uint16_t* out, int kind) {
+  return (size_t)pt_run_sweep_(a, na, b, nb, out, kind);
+}
+
+// Member count of op(a, b) (intersectionCountRunRun analog,
+// roaring/roaring.go:2253-2291 family).
+uint64_t pt_run_op_count(const uint16_t* a, size_t na, const uint16_t* b,
+                         size_t nb, int kind) {
+  return pt_run_sweep_(a, na, b, nb, nullptr, kind);
+}
+
+// Keep (keep_inside=1) or drop (keep_inside=0) sorted array values that
+// fall inside the intervals: array∧run and array∖run in one pass
+// (intersectArrayRun analog, roaring/roaring.go:2292ff). out holds nv.
+size_t pt_run_filter_array(const uint16_t* runs, size_t nr,
+                           const uint16_t* vals, size_t nv, uint16_t* out,
+                           int keep_inside) {
+  size_t ir = 0, k = 0;
+  for (size_t i = 0; i < nv; i++) {
+    uint16_t v = vals[i];
+    while (ir < nr && runs[2 * ir + 1] < v) ir++;
+    bool inside = ir < nr && runs[2 * ir] <= v;
+    if (inside == (keep_inside != 0)) out[k++] = v;
+  }
+  return k;
+}
+
+// popcount of the bitmap restricted to the intervals — run∧bitmap count
+// without materializing either side (intersectionCountBitmapRun analog).
+uint64_t pt_run_and_count_bits(const uint16_t* runs, size_t nr,
+                               const uint64_t* words) {
+  uint64_t total = 0;
+  for (size_t i = 0; i < nr; i++) {
+    int32_t s = (int32_t)runs[2 * i], e = (int32_t)runs[2 * i + 1];
+    int32_t ws = s >> 6, we = e >> 6;
+    for (int32_t w = ws; w <= we; w++) {
+      uint64_t m = ~0ULL;
+      if (w == ws) m &= ~0ULL << (s & 63);
+      if (w == we) m &= ~0ULL >> (63 - (e & 63));
+      total += (uint64_t)__builtin_popcountll(words[w] & m);
+    }
+  }
+  return total;
+}
+
+// Set the intervals into a zeroed uint64[1024] bitmap (runToBitmapContainer
+// analog, roaring/roaring.go:1776ff).
+void pt_run_to_bits(const uint16_t* runs, size_t nr, uint64_t* words) {
+  for (size_t i = 0; i < nr; i++) {
+    int32_t s = (int32_t)runs[2 * i], e = (int32_t)runs[2 * i + 1];
+    int32_t ws = s >> 6, we = e >> 6;
+    for (int32_t w = ws; w <= we; w++) {
+      uint64_t m = ~0ULL;
+      if (w == ws) m &= ~0ULL << (s & 63);
+      if (w == we) m &= ~0ULL >> (63 - (e & 63));
+      words[w] |= m;
+    }
+  }
+}
+
 }  // extern "C"

@@ -82,12 +82,10 @@ def _words_to_array(words: np.ndarray) -> np.ndarray:
 
 
 def _runs_to_words(iv: np.ndarray) -> np.ndarray:
-    """[nruns, 2] (start, last) -> uint64[1024] dense words."""
-    bits = np.zeros(CONTAINER_BITS, dtype=np.uint8)
-    for s, last in iv.astype(np.int64):
-        bits[s : last + 1] = 1
-    packed = np.packbits(bits, bitorder="little")
-    return np.frombuffer(packed.tobytes(), dtype="<u8").copy()
+    """[nruns, 2] (start, last) -> uint64[1024] dense words (native masked
+    range-set kernel; numpy packbits fallback lives in native.run_to_bits)."""
+    from pilosa_tpu import native
+    return native.run_to_bits(iv)
 
 
 def _runs_to_values(iv: np.ndarray) -> np.ndarray:
@@ -236,10 +234,30 @@ class Container:
     # -- set algebra --------------------------------------------------------
 
     def op(self, other: "Container", kind: str) -> "Container":
+        from pilosa_tpu import native
         if self.kind == "array" and other.kind == "array":
-            from pilosa_tpu import native
             out = native.array_op(self.data, other.data, kind)
             return Container.from_values(out)
+        # run fast paths (intersect/union/difference/xor *Run kernels,
+        # roaring.go:3549-3771): interval algebra instead of an 8 KiB
+        # dense inflation; None = native lib unavailable -> dense fallback
+        if self.kind == "run" and other.kind == "run":
+            iv = native.run_op(self.data, other.data, kind)
+            if iv is not None:
+                if iv.shape[0] == 0:
+                    return Container.empty()
+                return Container("run", iv)
+        if self.kind == "array" and other.kind == "run" \
+                and kind in ("and", "andnot"):
+            out = native.run_filter_array(other.data, self.data,
+                                          keep_inside=(kind == "and"))
+            if out is not None:
+                return Container.from_values(out)
+        if self.kind == "run" and other.kind == "array" and kind == "and":
+            out = native.run_filter_array(self.data, other.data,
+                                          keep_inside=True)
+            if out is not None:
+                return Container.from_values(out)
         aw, bw = self.words(), other.words()
         if kind == "and":
             out = aw & bw
@@ -255,6 +273,24 @@ class Container:
         from pilosa_tpu import native
         if self.kind == "array" and other.kind == "array" and kind == "and":
             return int(native.array_op(self.data, other.data, "and").size)
+        # run fast paths (intersectionCount*Run kernels,
+        # roaring.go:2162-2291): count without dense inflation
+        if self.kind == "run" and other.kind == "run":
+            n = native.run_op_count(self.data, other.data, kind)
+            if n is not None:
+                return n
+        if kind == "and" and {self.kind, other.kind} == {"run", "bitmap"}:
+            runs, words = ((self.data, other.data)
+                           if self.kind == "run" else (other.data, self.data))
+            n = native.run_and_count_bits(runs, words)
+            if n is not None:
+                return n
+        if kind == "and" and {self.kind, other.kind} == {"run", "array"}:
+            runs, vals = ((self.data, other.data)
+                          if self.kind == "run" else (other.data, self.data))
+            out = native.run_filter_array(runs, vals, keep_inside=True)
+            if out is not None:
+                return int(out.size)
         aw, bw = self.words(), other.words()
         if kind == "and":
             return native.and_count(aw, bw)
