@@ -346,3 +346,53 @@ def test_container_op_matrix_all_kind_pairs():
                 # inputs must be untouched (ops are pure)
                 assert set(a.values().tolist()) == models[ka]
                 assert set(b.values().tolist()) == models[kb]
+
+
+def test_run_mutation_fuzz_vs_set_model():
+    """Interleaved mutation fuzz with optimize() forced between steps so
+    run encodings keep appearing mid-stream (the new native run kernels'
+    adversarial workout): add/remove batches, container ops, serialization
+    round-trips — every step checked against a python-set model."""
+    import io
+
+    rng = np.random.default_rng(123)
+    b = Bitmap()
+    model = set()
+    # clustered value space: long runs + scattered points, 2 containers
+    def draw(n):
+        if rng.integers(0, 2):
+            s = int(rng.integers(0, 2 << 16))
+            return np.arange(s, min(s + int(rng.integers(1, 4000)),
+                                    2 << 16), dtype=np.uint64)
+        return rng.integers(0, 2 << 16, size=n).astype(np.uint64)
+
+    for step in range(60):
+        vals = draw(int(rng.integers(1, 500)))
+        if rng.integers(0, 3) == 0:
+            for v in np.unique(vals):
+                if b.remove(int(v)):
+                    model.discard(int(v))
+                else:
+                    assert int(v) not in model
+        else:
+            for v in np.unique(vals):
+                added = b.add(int(v))
+                assert added == (int(v) not in model)
+                model.add(int(v))
+        if step % 5 == 0:
+            b.optimize()  # re-pick encodings (runs appear here)
+        if step % 7 == 0:
+            other_vals = draw(300)
+            other = Bitmap(np.unique(other_vals))
+            other.optimize()
+            omodel = set(np.unique(other_vals).tolist())
+            assert b.intersection_count(other) == len(model & omodel)
+            assert b.intersect(other).count() == len(model & omodel)
+            assert b.union(other).count() == len(model | omodel)
+        if step % 11 == 0:
+            buf = io.BytesIO()
+            b.write_to(buf)
+            b = Bitmap.from_bytes(buf.getvalue())
+        assert b.count() == len(model), step
+        b.check()
+    assert set(b.slice().tolist()) == model
