@@ -134,18 +134,29 @@ def _attach_go_ref(m: dict, bench_name: str, tpu_s: float) -> None:
 
 
 def _concurrent_seconds_per_query(n_threads: int, per_thread: int,
-                                  run_query) -> float:
+                                  run_query, latencies: list = None) -> float:
     """Aggregate serving rate under concurrent clients: n_threads each
     issue per_thread queries via run_query(thread_id, i); returns wall
-    seconds per query. First client error re-raises."""
+    seconds per query. When `latencies` is given, per-query wall times
+    (seconds) are appended to it. First client error re-raises."""
     import threading
 
     errors = []
+    lat_lock = threading.Lock()
 
     def client(tid):
         try:
+            if latencies is None:
+                for i in range(per_thread):
+                    run_query(tid, i)
+                return
+            mine = []
             for i in range(per_thread):
+                q0 = time.perf_counter()
                 run_query(tid, i)
+                mine.append(time.perf_counter() - q0)
+            with lat_lock:
+                latencies.extend(mine)
         except Exception as e:  # noqa: BLE001
             errors.append(e)
 
@@ -162,9 +173,19 @@ def _concurrent_seconds_per_query(n_threads: int, per_thread: int,
     return wall / (n_threads * per_thread)
 
 
+def _lat_ms(latencies: list) -> dict:
+    """{p50, p99} in ms from collected per-query latencies."""
+    if not latencies:
+        return {}
+    s = sorted(latencies)
+    return {"p50_ms": round(s[len(s) // 2] * 1e3, 2),
+            "p99_ms": round(s[min(len(s) - 1, int(len(s) * 0.99))] * 1e3, 2)}
+
+
 def _measure_base_peak(base_threads: int, peak_threads: int,
                        per_thread_base: int, per_thread_peak: int,
-                       run_query, on_base_done=None) -> tuple:
+                       run_query, on_base_done=None,
+                       latencies: list = None) -> tuple:
     """Closed-loop serving at a base concurrency (continuity with earlier
     rounds) and — when peak_threads > base_threads — at a saturating one:
     over a ~100-190 ms tunnel a closed loop caps at in_flight/RTT, so peak
@@ -181,7 +202,7 @@ def _measure_base_peak(base_threads: int, peak_threads: int,
     if peak_threads <= base_threads:
         return base_s, base_threads, base_s, None
     peak_s = _concurrent_seconds_per_query(peak_threads, per_thread_peak,
-                                           run_query)
+                                           run_query, latencies=latencies)
     if peak_s < base_s:
         return peak_s, peak_threads, base_s, peak_s
     return base_s, base_threads, base_s, peak_s
@@ -361,10 +382,12 @@ def bench_executor(ex, row_bits) -> dict:
     # analog of the reference's concurrent query benchmarks (dispatches
     # and fetches from different queries overlap on the link); see
     # _measure_base_peak for the base-vs-saturating protocol
+    peak_lat: list = []
     tpu_s, headline_threads, tpu_s_base, tpu_s_peak = _measure_base_peak(
         EXEC_THREADS, EXEC_THREADS_PEAK,
         max(8, ENGINE_QUERIES // 4), max(8, ENGINE_QUERIES // 8),
-        lambda tid, i: ex.execute("b", qs[(tid * 7 + i) % len(qs)]))
+        lambda tid, i: ex.execute("b", qs[(tid * 7 + i) % len(qs)]),
+        latencies=peak_lat)
 
     # CPU baseline: the same dense AND+popcount work in numpy (per query:
     # two [S, W] operands), scaled from a slice. Measured BOTH single-core
@@ -408,7 +431,8 @@ def bench_executor(ex, row_bits) -> dict:
     if tpu_s_peak is not None:
         out["qps_at_peak_concurrency"] = {
             "clients": EXEC_THREADS_PEAK,
-            "qps": round(1.0 / tpu_s_peak, 2)}
+            "qps": round(1.0 / tpu_s_peak, 2),
+            **_lat_ms(peak_lat)}  # per-query latency under saturating load
     if EXEC_SHARDS == 128:  # proxy measured at this exact shape (1% rows)
         _attach_go_ref(out, "exec_128shard_1pct", tpu_s)
     return out
