@@ -1,0 +1,154 @@
+"""InternalClient connection pooling and retry semantics.
+
+The pooled keep-alive client (net/client.py) must reuse connections across
+requests, transparently retry exactly the stale-keep-alive failure modes,
+surface HTTP error statuses as ClientError, and never retry once response
+headers have arrived (side-effect safety). Exercised against a raw-socket
+HTTP server whose behavior is scripted per connection.
+"""
+
+import socket
+import threading
+
+import pytest
+
+from pilosa_tpu.net.client import ClientError, InternalClient
+
+
+class ScriptedServer:
+    """Accepts connections; each connection is handled per `script`, a list
+    of per-request actions: "ok" (respond 200, keep alive), "close-after"
+    (respond 200 then close), "drop" (close without responding), "400"
+    (error status). Tracks connection and request counts."""
+
+    def __init__(self, script):
+        self.script = script
+        self.sock = socket.create_server(("127.0.0.1", 0))
+        self.port = self.sock.getsockname()[1]
+        self.connections = 0
+        self.requests = 0
+        self._lock = threading.Lock()
+        self._thread = threading.Thread(target=self._serve, daemon=True)
+        self._thread.start()
+
+    @property
+    def uri(self):
+        return f"http://127.0.0.1:{self.port}"
+
+    def _read_request(self, conn) -> bool:
+        data = b""
+        while b"\r\n\r\n" not in data:
+            chunk = conn.recv(65536)
+            if not chunk:
+                return False
+            data += chunk
+        head = data.split(b"\r\n\r\n", 1)[0].decode()
+        clen = 0
+        for line in head.split("\r\n"):
+            if line.lower().startswith("content-length:"):
+                clen = int(line.split(":", 1)[1])
+        body = data.split(b"\r\n\r\n", 1)[1]
+        while len(body) < clen:
+            chunk = conn.recv(65536)
+            if not chunk:
+                return False
+            body += chunk
+        return True
+
+    def _serve(self):
+        self.sock.settimeout(10)
+        while True:
+            try:
+                conn, _ = self.sock.accept()
+            except (OSError, socket.timeout):
+                return
+            with self._lock:
+                self.connections += 1
+            threading.Thread(target=self._handle, args=(conn,),
+                             daemon=True).start()
+
+    def _handle(self, conn):
+        try:
+            while True:
+                with self._lock:
+                    action = (self.script.pop(0) if self.script else "ok")
+                if not self._read_request(conn):
+                    return
+                with self._lock:
+                    self.requests += 1
+                if action == "drop":
+                    conn.close()
+                    return
+                body = b'{"ok": true}' if action != "400" \
+                    else b'{"error": "bad", "code": "ErrTest"}'
+                status = b"200 OK" if action != "400" else b"400 Bad Request"
+                conn.sendall(
+                    b"HTTP/1.1 " + status + b"\r\n"
+                    b"Content-Type: application/json\r\n"
+                    b"Content-Length: " + str(len(body)).encode() + b"\r\n"
+                    b"\r\n" + body)
+                if action == "close-after":
+                    conn.close()
+                    return
+        except OSError:
+            pass
+
+    def close(self):
+        self.sock.close()
+
+
+def test_keepalive_reuses_one_connection():
+    srv = ScriptedServer(["ok"] * 5)
+    try:
+        c = InternalClient(timeout=5)
+        for _ in range(5):
+            assert c._json("POST", srv.uri, "/x", {"a": 1}) == {"ok": True}
+        assert srv.requests == 5
+        assert srv.connections == 1  # pooled: one TCP connection for all
+    finally:
+        srv.close()
+
+
+def test_stale_keepalive_retries_once_transparently():
+    # server closes the connection after the first response; the client's
+    # second request hits the stale socket and must transparently reconnect
+    srv = ScriptedServer(["close-after", "ok"])
+    try:
+        c = InternalClient(timeout=5)
+        assert c._json("POST", srv.uri, "/x", {}) == {"ok": True}
+        assert c._json("POST", srv.uri, "/x", {}) == {"ok": True}
+        assert srv.connections == 2
+    finally:
+        srv.close()
+
+
+def test_fresh_connection_failure_is_an_error_not_a_retry():
+    # a connection that dies WITHOUT ever answering is a real peer failure:
+    # exactly one reconnect attempt is allowed for the stale case, and a
+    # fresh-connection drop must not loop
+    srv = ScriptedServer(["drop", "drop", "drop"])
+    try:
+        c = InternalClient(timeout=5)
+        with pytest.raises(ClientError):
+            c._json("POST", srv.uri, "/x", {})
+        assert srv.connections <= 2  # at most the one stale-style retry
+    finally:
+        srv.close()
+
+
+def test_http_error_status_surfaces_code():
+    srv = ScriptedServer(["400"])
+    try:
+        c = InternalClient(timeout=5)
+        with pytest.raises(ClientError) as exc:
+            c._json("POST", srv.uri, "/x", {})
+        assert exc.value.status == 400
+        assert exc.value.code == "ErrTest"
+    finally:
+        srv.close()
+
+
+def test_connection_refused_is_clienterror():
+    c = InternalClient(timeout=2)
+    with pytest.raises(ClientError):
+        c._json("POST", "http://127.0.0.1:9", "/x", {})
