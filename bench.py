@@ -700,12 +700,15 @@ def bench_http(tmpdir) -> dict:
 
         # concurrent clients (the threaded server's actual serving mode);
         # see _measure_base_peak for the base-vs-saturating protocol
+        peak_lat: list = []
         per_q, conc, per_q_base, per_q_peak = _measure_base_peak(
             HTTP_THREADS, HTTP_THREADS_PEAK,
             HTTP_QUERIES // HTTP_THREADS,
             max(2, HTTP_QUERIES // HTTP_THREADS_PEAK),
-            lambda tid, i: post("/index/h/query", q))
+            lambda tid, i: post("/index/h/query", q),
+            latencies=peak_lat)
         return {
+            **({"peak_latency": _lat_ms(peak_lat)} if peak_lat else {}),
             "metric": "http_count_qps",
             "value": round(1.0 / per_q, 2),
             "unit": "queries/s",
