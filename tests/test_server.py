@@ -239,10 +239,14 @@ def test_cluster_ddl_broadcast_and_distributed_query(cluster3):
     for c in cols:
         status, out = jpost(s0.uri, "/index/i/query", raw=f"Set({c}, f=1)".encode())
         assert status == 200, out
-    # distributed read from any node sees all columns
+    # distributed read from any node sees all columns; a node hosting no
+    # replica of a new shard learns of it via the async create-shard
+    # announcement, so poll for convergence (eventual visibility, like the
+    # reference's gossiped CreateShardMessage)
     for s in cluster3:
-        _, out = jpost(s.uri, "/index/i/query", raw=b"Row(f=1)")
-        assert out["results"][0]["columns"] == cols, s.uri
+        assert wait_until(lambda s=s: jpost(
+            s.uri, "/index/i/query", raw=b"Row(f=1)"
+        )[1]["results"][0]["columns"] == cols), s.uri
         _, out = jpost(s.uri, "/index/i/query", raw=b"Count(Row(f=1))")
         assert out["results"] == [4]
     # each shard is stored on exactly replica_n nodes
