@@ -14,10 +14,8 @@ import io
 from datetime import datetime, timezone
 from typing import Optional
 
-import numpy as np
-
 from pilosa_tpu import __version__
-from pilosa_tpu.constants import EXISTENCE_FIELD_NAME, SHARD_WIDTH
+from pilosa_tpu.constants import SHARD_WIDTH
 from pilosa_tpu.executor import (
     ExecutionError,
     Executor,
@@ -27,7 +25,6 @@ from pilosa_tpu.executor import (
     ValCount,
 )
 from pilosa_tpu.models import FieldOptions, Holder
-from pilosa_tpu.models.field import FieldType
 from pilosa_tpu.models.row import Row
 from pilosa_tpu.models.view import VIEW_STANDARD
 from pilosa_tpu.parallel.cluster import (
