@@ -34,6 +34,9 @@ class ClusterConfig:
     replicas: int = 1
     hosts: list[str] = field(default_factory=list)
     long_query_time: float = 0.0
+    # server-wide default query deadline (seconds/duration); 0 = none.
+    # Overridden per request by ?timeout= or an adopted fan-out header.
+    query_timeout: float = 0.0
     # liveness probing (gossip probe/suspicion analog,
     # gossip/gossip.go:488-519): consecutive failed /status probes before a
     # peer is marked down, and the per-probe timeout in seconds

@@ -131,6 +131,7 @@ def cmd_server(args) -> int:
         anti_entropy_interval=cfg.anti_entropy.interval,
         join=getattr(args, "join", False),
         long_query_time=cfg.cluster.long_query_time,
+        query_timeout=cfg.cluster.query_timeout,
         max_writes_per_request=cfg.max_writes_per_request,
         metric_service=cfg.metric.service,
         metric_host=cfg.metric.host,

@@ -142,8 +142,11 @@ class Handler:
                     return self._error(
                         400, f"invalid query argument(s): {', '.join(sorted(unknown))}")
                 handler = getattr(self, name)
-                dl_token = self._set_deadline(name, query, headers)
+                dl_token = None
                 try:
+                    # inside the try: an invalid ?timeout= must map to a
+                    # clean 400 like any other ApiError, not escape dispatch
+                    dl_token = self._set_deadline(name, query, headers)
                     return handler(match.groupdict(), query, body)
                 except qctx.QueryTimeoutError as e:
                     return self._error(504, str(e))

@@ -50,6 +50,7 @@ class Server:
                  resize_timeout: float = 120.0,
                  mesh=None,
                  long_query_time: float = 0.0,
+                 query_timeout: float = 0.0,
                  max_writes_per_request: int = 5000,
                  metric_service: str = "expvar",
                  metric_host: str = "127.0.0.1:8125",
@@ -111,7 +112,7 @@ class Server:
         self.api = API(self.holder, self.cluster, executor=self.executor,
                        translate_store=self.cluster_translate)
         self.handler = Handler(self.api, cluster_message_fn=self.receive_message,
-                               stats=self.stats)
+                               stats=self.stats, query_timeout=query_timeout)
         self.http = HTTPServer(self.handler, host=host, port=port,
                                tls_certificate=tls_certificate, tls_key=tls_key)
         self._bind_host = host

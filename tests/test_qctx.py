@@ -155,3 +155,30 @@ def test_http_timeout_arg_maps_to_504(tmp_path):
         headers={qctx.DEADLINE_HEADER: "-1"})
     assert status == 504, payload
     h.close()
+
+
+def test_server_default_query_timeout_applies(tmp_path):
+    """[cluster] query-timeout sets a default deadline for queries with no
+    per-request override: a pre-expired one must 504 every bare query."""
+    from pilosa_tpu.net.http_server import Handler
+    from pilosa_tpu.api import API
+    from pilosa_tpu.models.holder import Holder
+    from pilosa_tpu.parallel.cluster import Cluster, Node
+
+    h = Holder(str(tmp_path))
+    h.open()
+    cluster = Cluster("n1")
+    cluster.set_static([Node(id="n1", uri="http://localhost:0")])
+    api = API(h, cluster)
+    # an (absurdly) tiny default: expired by the time the executor checks
+    handler = Handler(api, query_timeout=1e-9)
+    handler.dispatch("POST", "/index/q", {}, b"{}")
+    handler.dispatch("POST", "/index/q/field/f", {}, b"{}")
+    status, _, payload = handler.dispatch(
+        "POST", "/index/q/query", {}, b"Count(Row(f=0))")
+    assert status == 504, payload
+    # per-request ?timeout= overrides the default
+    status, _, _ = handler.dispatch(
+        "POST", "/index/q/query", {"timeout": ["30s"]}, b"Count(Row(f=0))")
+    assert status == 200
+    h.close()
