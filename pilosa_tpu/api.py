@@ -565,7 +565,11 @@ class API:
 
     def status(self) -> dict:
         return {"state": self.cluster.state, "nodes": self.hosts(),
-                "localID": self.cluster.local_id}
+                "localID": self.cluster.local_id,
+                # each node's coordinator claim; the probe loop converges
+                # divergent claims onto the electoral authority's (see
+                # Server._probe_peers)
+                "coordinatorID": self.cluster.coordinator_id}
 
     def info(self) -> dict:
         import os
@@ -592,7 +596,11 @@ class API:
         self._validate("resize")
         if self.cluster.node_by_id(node_id) is None:
             raise NotFoundError(f"node not found: {node_id}")
-        self.cluster.coordinator_id = node_id
+        self.cluster.adopt_coordinator(node_id)
+        # cluster-wide adoption (SetCoordinatorMessage, api.go
+        # SetCoordinator → SendSync): without it, a later failover would
+        # leave resize coordination split across divergent coordinators
+        self._broadcast({"type": "set-coordinator", "id": node_id})
 
     def remove_node(self, node_id: str):
         self._validate("resize")

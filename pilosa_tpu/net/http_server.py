@@ -101,14 +101,19 @@ class Handler:
         shard batches (executor.go:2591-2608 validateQueryContext)."""
         import time
 
+        # gather every applicable source and take the STRICTEST: a
+        # malformed or forged fan-out header must not disable the local
+        # sources (the operator's query-timeout cap in particular), and
+        # ?timeout=0 means "no timeout from this source" per the
+        # documented convention, not an already-expired deadline
+        candidates = []
         incoming = (headers or {}).get(qctx.DEADLINE_HEADER)
-        secs = None
         if incoming:
             try:
-                secs = float(incoming)
+                candidates.append(float(incoming))
             except ValueError:
-                secs = None
-        elif route == "post_query":
+                pass  # malformed header: fall through to local sources
+        if route == "post_query":
             arg = self._arg(query, "timeout")
             if arg:
                 from pilosa_tpu.utils.duration import parse_duration
@@ -116,11 +121,13 @@ class Handler:
                     secs = parse_duration(arg)
                 except ValueError:
                     raise ApiError(f"invalid timeout: {arg!r}")
-            elif self.query_timeout > 0:
-                secs = self.query_timeout
-        if secs is None:
+                if secs > 0:
+                    candidates.append(secs)
+            if self.query_timeout > 0:
+                candidates.append(self.query_timeout)
+        if not candidates:
             return None
-        return qctx.deadline.set(time.monotonic() + secs)
+        return qctx.deadline.set(time.monotonic() + min(candidates))
 
     def dispatch(self, method: str, path: str, query: dict, body: bytes,
                  headers=None):

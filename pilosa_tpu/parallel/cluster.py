@@ -106,6 +106,7 @@ class Cluster:
         self.nodes: list[Node] = []
         self.state = STATE_STARTING
         self.coordinator_id: Optional[str] = None
+        self._explicit_coordinator = False  # set-coordinator stickiness
         self.schema_fn = schema_fn or (lambda: {})
         self.topology_path = topology_path
         self.cluster_id = str(uuid.uuid4())
@@ -140,6 +141,28 @@ class Cluster:
 
     def is_coordinator(self) -> bool:
         return self.coordinator_id == self.local_id
+
+    def adopt_coordinator(self, node_id: str) -> None:
+        """EXPLICIT adoption (set-coordinator broadcast, or a probe tick
+        syncing to the electoral authority's claim): sticky while the node
+        remains a member."""
+        self.coordinator_id = node_id
+        self._explicit_coordinator = True
+        self.elect_coordinator()
+
+    def elect_coordinator(self) -> None:
+        """An explicitly-adopted coordinator is STICKY while it remains a
+        member; otherwise the deterministic default — lowest node id —
+        coordinates. Membership paths call this instead of resetting to
+        min(nodes), or an operator's choice would be undone on the next
+        tick (bootstrap self-claims from set_static are NOT explicit, so
+        they still converge to the default)."""
+        ids = {n.id for n in self.nodes}
+        if getattr(self, "_explicit_coordinator", False) \
+                and self.coordinator_id in ids:
+            return
+        self._explicit_coordinator = False
+        self.coordinator_id = min(ids) if ids else self.local_id
 
     def set_static(self, nodes: list[Node]) -> None:
         """Gossip-less fixed-membership mode (`cluster.disabled`,

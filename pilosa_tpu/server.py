@@ -384,8 +384,8 @@ class Server:
             if nd["id"] not in nodes and nd["id"] not in self._removed_ids:
                 nodes[nd["id"]] = Node.from_dict(nd)
         self.cluster.set_static(list(nodes.values()))
-        # lowest node id coordinates (deterministic across peers)
-        self.cluster.coordinator_id = min(nodes)
+        # sticky explicit coordinator; lowest node id otherwise
+        self.cluster.elect_coordinator()
 
     def _probe_peers(self) -> None:
         """Liveness detection: probe every known peer's /status each
@@ -412,9 +412,14 @@ class Server:
 
         # probe concurrently: N down peers must cost one probe_timeout per
         # tick, not N of them (the membership timer is a single thread)
+        claims: dict[str, str] = {}  # live peer -> its coordinator claim
+
         def probe(node):
             try:
-                self.client.status(node.uri, timeout=self.probe_timeout)
+                st = self.client.status(node.uri, timeout=self.probe_timeout)
+                claim = st.get("coordinatorID")
+                if claim:
+                    claims[node.id] = claim
                 return True
             except Exception:  # noqa: BLE001 — ANY probe failure means
                 # not-alive (ClientError, socket teardown mid-close, ...);
@@ -459,6 +464,17 @@ class Server:
                 if (n >= self.liveness_threshold
                         and not self.cluster.is_down(node.id)):
                     suspects.append(node)
+        # coordinator convergence: adopt the claim of the lowest-id LIVE
+        # node (the deterministic electoral authority — its own claim is
+        # sticky via elect_coordinator), so an explicit set-coordinator
+        # reaches nodes that missed the broadcast within one probe tick
+        live_ids = {self.node_id} | {n.id for n in peers
+                                     if results.get(n.id)}
+        authority = min(live_ids)
+        if authority != self.node_id:
+            claim = claims.get(authority)
+            if claim and self.cluster.node_by_id(claim) is not None:
+                self.cluster.adopt_coordinator(claim)
         if not suspects:
             return
         # SUSPECT phase: before declaring a peer dead, ask other live
@@ -667,6 +683,15 @@ class Server:
             self.cluster.add_node(node)
         elif mtype == "recalculate-caches":
             self.api.recalculate_caches()
+        elif mtype == "set-coordinator":
+            # SetCoordinatorMessage (broadcast.go; api.go SetCoordinator):
+            # every node adopts the new coordinator or resize plans after a
+            # failover would be driven by divergent coordinators. Adopt
+            # unconditionally (the id may be a node we learn of next tick);
+            # elect_coordinator reverts an id that never materializes, and
+            # the probe loop's authority claim converges stragglers.
+            if msg.get("id"):
+                self.cluster.adopt_coordinator(msg["id"])
         elif mtype == "node-join-request":
             self._handle_join_request(Node.from_dict(msg["node"]))
         elif mtype == "node-leave-request":
@@ -1158,8 +1183,7 @@ class Server:
         """Push the final membership to every node (the coordinator's
         cluster-status broadcast after a resize completes)."""
         nodes_d = [n.to_dict() for n in self.cluster.nodes]
-        self.cluster.coordinator_id = min(
-            (n.id for n in self.cluster.nodes), default=self.node_id)
+        self.cluster.elect_coordinator()
         msg = {"type": "topology", "nodes": nodes_d,
                "removed": sorted(self._removed_ids)}
         for n in self.cluster.nodes:
@@ -1188,8 +1212,7 @@ class Server:
         nodes = [Node.from_dict(d) for d in nodes_d
                  if d["id"] not in self._removed_ids]
         self.cluster.set_static(nodes)
-        self.cluster.coordinator_id = min(
-            (n.id for n in nodes), default=self.node_id)
+        self.cluster.elect_coordinator()
         self.clean_holder()
 
     def clean_holder(self) -> int:

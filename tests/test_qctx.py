@@ -157,9 +157,11 @@ def test_http_timeout_arg_maps_to_504(tmp_path):
     h.close()
 
 
-def test_server_default_query_timeout_applies(tmp_path):
-    """[cluster] query-timeout sets a default deadline for queries with no
-    per-request override: a pre-expired one must 504 every bare query."""
+def test_server_query_timeout_is_a_cap(tmp_path):
+    """[cluster] query-timeout is an operator CAP: it bounds bare queries,
+    cannot be lengthened by ?timeout= or a forged/malformed fan-out
+    header, and ?timeout=0 means no client-side timeout (the cap still
+    applies)."""
     from pilosa_tpu.net.http_server import Handler
     from pilosa_tpu.api import API
     from pilosa_tpu.models.holder import Holder
@@ -170,15 +172,34 @@ def test_server_default_query_timeout_applies(tmp_path):
     cluster = Cluster("n1")
     cluster.set_static([Node(id="n1", uri="http://localhost:0")])
     api = API(h, cluster)
-    # an (absurdly) tiny default: expired by the time the executor checks
+    # an (absurdly) tiny cap: expired by the time the executor checks
     handler = Handler(api, query_timeout=1e-9)
     handler.dispatch("POST", "/index/q", {}, b"{}")
     handler.dispatch("POST", "/index/q/field/f", {}, b"{}")
     status, _, payload = handler.dispatch(
         "POST", "/index/q/query", {}, b"Count(Row(f=0))")
     assert status == 504, payload
-    # per-request ?timeout= overrides the default
+    # a larger ?timeout= cannot lift the cap
     status, _, _ = handler.dispatch(
         "POST", "/index/q/query", {"timeout": ["30s"]}, b"Count(Row(f=0))")
+    assert status == 504
+    # neither can a forged or malformed deadline header
+    status, _, _ = handler.dispatch(
+        "POST", "/index/q/query", {}, b"Count(Row(f=0))",
+        headers={qctx.DEADLINE_HEADER: "999999"})
+    assert status == 504
+    status, _, _ = handler.dispatch(
+        "POST", "/index/q/query", {}, b"Count(Row(f=0))",
+        headers={qctx.DEADLINE_HEADER: "garbage"})
+    assert status == 504
+    # with no cap, ?timeout=0 = unbounded (documented convention), and a
+    # malformed header alone leaves the query deadline-free
+    unbounded = Handler(api)
+    status, _, _ = unbounded.dispatch(
+        "POST", "/index/q/query", {"timeout": ["0"]}, b"Count(Row(f=0))")
+    assert status == 200
+    status, _, _ = unbounded.dispatch(
+        "POST", "/index/q/query", {}, b"Count(Row(f=0))",
+        headers={qctx.DEADLINE_HEADER: "garbage"})
     assert status == 200
     h.close()

@@ -1249,3 +1249,46 @@ def test_debug_vars_surfaces_volatile_fragments(server, tmp_path):
     frag.snapshot()
     _, dv = http("GET", server.uri, "/debug/vars")
     assert "volatileFragments" not in json.loads(dv)
+
+
+def test_import_roaring_endpoint_and_set_coordinator(cluster3):
+    """HTTP surface coverage for the two previously-untested routes:
+    /index/{i}/field/{f}/import-roaring/{shard} (base64 views in JSON,
+    clear= arg) and /cluster/resize/set-coordinator."""
+    import base64
+
+    from pilosa_tpu.storage.roaring import Bitmap
+
+    s0 = cluster3[0]
+    jpost(s0.uri, "/index/ir", {})
+    jpost(s0.uri, "/index/ir/field/f", {})
+    # rows 0 and 1 in shard 0 of the standard view: positions row*2^20+col
+    bm = Bitmap(np.array([5, 9, (1 << 20) + 5], dtype=np.uint64))
+    payload = {"views": {"": base64.b64encode(bm.to_bytes()).decode()}}
+    status, out = jpost(s0.uri, "/index/ir/field/f/import-roaring/0", payload)
+    assert status == 200, out
+    _, out = jpost(s0.uri, "/index/ir/query", raw=b"Row(f=0)")
+    assert out["results"][0]["columns"] == [5, 9]
+    _, out = jpost(s0.uri, "/index/ir/query", raw=b"Row(f=1)")
+    assert out["results"][0]["columns"] == [5]
+    # clear path removes presented bits only
+    clr = Bitmap(np.array([9], dtype=np.uint64))
+    status, out = jpost(
+        s0.uri, "/index/ir/field/f/import-roaring/0?clear=true",
+        {"views": {"": base64.b64encode(clr.to_bytes()).decode()}})
+    assert status == 200, out
+    _, out = jpost(s0.uri, "/index/ir/query", raw=b"Row(f=0)")
+    assert out["results"][0]["columns"] == [5]
+
+    # set-coordinator: every node must adopt the new coordinator
+    # (SetCoordinatorMessage broadcast)
+    target = cluster3[1]
+    status, out = jpost(s0.uri, "/cluster/resize/set-coordinator",
+                        {"id": target.cluster.local_id})
+    assert status == 200, out
+    assert wait_until(lambda: all(
+        s.cluster.coordinator_id == target.cluster.local_id
+        for s in cluster3))
+    # missing id is a clean 400
+    status, out = jpost(s0.uri, "/cluster/resize/set-coordinator", {})
+    assert status == 400, out
