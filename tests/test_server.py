@@ -1292,3 +1292,41 @@ def test_import_roaring_endpoint_and_set_coordinator(cluster3):
     # missing id is a clean 400
     status, out = jpost(s0.uri, "/cluster/resize/set-coordinator", {})
     assert status == 400, out
+
+
+def test_internal_fragment_views_nodes_and_shard_tombstone(server):
+    """Coverage for the three previously-untested internal routes:
+    /internal/fragment/views, /internal/fragment/nodes, and DELETE
+    /internal/.../remote-available-shards/{s}."""
+    u = server.uri
+    jpost(u, "/index/iv", {})
+    jpost(u, "/index/iv/field/f", {"options": {"type": "time",
+                                               "timeQuantum": "YMD"}})
+    status, _ = jpost(u, "/index/iv/field/f/import", {
+        "rowIDs": [1, 1], "columnIDs": [3, SHARD_WIDTH + 4],
+        "timestamps": ["2026-07-15T00:00:00Z"] * 2})
+    assert status == 200
+    status, out = http("GET", u,
+                       "/internal/fragment/views?index=iv&field=f&shard=0")
+    views = json.loads(out)["views"]
+    assert status == 200 and "standard" in views
+    assert any(v.startswith("standard_2026") for v in views), views
+    status, out = http("GET", u, "/internal/fragment/nodes?index=iv&shard=1")
+    nodes = json.loads(out)
+    assert status == 200 and len(nodes) == 1 and nodes[0]["id"]
+
+    # remote-available-shards tombstone: mark a remote shard available,
+    # then DELETE must retract it from the availability view
+    f = server.holder.index("iv").field("f")
+    f.add_available_shard(7, quiet=True)
+    assert 7 in set(f.available_shards)
+    status, _ = http("DELETE", u,
+                     "/internal/index/iv/field/f/remote-available-shards/7")
+    assert status == 200
+    assert 7 not in set(f.available_shards)
+    # shards with local data survive the retraction path
+    status, _ = http("DELETE", u,
+                     "/internal/index/iv/field/f/remote-available-shards/0")
+    assert status == 200
+    _, out = jpost(u, "/index/iv/query", raw=b"Count(Row(f=1))")
+    assert out["results"] == [2]
