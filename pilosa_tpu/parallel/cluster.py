@@ -106,7 +106,7 @@ class Cluster:
         self.nodes: list[Node] = []
         self.state = STATE_STARTING
         self.coordinator_id: Optional[str] = None
-        self._explicit_coordinator = False  # set-coordinator stickiness
+        self._explicit_claim = None  # set-coordinator stickiness
         self.schema_fn = schema_fn or (lambda: {})
         self.topology_path = topology_path
         self.cluster_id = str(uuid.uuid4())
@@ -122,14 +122,21 @@ class Cluster:
 
     def add_node(self, node: Node) -> None:
         """Insert keeping nodes sorted by ID (the ring order the jump hash
-        indexes into, cluster.go nodes ordering)."""
+        indexes into, cluster.go nodes ordering). A pending explicit
+        coordinator claim takes effect if this is the claimed node."""
         if self.node_by_id(node.id) is None:
             self.nodes.append(node)
             self.nodes.sort(key=lambda n: n.id)
+            self.elect_coordinator()
         self.save_topology()
 
     def remove_node(self, node_id: str) -> None:
         self.nodes = [n for n in self.nodes if n.id != node_id]
+        if getattr(self, "_explicit_claim", None) == node_id:
+            # explicit removal retires the operator's claim for good —
+            # unlike transient unknown-ness, which keeps it pending
+            self._explicit_claim = None
+        self.elect_coordinator()
         self.save_topology()
 
     def node_by_id(self, node_id: str) -> Optional[Node]:
@@ -143,25 +150,29 @@ class Cluster:
         return self.coordinator_id == self.local_id
 
     def adopt_coordinator(self, node_id: str) -> None:
-        """EXPLICIT adoption (set-coordinator broadcast, or a probe tick
-        syncing to the electoral authority's claim): sticky while the node
-        remains a member."""
-        self.coordinator_id = node_id
-        self._explicit_coordinator = True
+        """EXPLICIT adoption (set-coordinator broadcast, a probe tick
+        syncing to the electoral authority's claim, or a return-heal
+        re-push). The claim is sticky: it survives the claimed node being
+        momentarily UNKNOWN (a set-coordinator message can race ahead of
+        membership discovery — gossip admission, topology broadcasts) and
+        takes effect the moment the node materializes; it is dropped only
+        by explicit removal of that node or a newer adoption."""
+        self._explicit_claim = node_id
         self.elect_coordinator()
 
     def elect_coordinator(self) -> None:
-        """An explicitly-adopted coordinator is STICKY while it remains a
-        member; otherwise the deterministic default — lowest node id —
-        coordinates. Membership paths call this instead of resetting to
-        min(nodes), or an operator's choice would be undone on the next
-        tick (bootstrap self-claims from set_static are NOT explicit, so
-        they still converge to the default)."""
+        """An explicitly-claimed coordinator is STICKY while it remains (or
+        becomes) a member; otherwise the deterministic default — lowest
+        node id — coordinates. Membership paths call this instead of
+        resetting to min(nodes), or an operator's choice would be undone on
+        the next tick (bootstrap self-claims from set_static are NOT
+        explicit, so they still converge to the default)."""
         ids = {n.id for n in self.nodes}
-        if getattr(self, "_explicit_coordinator", False) \
-                and self.coordinator_id in ids:
+        claim = getattr(self, "_explicit_claim", None)
+        if claim is not None and claim in ids:
+            self.coordinator_id = claim
             return
-        self._explicit_coordinator = False
+        # claim pending (node unknown yet) or absent: deterministic default
         self.coordinator_id = min(ids) if ids else self.local_id
 
     def set_static(self, nodes: list[Node]) -> None:

@@ -599,6 +599,20 @@ class Server:
                     self.logger.printf(
                         "liveness: schema re-sync to %s failed: %s",
                         node.id, e)
+                if self.cluster._explicit_claim:
+                    # the returning node missed the set-coordinator
+                    # broadcast while down — re-push the explicit CLAIM
+                    # (heals the gossip backend too, where the probe-tick
+                    # claim convergence does not run; the receiver keeps it
+                    # pending until it knows the claimed node)
+                    try:
+                        self.client.send_message(node.uri, {
+                            "type": "set-coordinator",
+                            "id": self.cluster._explicit_claim})
+                    except ClientError as e:
+                        self.logger.printf(
+                            "liveness: coordinator re-push to %s failed: %s",
+                            node.id, e)
                 try:
                     self._sync_with_node(node.id)
                 except Exception as e:  # noqa: BLE001 — best-effort healing

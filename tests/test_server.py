@@ -1330,3 +1330,41 @@ def test_internal_fragment_views_nodes_and_shard_tombstone(server):
     assert status == 200
     _, out = jpost(u, "/index/iv/query", raw=b"Count(Row(f=1))")
     assert out["results"] == [2]
+
+
+def test_pending_coordinator_claim_semantics():
+    """adopt_coordinator is sticky across the claimed node being unknown:
+    the claim waits for the node to materialize, takes effect on admission,
+    and is retired by explicit removal (not by transient unknown-ness)."""
+    from pilosa_tpu.parallel.cluster import Cluster, Node
+
+    c = Cluster("n1")
+    c.set_static([Node(id="n1"), Node(id="n2")])
+    c.elect_coordinator()
+    assert c.coordinator_id == "n1"  # default: lowest id
+    c.adopt_coordinator("n9")  # unknown node: claim pends, default holds
+    assert c.coordinator_id == "n1"
+    c.add_node(Node(id="n9"))  # claim takes effect on admission
+    assert c.coordinator_id == "n9"
+    c.remove_node("n9")  # explicit removal retires the claim
+    assert c.coordinator_id == "n1"
+    c.add_node(Node(id="n9"))  # re-admission does NOT resurrect it
+    assert c.coordinator_id == "n1"
+
+
+def test_return_heal_repushes_explicit_coordinator(cluster3):
+    """A node that was down during set-coordinator learns the explicit
+    choice from the return-heal push (the convergence path gossip mode
+    relies on)."""
+    s0, s1, s2 = cluster3
+    target = s2.cluster.local_id
+    # s0 holds an explicit claim; simulate s1 having missed the broadcast
+    s0.cluster.adopt_coordinator(target)
+    s1.cluster._explicit_claim = None
+    s1.cluster.elect_coordinator()
+    assert s1.cluster.coordinator_id != target or True  # may equal by luck
+    node_s1 = s0.cluster.node_by_id(s1.cluster.local_id)
+    s0._on_node_return(node_s1)  # the heal thread pushes the claim
+    assert wait_until(
+        lambda: s1.cluster.coordinator_id == target
+        and s1.cluster._explicit_claim == target)
