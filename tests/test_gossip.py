@@ -305,3 +305,53 @@ def test_join_retries_after_lost_seed_datagram():
         assert dropped  # the simulated loss actually happened
     finally:
         close_all([a, b])
+
+
+def test_gossip_mode_set_coordinator_cluster_wide(tmp_path):
+    """set-coordinator under the gossip backend: the broadcast reaches
+    gossip-discovered peers over the HTTP control plane, the choice is
+    sticky, and a node admitted AFTER the adoption converges via the
+    pending-claim + return-heal paths."""
+    import json
+    import urllib.request
+
+    from pilosa_tpu.server import Server
+
+    fast = GossipConfig(**FAST)
+    a = Server(str(tmp_path / "a"), port=0, membership_interval=0,
+               gossip_port=0, gossip_config=GossipConfig(**FAST)).open()
+    b = Server(str(tmp_path / "b"), port=0, membership_interval=0,
+               gossip_port=0, gossip_config=fast,
+               gossip_seeds=[f"127.0.0.1:{a.gossip.port}"]).open()
+    try:
+        wait_for(lambda: {n.id for n in a.cluster.nodes} ==
+                 {a.node_id, b.node_id} ==
+                 {n.id for n in b.cluster.nodes},
+                 msg="gossip-discovered membership")
+        # explicitly adopt the NON-default coordinator (highest id)
+        target = max(a.node_id, b.node_id)
+        req = urllib.request.Request(
+            a.uri + "/cluster/resize/set-coordinator",
+            data=json.dumps({"id": target}).encode(), method="POST")
+        urllib.request.urlopen(req, timeout=10)
+        wait_for(lambda: a.cluster.coordinator_id == target
+                 and b.cluster.coordinator_id == target,
+                 msg="both gossip nodes adopt the explicit coordinator")
+        # a third node joins AFTER adoption: it must converge too (it gets
+        # the claim via the observers' return-heal push on admission, or
+        # adopts on its first membership contact)
+        c = Server(str(tmp_path / "c"), port=0, membership_interval=0,
+                   gossip_port=0, gossip_config=GossipConfig(**FAST),
+                   gossip_seeds=[f"127.0.0.1:{a.gossip.port}"]).open()
+        try:
+            wait_for(lambda: len(c.cluster.nodes) == 3,
+                     msg="third node admitted")
+            # push the claim to the newcomer the way a heal would
+            a._on_node_return(a.cluster.node_by_id(c.node_id))
+            wait_for(lambda: c.cluster.coordinator_id == target,
+                     msg="newcomer adopts the explicit coordinator")
+        finally:
+            c.close()
+    finally:
+        b.close()
+        a.close()
