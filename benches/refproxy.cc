@@ -526,6 +526,76 @@ int main(int argc, char** argv) {
             [&] { return rowa.intersection_count_with(rowb); }, 2.0);
   }
 
+  // bench.py groupby stage shape: two axes of 100 rows over 4 shards,
+  // 2000 bits/row; one op = the full 100x100 cross product of pairwise
+  // intersection counts — the reference's groupByIterator walks exactly
+  // this per-combination count loop (executor.go:897-1090)
+  {
+    const int n_rows = 100, n_shards = 4, per_shard = 1 << 20;
+    const int n_bits = 2000;
+    const uint64_t span = (uint64_t)n_shards * per_shard;
+    std::vector<Bitmap> g1(n_rows), g2(n_rows);
+    for (int r = 0; r < n_rows; r++) {
+      std::vector<uint64_t> v1, v2;
+      v1.reserve(n_bits);
+      v2.reserve(n_bits);
+      for (int k = 0; k < n_bits; k++) {
+        v1.push_back(rng() % span);
+        v2.push_back(rng() % span);
+      }
+      g1[r] = Bitmap::from_values(std::move(v1));
+      g2[r] = Bitmap::from_values(std::move(v2));
+    }
+    if (want("groupby_100x100_4shard"))
+      bench("groupby_100x100_4shard", [&] {
+        int64_t live = 0;
+        for (int a = 0; a < n_rows; a++)
+          for (int b = 0; b < n_rows; b++)
+            live += g1[a].intersection_count_with(g2[b]) > 0 ? 1 : 0;
+        return live;
+      }, 1.0);
+  }
+
+  // bench.py http stage shape: Count(Intersect) of 2 rows x 100k bits over
+  // 8 shards — the serving work behind one HTTP query (the Go reference's
+  // wire+parse overhead is negligible against it)
+  {
+    const int n_shards = 8, per_shard = 1 << 20, n_bits = 100000;
+    const uint64_t span = (uint64_t)n_shards * per_shard;
+    std::vector<uint64_t> va, vb2;
+    va.reserve(n_bits);
+    vb2.reserve(n_bits);
+    for (int k = 0; k < n_bits; k++) {
+      va.push_back(rng() % span);
+      vb2.push_back(rng() % span);
+    }
+    Bitmap rowa = Bitmap::from_values(std::move(va));
+    Bitmap rowb = Bitmap::from_values(std::move(vb2));
+    if (want("http_count_8shard"))
+      bench("http_count_8shard",
+            [&] { return rowa.intersection_count_with(rowb); }, 1.0);
+  }
+
+  // bench.py distributed stage shape: Count(Intersect) of 2 rows x 0.5%
+  // density over 16 shards — what each fan-out query costs the reference
+  // in kernel work before its own HTTP scatter-gather overhead
+  {
+    const int n_shards = 16, per_shard = 1 << 20;
+    const int n_bits_per_shard = per_shard / 200;
+    std::vector<uint64_t> va, vb2;
+    for (int s = 0; s < n_shards; s++) {
+      for (int k = 0; k < n_bits_per_shard; k++) {
+        va.push_back((uint64_t)s * per_shard + rng() % per_shard);
+        vb2.push_back((uint64_t)s * per_shard + rng() % per_shard);
+      }
+    }
+    Bitmap rowa = Bitmap::from_values(std::move(va));
+    Bitmap rowb = Bitmap::from_values(std::move(vb2));
+    if (want("dist_count_16shard"))
+      bench("dist_count_16shard",
+            [&] { return rowa.intersection_count_with(rowb); }, 1.0);
+  }
+
   // bench.py bsi stage shape: Sum(Range(v > thr)) over 16 shards of dense
   // BSI planes (10 bit planes + exists): range walk materializes the
   // filter row plane-by-plane (fragment.go:718-985 rangeOp GT), then the

@@ -27,34 +27,48 @@ def build() -> None:
 
 def main() -> None:
     build()
-    proc = subprocess.run([BIN] + sys.argv[1:], capture_output=True,
-                          text=True, check=True, timeout=600)
+    filters = sys.argv[1:]  # zero names = full run; N names = N filtered runs
+    stdout = ""
+    for args in ([[]] if not filters else [[f] for f in filters]):
+        proc = subprocess.run([BIN] + args, capture_output=True,
+                              text=True, check=True, timeout=600)
+        stdout += proc.stdout
     results = {}
-    if len(sys.argv) > 1:  # filtered rerun: merge over the existing file
+    prev_meta = {}
+    if filters:  # filtered rerun: merge over the existing file
         try:
             with open(OUT) as f:
-                results = json.load(f).get("results", {})
+                prev_meta = json.load(f)
+                results = prev_meta.get("results", {})
         except (OSError, ValueError):
-            pass
-    for line in proc.stdout.splitlines():
-        parts = line.split()
-        if len(parts) != 3:
-            continue
-        name, ns, ops = parts[0], float(parts[1]), int(parts[2])
-        results[name] = {"ns_per_op": ns, "ops": ops,
-                         "qps": round(1e9 / ns, 2) if ns else 0.0}
+            prev_meta = {}
     try:
         cpu = [l.split(":", 1)[1].strip()
                for l in open("/proc/cpuinfo")
                if l.startswith("model name")][0]
     except (OSError, IndexError):
         cpu = platform.processor()
+    for line in stdout.splitlines():
+        parts = line.split()
+        if len(parts) != 3:
+            continue
+        name, ns, ops = parts[0], float(parts[1]), int(parts[2])
+        results[name] = {"ns_per_op": ns, "ops": ops,
+                         "qps": round(1e9 / ns, 2) if ns else 0.0}
+        if filters and prev_meta.get("host_cpu") not in ("", None, cpu):
+            # merged entry measured on a different host than the original
+            # full run: record its provenance per-entry
+            results[name]["host_cpu"] = cpu
+    if filters and prev_meta:
+        # keep the original full-run host metadata on merges
+        cpu = prev_meta.get("host_cpu", cpu)
     out = {
         "proxy": "scalar C++ -O2 reimplementation of the reference's "
                  "roaring kernels + bench workloads (no Go toolchain in "
                  "image; see refproxy.cc header and BASELINE.md)",
         "host_cpu": cpu,
-        "host_cores": os.cpu_count(),
+        "host_cores": (prev_meta.get("host_cores") if filters and prev_meta
+                       else None) or os.cpu_count(),
         "results": results,
     }
     with open(OUT, "w") as f:

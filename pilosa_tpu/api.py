@@ -358,7 +358,12 @@ class API:
             def _epoch(t):
                 if isinstance(t, str):
                     try:
-                        t = datetime.fromisoformat(t)
+                        # Python < 3.11 fromisoformat rejects the Zulu
+                        # suffix; normalize it so "…T00:00:00Z" imports
+                        # parse on every supported interpreter
+                        t = datetime.fromisoformat(
+                            t[:-1] + "+00:00" if t[-1:] in ("Z", "z")
+                            else t)
                     except ValueError:
                         raise ApiError(f"invalid import timestamp: {t!r}")
                 if isinstance(t, datetime):

@@ -8,7 +8,12 @@ underscores (PILOSA_TPU_CLUSTER_REPLICAS, matching the reference's PILOSA_*).
 from __future__ import annotations
 
 import os
-import tomllib
+
+try:
+    import tomllib
+except ModuleNotFoundError:  # Python < 3.11: same parser under its PyPI name
+    import tomli as tomllib
+
 from dataclasses import dataclass, field
 
 from pilosa_tpu.utils.duration import parse_duration

@@ -117,6 +117,15 @@ class Executor:
         # rows materialized for TopN recounts — observability for the
         # threshold-pruning walk (tests assert ≪ total rows; /debug/vars)
         self.topn_recount_rows = 0
+        # host syncs performed by GroupBy's device path — the pipelined
+        # level loop promises at most ONE blocking fetch per cross-product
+        # level (tests assert it, like topn_recount_rows; /debug/vars)
+        self.groupby_host_syncs = 0
+        # static size bound of the on-device zero-prune transfer: a level
+        # chunk whose live combinations exceed it falls back to a full
+        # count-matrix fetch (counted as an extra sync)
+        self._groupby_live_cap = int(os.environ.get(
+            "PILOSA_TPU_GROUPBY_LIVE_BOUND", str(1 << 16)))
         # (index, field, shards) -> (cache versions, merged ids, counts):
         # the cross-shard TopN candidate merge memo, LRU-bounded so a
         # server alternating many ad-hoc shard subsets evicts the coldest
@@ -1019,21 +1028,34 @@ class Executor:
         """GroupBy(Rows(...), ..., limit=, filter=) — cross product of row
         iterators with intersection counts (executor.go:897-1090).
 
-        Device-batched redesign of the reference's per-combination iterator
-        walk: each Rows axis becomes one HBM-resident [R, S, W] slab (leaves
-        shared with every other query through the residency manager), and
-        each level of the cross product is computed in fused and+popcount
-        dispatches of at most P_CHUNK prefixes — counts[P, R] =
-        popcount(prefix ⊗ axis). Prefix slabs are never persisted: each
-        chunk's prefix is re-gathered from the component axis slabs and
-        AND-reduced inside the fused dispatch, so device memory stays
-        O(P_CHUNK · S · W) regardless of how many combinations survive.
-        Zero-count prefixes are pruned between levels (the groupByIterator
-        early-exit). Groups emit in lexicographic iterator order, so
-        `limit` matches the reference's cutoff semantics — and the final
-        level stops dispatching once `limit` nonzero groups exist."""
+        Single-program redesign of the reference's per-combination iterator
+        walk: each Rows axis becomes one HBM-resident [R, S, W] slab (built
+        once from host rows, cached by the residency manager), and each
+        level of the cross product is evaluated by the cross_count_matrix
+        kernel family — counts[P, R] = popcount(prefix ⊗ axis) fused on
+        device (ops/bitvector.py; sharded psum form in parallel/mesh.py;
+        Pallas blocked form behind PILOSA_TPU_PALLAS). Prefix slabs are
+        never persisted: each chunk's prefix is re-gathered from the
+        component axis slabs and AND-reduced inside the fused dispatch, so
+        device memory stays O(P_CHUNK · S · W) regardless of how many
+        combinations survive.
+
+        Zero-count pruning runs ON DEVICE (live_from_matrix: jnp.nonzero
+        with a static bound + true live count), and chunk dispatches
+        PIPELINE: every chunk of a level is enqueued before the first host
+        sync, then one jax.device_get fetches the whole level's compact
+        (indices, counts) batch — device compute overlaps the link RTT the
+        way parallel/batcher.py overlaps executor dispatches, and the host
+        pays at most ONE sync per level (groupby_host_syncs asserts it;
+        the rare dense chunk whose live set overflows the bound costs one
+        extra full-matrix fetch). Groups emit in lexicographic iterator
+        order, so `limit` matches the reference's cutoff semantics — and a
+        limited final level probes its lex-first chunk before fanning out
+        the rest, keeping the old early-exit's compute bound (a probe miss
+        costs one extra sync for the remaining chunks)."""
+        import jax
         import jax.numpy as jnp
-        from pilosa_tpu.ops.bitvector import intersect_count, popcount
+        from pilosa_tpu.ops.bitvector import popcount
 
         shards = self._query_shards(index, shards)
         limit = call.uint_arg("limit")
@@ -1066,26 +1088,32 @@ class Executor:
                 return GroupCounts([])
             # the stacked [R, S', W] axis slab is itself residency-cached
             # (gen-keyed like its component leaves): repeat GroupBys skip
-            # the R-operand device stack, which over a tunneled link costs
-            # more than the counting dispatches themselves
+            # the R-operand upload, which over a tunneled link costs more
+            # than the counting dispatches themselves. Built from HOST rows
+            # (the _bsi_planes pattern) so the per-row leaves don't also
+            # occupy residency budget — only the slab the kernels read is
+            # cached, in one shard-axis-sharded upload
             gens = tuple(
                 self._leaf_gens(index, fname, VIEW_STANDARD, shards, rid)
                 for rid in row_ids)
             slab = self.residency.leaf(
                 ("rows_slab", index.name, fname, VIEW_STANDARD,
                  tuple(shards), tuple(row_ids), gens),
-                lambda f=fname, rids=row_ids, g=gens: jnp.stack([
-                    self._row_leaf_dev(index, f, VIEW_STANDARD, shards,
-                                       rid, gens=gi)
-                    for rid, gi in zip(rids, g)]))
+                lambda f=fname, rids=row_ids: self.runner.put_plane_slab(
+                    np.stack([
+                        np.stack([self._cached_row(index, f, VIEW_STANDARD,
+                                                   s, rid)
+                                  for s in shards])
+                        for rid in rids])))
             axes.append((fname, row_ids, slab))
 
         # prefixes per dispatch: the [chunk, R, S, W] intermediate is fused
         # into the popcount reduction (never hits HBM), so chunking is
         # bounded by per-dispatch COMPUTE (~2^31 words = ~8.6 GB of fused
-        # and+popcount, ~15 ms at the measured stream rate) — each dispatch
-        # round trip costs more than that on a tunneled link, so bigger
-        # chunks are strictly faster until the abort granularity suffers
+        # and+popcount, ~15 ms at the measured stream rate). Dispatches are
+        # asynchronous — all of a level's chunks enqueue before its one
+        # host sync — so chunk size only sets abort granularity and the
+        # peak size of the fused working set, not the number of RTTs
         def chunk_for(slab) -> int:
             r, s, w = slab.shape
             return int(min(512, max(16, (1 << 31) // max(1, r * s * w))))
@@ -1097,19 +1125,13 @@ class Executor:
             slab0 = jnp.bitwise_and(slab0, filter_dev[None])
         axis_slabs = [slab0] + [a[2] for a in axes[1:]]
 
-        def prefix_chunk(comb, li, st, en):
-            """Re-gather + AND-reduce the [st:en] prefix slabs from their
-            component axes (fused by XLA with the downstream count)."""
-            pref = axis_slabs[0][comb[0][st:en]]
-            for a in range(1, li):
-                pref = jnp.bitwise_and(pref, axis_slabs[a][comb[a][st:en]])
-            return pref  # [chunk, S, W]
-
         # comb: one index array per axis consumed so far; row-major order of
         # the arrays IS the reference's lexicographic iterator order
         comb = [np.arange(len(rows0))]
         if len(axes) == 1:
-            counts = np.asarray(popcount(slab0).sum(axis=-1))  # [R0]
+            # one fused dispatch + one fetch of the [R0] count vector
+            counts = np.asarray(jnp.sum(popcount(slab0), axis=-1))
+            self.groupby_host_syncs += 1
             live = np.nonzero(counts)[0]
             comb, counts = [live], counts[live]
         else:
@@ -1117,24 +1139,76 @@ class Executor:
             for li in range(1, len(axes)):
                 _, row_ids, slab = axes[li]
                 last = li == len(axes) - 1
+                limited_last = last and limit is not None
                 P, R = len(comb[0]), len(row_ids)
                 p_chunk = chunk_for(slab)
+                bound = max(1, min(p_chunk * R, self._groupby_live_cap))
+                if limited_last:
+                    # the result is a lexicographic prefix, so no chunk
+                    # ever contributes more than `limit` groups — capping
+                    # the prune transfer also makes an over-`bound` live
+                    # set harmless (no refetch: the lex-first `bound`
+                    # entries are all that can be reported)
+                    bound = max(1, min(bound, limit))
+
+                def dispatch(st, li=li, slab=slab, bound=bound):
+                    """One async chunk dispatch — index arrays are padded
+                    to a static chunk shape (one XLA program per level),
+                    padding rows masked by n_valid inside the kernel."""
+                    en = min(st + p_chunk, P)
+                    idx = tuple(jnp.asarray(np.ascontiguousarray(np.pad(
+                        ci[st:en], (0, p_chunk - (en - st))).astype(
+                            np.int32))) for ci in comb)
+                    return (st, idx, self.runner.groupby_chunk(
+                        axis_slabs[:li], idx, slab, jnp.int32(en - st),
+                        bound))
+
+                starts = list(range(0, P, p_chunk))
+                # an unlimited level enqueues EVERY chunk before its one
+                # batched fetch. A limited FINAL level probes its first
+                # chunk alone: the lex-first chunk usually satisfies
+                # `limit`, preserving the early-exit's compute bound at
+                # one sync — only a miss pays a second sync for the rest
+                waves = [starts[:1], starts[1:]] if limited_last else \
+                    [starts]
                 live_p_parts, live_r_parts, count_parts = [], [], []
                 found = 0
-                for st in range(0, P, p_chunk):
-                    qctx.check()  # abort between dispatches
-                    en = min(st + p_chunk, P)
-                    c = intersect_count(
-                        prefix_chunk(comb, li, st, en)[:, None],
-                        slab[None])                     # [chunk, R, S]
-                    cmat = np.asarray(c.sum(axis=-1))   # [chunk, R]
-                    lp, lr = np.nonzero(cmat)
-                    live_p_parts.append(lp + st)
-                    live_r_parts.append(lr)
-                    count_parts.append(cmat[lp, lr])
-                    found += lp.size
-                    if last and limit is not None and found >= limit:
-                        break  # lex order: later chunks can't precede these
+                for wave in waves:
+                    if not wave or (limited_last and found >= limit):
+                        continue
+                    pending = []
+                    for st in wave:
+                        qctx.check()  # abort between dispatches (no sync)
+                        pending.append(dispatch(st))
+                    # the wave's single host sync: one batched fetch of
+                    # every chunk's (n_live, flat indices, counts) triple
+                    fetched = jax.device_get([o for (_, _, o) in pending])
+                    self.groupby_host_syncs += 1
+                    for (st, idx, _), (n_live, flat_idx, cvals) in zip(
+                            pending, fetched):
+                        n_live = int(n_live)
+                        if n_live > bound and not (limited_last
+                                                   and bound >= limit):
+                            # dense chunk overflowed the prune bound:
+                            # refetch its full count matrix (extra sync,
+                            # counted; no group is ever silently dropped)
+                            cmat = np.asarray(self.runner.groupby_cmat(
+                                axis_slabs[:li], idx, slab,
+                                jnp.int32(min(st + p_chunk, P) - st)))
+                            self.groupby_host_syncs += 1
+                            lp, lr = np.nonzero(cmat)
+                            cv = cmat[lp, lr]
+                        else:
+                            k = min(n_live, bound)
+                            fi = flat_idx[:k].astype(np.int64)
+                            lp, lr = fi // R, fi % R
+                            cv = cvals[:k]
+                        live_p_parts.append(lp.astype(np.int64) + st)
+                        live_r_parts.append(lr.astype(np.int64))
+                        count_parts.append(cv.astype(np.int64))
+                        found += lp.size
+                        if limited_last and found >= limit:
+                            break  # lex order: nothing later can precede
                 live_p = np.concatenate(live_p_parts) if live_p_parts else \
                     np.empty(0, dtype=np.int64)
                 live_r = np.concatenate(live_r_parts) if live_r_parts else \

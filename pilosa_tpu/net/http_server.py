@@ -370,6 +370,7 @@ class Handler:
             if residency is not None:
                 snap["deviceResidency"] = residency.snapshot()
             snap["topnRecountRows"] = getattr(ex, "topn_recount_rows", 0)
+            snap["groupByHostSyncs"] = getattr(ex, "groupby_host_syncs", 0)
             batcher = getattr(ex, "batcher", None)
             if batcher is not None:
                 snap["countBatcher"] = batcher.snapshot()

@@ -13,9 +13,11 @@ from pilosa_tpu.ops.bitvector import (  # noqa: F401
     bor,
     bxor,
     columns_from_dense,
+    cross_count_matrix,
     dense_from_columns,
     difference_count,
     intersect_count,
+    live_from_matrix,
     popcount,
     row_popcounts,
     union_count,

@@ -116,6 +116,96 @@ def row_popcounts(rows: jax.Array) -> jax.Array:
 
 
 # ---------------------------------------------------------------------------
+# GroupBy cross-count primitives: one fused dispatch evaluates a whole
+# [prefixes x axis-rows] level of the cross product and prunes zero
+# combinations ON DEVICE, so the host sees one small (indices, counts)
+# transfer per level instead of a count matrix per chunk. This is the
+# batched-popcount insight of the CPU bitmap literature (Chambi et al.,
+# Roaring; Muła/Kurz/Lemire AVX2 popcount) lifted to the slab layout: the
+# reference walks the cross product one combination at a time
+# (executor.go:897-1090 groupByIterator); here a level is a single
+# vectorized counts[P, R] = popcount(prefix ⊗ axis) pass.
+# ---------------------------------------------------------------------------
+
+
+@jax.jit
+def cross_count_matrix(prefix: jax.Array, axis: jax.Array) -> jax.Array:
+    """counts[P, R]: intersection popcounts of every (prefix, axis-row) pair.
+
+    prefix [P, S, W] x axis [R, S, W] -> int32 [P, R], reduced over shards
+    and words. The [P, R, S, W] broadcast-AND fuses into the popcount
+    reduction (XLA loop fusion — it never materializes in HBM); callers
+    bound P·R·S·W per dispatch (the executor's chunk sizing)."""
+    return jnp.sum(intersect_count(prefix[:, None], axis[None]), axis=-1)
+
+
+def gather_prefix(axis_slabs, idx) -> jax.Array:
+    """AND-reduce the prefix rows [chunk, S, W] gathered per-axis from the
+    resident axis slabs — traced inside the chunk dispatch so the gathers
+    and the reduction fuse with the downstream cross count."""
+    pref = axis_slabs[0][idx[0]]
+    for k in range(1, len(idx)):
+        pref = jnp.bitwise_and(pref, axis_slabs[k][idx[k]])
+    return pref
+
+
+def mask_prefix_rows(cmat: jax.Array, n_valid: jax.Array) -> jax.Array:
+    """Zero count-matrix rows past n_valid: chunks are padded to a static
+    prefix count (one compile per level), and a padding row gathers row 0's
+    data — its counts must not surface as live combinations."""
+    rows = lax.broadcasted_iota(jnp.int32, cmat.shape, 0)
+    return jnp.where(rows < n_valid, cmat, 0)
+
+
+@functools.partial(jax.jit, static_argnames=("bound",))
+def live_from_matrix(cmat: jax.Array, bound: int):
+    """On-device zero-count pruning: (n_live, flat_idx[bound], counts[bound]).
+
+    flat_idx ascends over the row-major flattening of cmat — exactly the
+    reference's lexicographic iterator order — with entries past the real
+    live count filled by the out-of-range sentinel P·R (counts 0). n_live
+    is the TRUE number of nonzero combinations: when it exceeds `bound`
+    the caller must refetch the full matrix (the static bound keeps the
+    per-level transfer small without ever silently dropping groups)."""
+    flat = cmat.reshape(-1)
+    n = flat.shape[0]
+    n_live = jnp.sum((flat != 0).astype(jnp.int32))
+    (idx,) = jnp.nonzero(flat, size=bound, fill_value=n)
+    counts = jnp.where(idx < n, flat[jnp.minimum(idx, n - 1)], 0)
+    return n_live, idx.astype(jnp.int32), counts
+
+
+def chunk_count_matrix(axis_slabs, idx, axis, n_valid,
+                       cross_fn=None) -> jax.Array:
+    """The ONE chunk composition every GroupBy variant traces: gather + AND
+    the prefix slab from the component axes, cross-count against the
+    level's axis slab, mask padding rows. `cross_fn` swaps the matrix
+    kernel (None = the fused XLA form; the Pallas blocked form plugs in
+    here), so the XLA, Pallas, and mesh paths cannot drift apart."""
+    fn = cross_count_matrix if cross_fn is None else cross_fn
+    return mask_prefix_rows(fn(gather_prefix(axis_slabs, idx), axis),
+                            n_valid)
+
+
+@functools.partial(jax.jit, static_argnames=("bound", "cross_fn"))
+def groupby_chunk_live(axis_slabs: tuple, idx: tuple, axis: jax.Array,
+                       n_valid: jax.Array, bound: int, cross_fn=None):
+    """One pipelined GroupBy level chunk, fully on device: the chunk
+    composition plus the zero-prune. Returns device arrays only — the
+    executor enqueues every chunk of a level before its single host sync."""
+    cmat = chunk_count_matrix(axis_slabs, idx, axis, n_valid, cross_fn)
+    return live_from_matrix(cmat, bound)
+
+
+@functools.partial(jax.jit, static_argnames=("cross_fn",))
+def groupby_chunk_matrix(axis_slabs: tuple, idx: tuple, axis: jax.Array,
+                         n_valid: jax.Array, cross_fn=None) -> jax.Array:
+    """Dense [chunk, R] count matrix for one chunk — the overflow fallback
+    when a chunk's live combinations exceed the pruning bound."""
+    return chunk_count_matrix(axis_slabs, idx, axis, n_valid, cross_fn)
+
+
+# ---------------------------------------------------------------------------
 # Range mutations, used by row-level writes and Not/flip semantics
 # (reference: bitmapSetRange/bitmapZeroRange/bitmapXorRange
 # roaring/roaring.go:2685-2771). Implemented as masked bitwise ops built from

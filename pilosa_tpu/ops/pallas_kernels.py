@@ -139,6 +139,78 @@ def program_count(leaves, program) -> jax.Array:
     return padded[:s, 0]
 
 
+# -- GroupBy cross-count matrix ----------------------------------------------
+# counts[P, R] = popcount(prefix[p] & axis[r]) summed over all words. The
+# XLA form relies on loop fusion to keep the [P, R, W] intermediate out of
+# HBM; this kernel makes the blocking explicit: one (8-prefix, 128-row,
+# 512-word) tile triple per grid step, the [8, 128, 512] AND+popcount in
+# VMEM (~2 MiB), partial [8, 128] counts accumulated in the revisited
+# output block across the word grid axis (innermost, so the accumulator
+# stays pinned while operand tiles stream HBM->VMEM double-buffered).
+
+CC_P_BLK = 8     # prefix tile: int32 sublane minimum
+CC_R_BLK = 128   # axis-row tile: int32 lane width
+CC_W_BLK = 512   # word tile per step (a: 16 KiB, b: 256 KiB in VMEM)
+
+
+def _cross_count_kernel(a_ref, b_ref, out_ref):
+    wb = pl.program_id(2)
+    a, b = a_ref[...], b_ref[...]
+    inter = jnp.bitwise_and(a[:, None, :], b[None, :, :])
+    partial = jnp.sum(jax.lax.population_count(inter).astype(jnp.int32),
+                      axis=-1)  # [CC_P_BLK, CC_R_BLK]
+
+    @pl.when(wb == 0)
+    def _init():
+        out_ref[...] = jnp.zeros_like(out_ref)
+
+    out_ref[...] += partial
+
+
+def _pad_axis_to(x: jax.Array, axis: int, mult: int) -> jax.Array:
+    pad = (-x.shape[axis]) % mult
+    if pad == 0:
+        return x
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(x, widths)
+
+
+@jax.jit
+def cross_count_matrix(prefix: jax.Array, axis: jax.Array) -> jax.Array:
+    """prefix [P, ..., W] x axis [R, ..., W] -> int32[P, R] cross-count
+    matrix (leading axes flattened into the word axis). The Pallas form of
+    bitvector.cross_count_matrix, selected by PILOSA_TPU_PALLAS; parity is
+    tested in tests/test_pallas.py. Zero padding (prefixes to 8, rows to
+    128, words to 512) is sliced off the result; padded words AND to zero
+    so they never contribute counts."""
+    p = prefix.reshape(prefix.shape[0], -1)
+    r = axis.reshape(axis.shape[0], -1)
+    np_, nr = p.shape[0], r.shape[0]
+    p = _pad_axis_to(_pad_axis_to(p, 0, CC_P_BLK), 1, CC_W_BLK)
+    r = _pad_axis_to(_pad_axis_to(r, 0, CC_R_BLK), 1, CC_W_BLK)
+    pp, wt = p.shape
+    rp = r.shape[0]
+    out = pl.pallas_call(
+        _cross_count_kernel,
+        grid=(pp // CC_P_BLK, rp // CC_R_BLK, wt // CC_W_BLK),
+        in_specs=[
+            pl.BlockSpec((CC_P_BLK, CC_W_BLK), lambda i, j, k: (i, k)),
+            pl.BlockSpec((CC_R_BLK, CC_W_BLK), lambda i, j, k: (j, k)),
+        ],
+        out_specs=pl.BlockSpec((CC_P_BLK, CC_R_BLK), lambda i, j, k: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((pp, rp), jnp.int32),
+        interpret=_interpret(),
+    )(p, r)
+    return out[:np_, :nr]
+
+
+# The GroupBy chunk pipeline itself (gather + cross count + mask + prune)
+# lives ONCE in bitvector.chunk_count_matrix / groupby_chunk_live; this
+# kernel plugs in as their `cross_fn` so the Pallas path can never drift
+# from the XLA contract.
+
+
 def _pair_stream_kernel(ii_ref, jj_ref, a_ref, b_ref, out_ref):
     """One (query, shard-block) grid step of the Count(Intersect) stream:
     the scalar-prefetched ii/jj pick which rows' blocks the pipeline DMAs

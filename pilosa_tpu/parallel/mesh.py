@@ -25,7 +25,13 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from pilosa_tpu.ops.bitvector import popcount
+from pilosa_tpu.ops.bitvector import (
+    chunk_count_matrix,
+    groupby_chunk_live,
+    groupby_chunk_matrix,
+    live_from_matrix,
+    popcount,
+)
 
 SHARD_AXIS = "shard"
 REPLICA_AXIS = "replica"
@@ -292,6 +298,61 @@ def pair_stream_counts(mesh: Mesh, rows: jax.Array, ii: np.ndarray,
     return out[:k]
 
 
+# -- GroupBy cross-count mesh form -------------------------------------------
+# Per-device partial count matrices over the local shard slice, one psum
+# over the shard axis — the [P, R, S] intermediate never crosses devices
+# and the zero-prune runs on the replicated [P, R] result. The replica
+# axis (if any) holds full data copies, so every replica computes the same
+# matrix (same pattern as _program_count_mesh_fn).
+
+
+@functools.lru_cache(maxsize=None)
+def _groupby_cmat_mesh_fn(mesh: Mesh, n_axes: int, use_pallas: bool):
+    from jax.experimental.shard_map import shard_map
+
+    cross_fn = _pallas_cross_fn() if use_pallas else None
+    slab_spec = P(None, SHARD_AXIS, None)
+
+    @jax.jit
+    @functools.partial(
+        shard_map, mesh=mesh,
+        in_specs=(tuple(slab_spec for _ in range(n_axes)),
+                  tuple(P() for _ in range(n_axes)), slab_spec, P()),
+        out_specs=P(), check_rep=False)
+    def run(axis_slabs, idx, axis, n_valid):
+        # the shared chunk composition on the local shard slice (masked
+        # padding rows are zero on every device, so masking commutes with
+        # the psum), then one ICI all-reduce over the shard axis
+        local = chunk_count_matrix(axis_slabs, idx, axis, n_valid, cross_fn)
+        return jax.lax.psum(local, SHARD_AXIS)
+
+    return run
+
+
+def _pallas_cross_fn():
+    from pilosa_tpu.ops.pallas_kernels import cross_count_matrix
+
+    return cross_count_matrix
+
+
+def groupby_chunk_live_mesh(mesh: Mesh, axis_slabs: tuple, idx: tuple,
+                            axis: jax.Array, n_valid, bound: int,
+                            use_pallas: bool = False):
+    """Sharded groupby_chunk_live: per-device partial [P, R] counts, one
+    ICI psum, on-device prune. Returns device arrays — no host sync."""
+    cmat = _groupby_cmat_mesh_fn(mesh, len(idx), use_pallas)(
+        tuple(axis_slabs), tuple(idx), axis, n_valid)
+    return live_from_matrix(cmat, bound)
+
+
+def groupby_chunk_matrix_mesh(mesh: Mesh, axis_slabs: tuple, idx: tuple,
+                              axis: jax.Array, n_valid,
+                              use_pallas: bool = False) -> jax.Array:
+    """Dense mesh count matrix — the overflow fallback's sharded form."""
+    return _groupby_cmat_mesh_fn(mesh, len(idx), use_pallas)(
+        tuple(axis_slabs), tuple(idx), axis, n_valid)
+
+
 class DeviceRunner:
     """Executes shard-slab programs, optionally over a mesh.
 
@@ -390,3 +451,27 @@ class DeviceRunner:
                                               program))
             return int(jnp.sum(program_count(tuple(leaves), program)))
         return int(eval_count_total(tuple(leaves), program))
+
+    # -- GroupBy cross-count dispatch (XLA / Pallas / mesh routing) --------
+
+    def groupby_chunk(self, axis_slabs, idx, axis, n_valid, bound: int):
+        """(n_live, flat_idx[bound], counts[bound]) device arrays for one
+        level chunk — dispatched asynchronously so the executor can enqueue
+        every chunk of a level before its single host sync."""
+        axis_slabs, idx = tuple(axis_slabs), tuple(idx)
+        if self.mesh is not None:
+            return groupby_chunk_live_mesh(self.mesh, axis_slabs, idx, axis,
+                                           n_valid, bound, self.use_pallas)
+        cross_fn = _pallas_cross_fn() if self.use_pallas else None
+        return groupby_chunk_live(axis_slabs, idx, axis, n_valid, bound,
+                                  cross_fn)
+
+    def groupby_cmat(self, axis_slabs, idx, axis, n_valid) -> jax.Array:
+        """Dense [chunk, R] count matrix (device array) — the fallback when
+        a chunk's live set overflows the static pruning bound."""
+        axis_slabs, idx = tuple(axis_slabs), tuple(idx)
+        if self.mesh is not None:
+            return groupby_chunk_matrix_mesh(self.mesh, axis_slabs, idx,
+                                             axis, n_valid, self.use_pallas)
+        cross_fn = _pallas_cross_fn() if self.use_pallas else None
+        return groupby_chunk_matrix(axis_slabs, idx, axis, n_valid, cross_fn)

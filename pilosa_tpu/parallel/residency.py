@@ -89,6 +89,16 @@ class DeviceResidency:
 
     def snapshot(self) -> dict:
         with self._lock:
+            # per-kind occupancy (key[0] is the leaf kind: "row", "bsicmp",
+            # "bsiplanes", "rows_slab", ...): GroupBy axis slabs are the
+            # largest residents, so operators diagnosing eviction churn or
+            # cold GroupBy p50s need to see what actually holds the budget
+            by_kind: dict = {}
+            for key, arr in self._lru.items():
+                kind = str(key[0]) if isinstance(key, tuple) and key else "?"
+                k = by_kind.setdefault(kind, {"entries": 0, "bytes": 0})
+                k["entries"] += 1
+                k["bytes"] += arr.nbytes
             return {"entries": len(self._lru), "bytes": self.bytes,
                     "hits": self.hits, "misses": self.misses,
-                    "evictions": self.evictions}
+                    "evictions": self.evictions, "by_kind": by_kind}

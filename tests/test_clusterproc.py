@@ -244,7 +244,14 @@ def test_gossip_cluster_sigstop_liveness(tmp_path):
     /status probes: SIGSTOP -> no UDP acks -> suspect -> dead -> cluster
     DEGRADED; SIGCONT -> acks -> alive -> NORMAL. Asserts the optional
     backend drives the same mark_down/mark_up plumbing end to end across
-    process boundaries (gossip/gossip.go:488-519 analog)."""
+    process boundaries (gossip/gossip.go:488-519 analog).
+
+    Load-deflaked (the commit-78793c6 recipe, VERDICT r5 weak #5): the
+    SWIM clock is widened — a loaded-but-alive node gets 0.6 s (not
+    0.15 s) to ack before suspicion, so host contention can't mark a
+    healthy peer down and flap the cluster state mid-assert — and every
+    cross-process observation polls until convergence with generous
+    deadlines instead of asserting a single snapshot."""
     ports = free_ports(3)
     gports = free_ports(3)
     hosts = ", ".join(f'"http://127.0.0.1:{p}"' for p in ports)
@@ -263,9 +270,11 @@ def test_gossip_cluster_sigstop_liveness(tmp_path):
                 "[gossip]\n"
                 f"port = {gports[i]}\n"
                 f'seeds = ["127.0.0.1:{gports[0]}"]\n'
-                "period = 0.1\n"
-                "probe-timeout = 0.15\n"
-                "push-pull-interval = 0.5\n"
+                # widened suspicion tolerance: 0.1/0.15 s false-suspected
+                # healthy-but-slow peers under CPU contention (load flake)
+                "period = 0.25\n"
+                "probe-timeout = 0.6\n"
+                "push-pull-interval = 1.0\n"
                 "[mesh]\n"
                 'devices = "none"\n'
                 'platform = "cpu"\n')
@@ -289,15 +298,21 @@ def test_gossip_cluster_sigstop_liveness(tmp_path):
         os.kill(procs[2].pid, signal.SIGSTOP)
         assert wait_until(
             lambda: cluster_state(p0) == "DEGRADED"
-            and cluster_state(p1) == "DEGRADED", 45.0), \
+            and cluster_state(p1) == "DEGRADED", 90.0), \
             "gossip never marked the SIGSTOP'd node down"
-        # queries still answer while DEGRADED (placement routes around)
-        _, out = http("POST", p0, "/index/gi/query", b"Count(Row(f=5))")
-        assert out["results"] == [1]
+
+        # queries still answer while DEGRADED (placement routes around);
+        # poll — routing tables converge asynchronously with the state flip
+        def degraded_query_ok():
+            _, out = http("POST", p0, "/index/gi/query", b"Count(Row(f=5))")
+            return out["results"] == [1]
+
+        assert wait_until(degraded_query_ok, 30.0), \
+            "DEGRADED cluster never served the routed-around query"
         os.kill(procs[2].pid, signal.SIGCONT)
         assert wait_until(
             lambda: cluster_state(p0) == "NORMAL"
-            and cluster_state(p1) == "NORMAL", 30.0), \
+            and cluster_state(p1) == "NORMAL", 60.0), \
             "gossip never revived the resumed node"
     finally:
         for p in procs:

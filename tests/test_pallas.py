@@ -54,6 +54,52 @@ def test_pair_stream_counts_matches_numpy():
         np.testing.assert_array_equal(got, expect)
 
 
+def test_cross_count_matrix_matches_numpy():
+    """Blocked GroupBy cross-count kernel: counts[P, R] over ragged shapes
+    that force prefix/row/word padding in every combination."""
+    for p, r, w in ((1, 1, 512), (5, 7, 512), (8, 128, 1024), (9, 130, 512)):
+        a = RNG.integers(0, 2**32, size=(p, w), dtype=np.uint32)
+        b = RNG.integers(0, 2**32, size=(r, w), dtype=np.uint32)
+        got = np.asarray(pk.cross_count_matrix(a, b))
+        expect = np.bitwise_count(
+            a[:, None, :] & b[None, :, :]).sum(axis=-1).astype(np.int32)
+        np.testing.assert_array_equal(got, expect)
+
+
+def test_cross_count_matrix_parity_with_xla():
+    """PILOSA_TPU_PALLAS routes GroupBy levels through this kernel; it must
+    agree with the XLA fused form on [*, S, W] slab operands."""
+    from pilosa_tpu.ops.bitvector import cross_count_matrix as xla_ccm
+
+    pref = RNG.integers(0, 2**32, size=(6, 3, 512), dtype=np.uint32)
+    axis = RNG.integers(0, 2**32, size=(9, 3, 512), dtype=np.uint32)
+    np.testing.assert_array_equal(np.asarray(pk.cross_count_matrix(pref, axis)),
+                                  np.asarray(xla_ccm(pref, axis)))
+
+
+def test_groupby_chunk_live_parity():
+    """Full chunk contract (gather + AND + cross count + on-device prune):
+    the shared composition with the Pallas kernel plugged in as cross_fn
+    returns identical (n_live, indices, counts) to the XLA form."""
+    import jax
+    import jax.numpy as jnp
+
+    from pilosa_tpu.ops import bitvector as bv
+
+    slab_a = jnp.asarray(
+        RNG.integers(0, 2**32, size=(5, 2, 512), dtype=np.uint32))
+    slab_b = jnp.asarray(
+        RNG.integers(0, 2**32, size=(4, 2, 512), dtype=np.uint32))
+    idx = (jnp.asarray(np.array([0, 3, 4, 0], dtype=np.int32)),
+           jnp.asarray(np.array([2, 0, 1, 0], dtype=np.int32)))
+    args = ((slab_a, slab_b), idx, slab_b, jnp.int32(3), 32)
+    got = jax.device_get(
+        bv.groupby_chunk_live(*args, cross_fn=pk.cross_count_matrix))
+    expect = jax.device_get(bv.groupby_chunk_live(*args))
+    for g, e in zip(got, expect):
+        np.testing.assert_array_equal(g, e)
+
+
 def test_available():
     assert pk.available()
 
@@ -81,6 +127,73 @@ def test_program_count_mesh_parity(replicas):
     assert got == expect
     # and parity with the XLA mesh path on the same device arrays
     assert got == int(eval_count_total(tuple(leaves), program))
+
+
+@pytest.mark.parametrize("replicas", [1, 2])
+def test_groupby_chunk_mesh_pallas_parity(replicas):
+    """GroupBy level chunks under PILOSA_TPU_PALLAS on a mesh: the blocked
+    kernel runs per-device inside shard_map with an ICI psum over the shard
+    axis, and must agree with the XLA mesh path and a numpy oracle."""
+    import jax
+    import jax.numpy as jnp
+
+    from pilosa_tpu.parallel.mesh import DeviceRunner, make_mesh
+
+    mesh = make_mesh(replicas=replicas)
+    xla = DeviceRunner(mesh, use_pallas=False)
+    pallas = DeviceRunner(mesh, use_pallas=True)
+    assert pallas.use_pallas
+    rng = np.random.default_rng(23)
+    host_a = rng.integers(0, 2**32, size=(6, 4, 512), dtype=np.uint32)
+    host_b = rng.integers(0, 2**32, size=(5, 4, 512), dtype=np.uint32)
+    idx = (jnp.asarray(np.array([0, 2, 5, 0], dtype=np.int32)),)
+    n_valid, bound = jnp.int32(3), 30
+    outs = []
+    for runner in (xla, pallas):
+        slab_a = runner.put_plane_slab(host_a)
+        slab_b = runner.put_plane_slab(host_b)
+        outs.append(jax.device_get(runner.groupby_chunk(
+            (slab_a,), idx, slab_b, n_valid, bound)))
+    for g, e in zip(outs[0], outs[1]):
+        np.testing.assert_array_equal(g, e)
+    cmat = np.bitwise_count(
+        host_a[np.asarray(idx[0][:3])][:, None] & host_b[None]).reshape(
+            3, 5, -1).sum(axis=-1)
+    lp, lr = np.nonzero(cmat)
+    n_live, flat_idx, counts = outs[1]
+    assert int(n_live) == lp.size
+    np.testing.assert_array_equal(flat_idx[:lp.size] // 5, lp)
+    np.testing.assert_array_equal(counts[:lp.size], cmat[lp, lr])
+
+
+def test_executor_groupby_pallas_parity(tmp_path):
+    """End to end: PILOSA_TPU_PALLAS GroupBy through the executor matches
+    the XLA path's groups, still at one host sync per level."""
+    from pilosa_tpu.executor import Executor
+    from pilosa_tpu.models import Holder
+    from pilosa_tpu.parallel.mesh import DeviceRunner
+
+    rng = np.random.default_rng(27)
+    results = {}
+    for mode, use_pallas in (("xla", False), ("pallas", True)):
+        h = Holder(str(tmp_path / mode)).open()
+        ex = Executor(h, runner=DeviceRunner(use_pallas=use_pallas))
+        idx = h.create_index("gp", track_existence=False)
+        rng = np.random.default_rng(27)  # identical data both runs
+        for fname in ("a", "b"):
+            f = idx.create_field(fname)
+            rids, cids = [], []
+            for r in range(8):
+                cols = rng.choice(2000, size=120, replace=False)
+                rids += [r] * len(cols)
+                cids += [int(c) for c in cols]
+            f.import_bits(rids, cids)
+        before = ex.groupby_host_syncs
+        (groups,) = ex.execute("gp", "GroupBy(Rows(field=a), Rows(field=b))")
+        assert ex.groupby_host_syncs - before == 1
+        results[mode] = list(groups)
+        h.close()
+    assert results["pallas"] == results["xla"]
 
 
 @pytest.mark.parametrize("replicas", [1, 2])
