@@ -817,24 +817,71 @@ def bench_http(tmpdir) -> dict:
 
 
 DIST_SHARDS = 16
+DIST_NODES = int(os.environ.get("PILOSA_BENCH_DIST_NODES", "3"))
 DIST_THREADS = 8
 DIST_THREADS_PEAK = int(os.environ.get("PILOSA_BENCH_DIST_THREADS_PEAK", "64"))
 DIST_QUERIES = 96
+# coalescing A/B: fixed concurrency + interleaved on/off rounds (the
+# shared bench host drifts; per-round ratios are the honest signal)
+DIST_AB_THREADS = int(os.environ.get("PILOSA_BENCH_DIST_AB_THREADS", "32"))
+DIST_AB_ROUNDS = int(os.environ.get("PILOSA_BENCH_DIST_AB_ROUNDS", "5"))
+DIST_SWEEP = [1, 4, 8, 16, 32, 64]
+
+
+def _keepalive_qps(host: str, path: str, body: bytes, check,
+                   clients: int, per_thread: int) -> float:
+    """Closed-loop QPS with one persistent HTTP connection per client —
+    measures the server, not urllib's per-request reconnect churn (the
+    sweep/A-B companion to the urllib-based headline, whose methodology
+    is kept for round-over-round continuity)."""
+    import http.client
+    import threading
+
+    errors = []
+
+    def client(tid):
+        conn = http.client.HTTPConnection(host, timeout=60)
+        try:
+            for _ in range(per_thread):
+                conn.request("POST", path, body=body)
+                resp = conn.getresponse()
+                out = json.loads(resp.read())
+                check(out)
+        except Exception as e:  # noqa: BLE001 — surface the first error
+            errors.append(e)
+        finally:
+            conn.close()
+
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(clients)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    elapsed = time.perf_counter() - t0
+    if errors:
+        raise errors[0]
+    return clients * per_thread / elapsed
 
 
 def bench_distributed(tmpdir) -> dict:
-    """Config 5: distributed Intersect+Count over a 2-node cluster — the
+    """Config 5: distributed Intersect+Count over a 3-node cluster — the
     mapReduce fan-out path (executor.go:2183 analog): node 0 executes its
-    own shards locally (device) and scatter-gathers the rest from node 1
-    over HTTP/JSON, merging per-shard counts. Both in-process nodes share
-    the one real chip; the measured delta vs the single-node executor
-    number is the fan-out + wire + remote-re-parse overhead."""
+    own shards locally (device) and scatter-gathers the rest from nodes
+    1..N over the coalesced /internal/query-batch envelope (net/coalesce),
+    merging per-shard counts. All in-process nodes share the one real
+    chip; the measured delta vs the single-node executor number is the
+    fan-out + wire + remote-re-parse overhead. Grew from 2 to 3 nodes in
+    the coalescing round: with one remote node the coordinator's own
+    HTTP+execute cost dominates and the A/B understates the wire effect
+    every additional node multiplies."""
     import urllib.request
 
     from pilosa_tpu.server import Server
 
     servers = [Server(os.path.join(tmpdir, f"dn{i}"), port=0).open()
-               for i in range(2)]
+               for i in range(DIST_NODES)]
     try:
         uris = [s.uri for s in servers]
         for s in servers:
@@ -871,38 +918,109 @@ def bench_distributed(tmpdir) -> dict:
             np.intersect1d(sets[(0, s)], sets[(1, s)]).size
             for s in range(DIST_SHARDS))
         assert out["results"][0] == expect, (out, expect)
-        # both nodes must answer identically (remote re-parse path). Node 1
-        # learns of shards it doesn't host via the async create-shard
+        # every node must answer identically (remote re-parse path). Peers
+        # learn of shards they don't host via the async create-shard
         # announcements, so poll briefly for convergence (the same eventual
         # visibility the cluster tests assert; the import coordinator —
         # node 0, asserted above — is always immediately correct)
         deadline = time.monotonic() + 30
-        while True:
-            out1 = post(uris[1], "/index/d/query", q)
-            if out1["results"][0] == expect:
-                break
-            assert time.monotonic() < deadline, (out1, expect)
-            time.sleep(0.25)
+        for u in uris[1:]:
+            while True:
+                out1 = post(u, "/index/d/query", q)
+                if out1["results"][0] == expect:
+                    break
+                assert time.monotonic() < deadline, (u, out1, expect)
+                time.sleep(0.25)
 
         per_q, conc, per_q_base, per_q_peak = _measure_base_peak(
             DIST_THREADS, DIST_THREADS_PEAK,
             DIST_QUERIES // DIST_THREADS,
             max(2, DIST_QUERIES // DIST_THREADS_PEAK),
             lambda tid, i: post(uris[0], "/index/d/query", q))
+
+        host = uris[0].split("//", 1)[1]
+
+        def check(o):
+            assert o["results"][0] == expect, (o, expect)
+
+        def qps_at(clients: int, per_thread: int) -> float:
+            return _keepalive_qps(host, "/index/d/query", q, check,
+                                  clients, per_thread)
+
+        # saturating-concurrency sweep (keep-alive clients): where does
+        # the coordinator stop converting clients into throughput? The
+        # knee was never captured in earlier rounds (VERDICT r5: 43 q/s @8
+        # clients, "no saturation point")
+        sweep = []
+        for c in DIST_SWEEP:
+            sweep.append({"clients": c,
+                          "qps": round(qps_at(c, max(4, 192 // c)), 2)})
+        # saturation = smallest client count reaching >=90% of the sweep's
+        # peak rate (robust to non-monotone noise on a shared host, where
+        # a first-gain-below-10% walk stops at the first dip)
+        peak = max(p["qps"] for p in sweep)
+        saturation = next(p["clients"] for p in sweep
+                          if p["qps"] >= 0.9 * peak)
+
+        # coalescing A/B at fixed concurrency: same cluster, same warm
+        # residency, interleaved off/on rounds (the shared host drifts —
+        # per-round ratios are the honest signal, the median ratio the
+        # headline). Factor/dedup deltas come from the coordinator's
+        # NodeCoalescer counters.
+        coal = servers[0].executor.coalescer
+        ab_rounds = []
+        for _ in range(DIST_AB_ROUNDS):
+            rnd = {}
+            for mode in ("off", "on"):
+                if coal is not None:
+                    coal.enabled = mode == "on"
+                snap0 = coal.snapshot() if coal is not None else {}
+                rnd[f"qps_{mode}"] = round(qps_at(DIST_AB_THREADS, 8), 2)
+                snap1 = coal.snapshot() if coal is not None else {}
+                if mode == "on" and coal is not None:
+                    nb = snap1["batches"] - snap0["batches"]
+                    nq = (snap1["batched_queries"]
+                          - snap0["batched_queries"])
+                    rnd["coalesce_factor"] = round(nq / nb, 2) if nb else 0.0
+                    rnd["deduped"] = (snap1["deduped_queries"]
+                                      - snap0["deduped_queries"])
+            rnd["speedup"] = (round(rnd["qps_on"] / rnd["qps_off"], 2)
+                              if rnd["qps_off"] else 0.0)
+            ab_rounds.append(rnd)
+        if coal is not None:
+            coal.enabled = True
+        speedups = sorted(r["speedup"] for r in ab_rounds)
+        factors = [r.get("coalesce_factor", 0.0) for r in ab_rounds]
+
         out = {
-            "metric": "distributed_count_qps_16shard_2node",
+            "metric": f"distributed_count_qps_16shard_{DIST_NODES}node",
             "value": round(1.0 / per_q, 2),
             "unit": "queries/s",
             "tpu_ms_per_query": round(per_q * 1e3, 4),
             "concurrency": conc,
             "qps_at_base_concurrency": {"clients": DIST_THREADS,
                                         "qps": round(1.0 / per_q_base, 2)},
-            "path": "2-node mapReduce fan-out: local device shards + "
-                    "HTTP scatter-gather (executor.go:2183 analog); "
+            "concurrency_sweep": sweep,
+            "saturation_clients": saturation,
+            "coalesce_ab": {
+                "clients": DIST_AB_THREADS,
+                "rounds": ab_rounds,
+                "median_speedup_on_vs_off": speedups[len(speedups) // 2],
+                "mean_coalesce_factor": round(
+                    sum(factors) / len(factors), 2) if factors else 0.0,
+                "note": "interleaved off/on keep-alive rounds on the same "
+                        "warm cluster; coalescing = /internal/query-batch "
+                        "envelopes + singleflight dedup (net/coalesce.py)",
+            },
+            "path": f"{DIST_NODES}-node mapReduce fan-out: local device "
+                    "shards + coalesced HTTP scatter-gather "
+                    "(executor.go:2183 analog; net/coalesce.py); "
                     + _conc_path(DIST_THREADS, DIST_THREADS_PEAK,
                                  per_q_peak is not None)
-                    + "; baseline is the Go-proxy kernel time for the "
-                    "same query shape (fan-out overhead metric)",
+                    + " via per-request urllib (continuity); sweep and "
+                    "A/B use keep-alive clients; baseline is the Go-proxy "
+                    "kernel time for the same query shape (fan-out "
+                    "overhead metric)",
         }
         # fan-out overhead metric with no numpy equivalent: compare the
         # Go proxy's kernel time for the same 16-shard query shape (the

@@ -193,6 +193,67 @@ class API:
             out["columnAttrSets"] = self.column_attr_sets(index_name, results)
         return out
 
+    def query_batch(self, entries: list[dict]) -> list[tuple]:
+        """Execute a coalesced fan-out envelope (POST /internal/query-batch,
+        net/coalesce.py): N read-only query entries, answered in order as
+        (results, err) pairs. Entries run through query_results — the same
+        validation/translation path as the per-query route — but
+        CONCURRENTLY on the executor's inbound batch pool, so the
+        envelope's device dispatches coalesce in CountBatcher /
+        PlaneSumBatcher exactly as N separate requests would, minus the
+        N-1 HTTP round trips. Write calls are rejected per-entry: the
+        sender retries a coalesced envelope on a stale keep-alive
+        (net/client.py single-retry rule), which is only safe while every
+        entry is idempotent."""
+        self._validate("query")
+        import contextvars
+        import time as _time
+
+        from pilosa_tpu.pql import parse_string_cached
+        from pilosa_tpu.utils import qctx
+
+        def one(e: dict) -> tuple:
+            dl_token = None
+            try:
+                timeout = e.get("timeout")
+                if timeout is not None:
+                    # per-entry deadline: each coalesced caller's remaining
+                    # budget rides its own entry, not the envelope leader's
+                    # (the leader's header-adopted deadline still caps it —
+                    # strictest source wins, as in Handler._set_deadline)
+                    entry_dl = _time.monotonic() + float(timeout)
+                    cur = qctx.deadline.get()
+                    dl_token = qctx.deadline.set(
+                        entry_dl if cur is None else min(entry_dl, cur))
+                query = parse_string_cached(e.get("query", ""))
+                for c in query.calls:
+                    inner = (c.children[0]
+                             if c.name == "Options" and c.children else c)
+                    if inner.name in self.executor.WRITE_CALLS:
+                        return (None, f"{inner.name}() cannot ride a "
+                                      "coalesced query batch (not idempotent)")
+                return (self.query_results(
+                    e.get("index", ""), query, shards=e.get("shards"),
+                    remote=bool(e.get("remote", True))), "")
+            except qctx.QueryTimeoutError as exc:
+                return (None, str(exc) or "query deadline exceeded")
+            except (ApiError, ValueError) as exc:
+                return (None, str(exc))
+            except Exception as exc:  # noqa: BLE001 — per-entry isolation
+                return (None, f"{type(exc).__name__}: {exc}")
+            finally:
+                if dl_token is not None:
+                    qctx.deadline.reset(dl_token)
+
+        if len(entries) <= 1:
+            return [one(e) for e in entries]
+        # copied contexts: pool threads must see the request's trace id /
+        # adopted deadline (the same rule as the executor's fan-out pool)
+        pool = self.executor.batch_exec_pool
+        futs = [pool.submit(contextvars.copy_context().run, one, e)
+                for e in entries]
+        return [f.result() for f in futs]
+
     def column_attr_sets(self, index_name: str, results: list) -> list[dict]:
         """Attrs for every column appearing in Row results — the
         QueryRequest.ColumnAttrs option (executor/handler attach

@@ -50,6 +50,16 @@ class ClusterConfig:
     # seconds between membership refresh + liveness probe ticks (the
     # memberlist ProbeInterval analog, gossip/gossip.go:508-519)
     membership_interval: float = 5.0
+    # distributed fan-out (net/coalesce.py; docs/operations.md "Fan-out
+    # and hedging"): persistent fan-out pool size, the coalesce window a
+    # query-batch leader waits for co-destined queries (duration; flushes
+    # earlier on an arrival lull or at max-batch), the per-envelope entry
+    # cap, and the hedged-read delay after which a read-only node batch
+    # re-issues to the next live replica (duration; 0 disables hedging)
+    fanout_pool_size: int = 32
+    fanout_coalesce_window: float = 0.002
+    fanout_coalesce_max_batch: int = 64
+    hedge_delay: float = 0.0
 
 
 @dataclass
@@ -201,6 +211,10 @@ class Config:
             f"disabled = {str(self.cluster.disabled).lower()}",
             f"replicas = {self.cluster.replicas}",
             f"hosts = [{', '.join(repr(h) for h in self.cluster.hosts)}]",
+            f"fanout-pool-size = {self.cluster.fanout_pool_size}",
+            f"fanout-coalesce-window = {self.cluster.fanout_coalesce_window}",
+            f"fanout-coalesce-max-batch = {self.cluster.fanout_coalesce_max_batch}",
+            f"hedge-delay = {self.cluster.hedge_delay}",
             "",
             "[anti-entropy]",
             f"interval = {self.anti_entropy.interval}",

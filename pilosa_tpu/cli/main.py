@@ -132,6 +132,10 @@ def cmd_server(args) -> int:
         join=getattr(args, "join", False),
         long_query_time=cfg.cluster.long_query_time,
         query_timeout=cfg.cluster.query_timeout,
+        fanout_pool_size=cfg.cluster.fanout_pool_size,
+        fanout_coalesce_window=cfg.cluster.fanout_coalesce_window,
+        fanout_coalesce_max_batch=cfg.cluster.fanout_coalesce_max_batch,
+        hedge_delay=cfg.cluster.hedge_delay,
         max_writes_per_request=cfg.max_writes_per_request,
         metric_service=cfg.metric.service,
         metric_host=cfg.metric.host,

@@ -11,6 +11,8 @@ Result type tags follow the reference's queryResultType* iota
 
 from __future__ import annotations
 
+import base64
+import json
 from typing import Optional
 
 import numpy as np
@@ -194,6 +196,57 @@ class Serializer:
                     {"id": c.ID, "attrs": _decode_attrs(c.Attrs),
                      **({"key": c.Key} if c.Key else {})}
                     for c in m.ColumnAttrSets]}
+
+    # -- coalesced fan-out envelope (net/coalesce.py) ------------------------
+    # JSON envelope, per-entry protobuf QueryResponse payloads in base64
+    # (proto/pilosa.proto documents the shape): the envelope stays
+    # versionable — a peer without the route 404s and callers fall back to
+    # per-query query_proto — while each entry's results round-trip
+    # through the EXACT wire codec the per-query path uses, so batched and
+    # unbatched responses can never skew. Per-entry errors ride each
+    # entry's QueryResponse.Err; only a malformed envelope fails whole.
+
+    def encode_query_batch_request(self, entries: list[dict]) -> bytes:
+        out = []
+        for e in entries:
+            entry = {"index": e["index"], "query": e["query"],
+                     "remote": bool(e.get("remote", True))}
+            if e.get("shards") is not None:
+                entry["shards"] = [int(s) for s in e["shards"]]
+            if e.get("timeout") is not None:
+                entry["timeout"] = float(e["timeout"])
+            out.append(entry)
+        return json.dumps({"queries": out}).encode()
+
+    def decode_query_batch_request(self, data: bytes) -> list[dict]:
+        try:
+            body = json.loads(data)
+        except (ValueError, UnicodeDecodeError) as e:
+            raise ValueError(f"invalid query-batch body: {e}")
+        queries = body.get("queries") if isinstance(body, dict) else None
+        if not isinstance(queries, list):
+            raise ValueError("query-batch body must carry a 'queries' list")
+        return queries
+
+    def encode_query_batch_response(self, results_or_errs: list) -> bytes:
+        """`results_or_errs`: one (results, err) pair per entry; results
+        may be None when err is set."""
+        resps = [
+            base64.b64encode(
+                self.encode_query_response(results or [], err=err)).decode()
+            for results, err in results_or_errs]
+        return json.dumps({"responses": resps}).encode()
+
+    def decode_query_batch_response_raw(self, data: bytes) -> list[bytes]:
+        """Per-entry serialized QueryResponse payloads, undecoded — the
+        coalescer decodes per waiter (deduped entries must not share one
+        result object graph)."""
+        body = json.loads(data)
+        return [base64.b64decode(b) for b in body.get("responses", [])]
+
+    def decode_query_batch_response(self, data: bytes) -> list[dict]:
+        return [self.decode_query_response(raw)
+                for raw in self.decode_query_batch_response_raw(data)]
 
     # -- imports -------------------------------------------------------------
 

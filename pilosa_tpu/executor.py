@@ -160,6 +160,80 @@ class Executor:
             self.batcher = None
             self.sum_batcher = None
             self.minmax_batcher = None
+        # ---- distributed fan-out plumbing (net/coalesce.py) ----
+        # persistent bounded pools replacing the per-query
+        # ThreadPoolExecutor: created lazily, shut down with the server
+        # (shutdown()); sizes are Server/config knobs
+        self._fanout_pool = None
+        self._batch_exec_pool = None
+        self._hedge_pool = None
+        self._pool_lock = _threading.Lock()
+        self.fanout_pool_size = 32
+        self.batch_exec_pool_size = 16
+        # hedged replica reads: after hedge_delay seconds without a primary
+        # response, the same read-only node batch re-issues to the next
+        # live replica and the first response wins. 0 disables.
+        self.hedge_delay = 0.0
+        self._hedge_lock = _threading.Lock()
+        self.hedges_fired = 0
+        self.hedges_won = 0
+        self.hedges_cancelled = 0
+        # network-layer continuous batcher: concurrent fan-out queries to
+        # the same remote node coalesce into one /internal/query-batch
+        # envelope (PILOSA_TPU_NET_COALESCE=0 falls back to per-query RPC)
+        self.coalescer = None
+        if client is not None and os.environ.get(
+                "PILOSA_TPU_NET_COALESCE", "1") != "0":
+            from pilosa_tpu.net.coalesce import NodeCoalescer
+            self.coalescer = NodeCoalescer(client)
+
+    # ------------------------------------------------------ fan-out pools
+
+    def _get_pool(self, attr: str, size: int, name: str):
+        pool = getattr(self, attr)
+        if pool is not None:
+            return pool
+        with self._pool_lock:
+            if getattr(self, attr) is None:
+                from concurrent.futures import ThreadPoolExecutor
+                setattr(self, attr, ThreadPoolExecutor(
+                    max_workers=size, thread_name_prefix=name))
+            return getattr(self, attr)
+
+    @property
+    def fanout_pool(self):
+        """Long-lived bounded pool for outbound node fan-out (replaces the
+        ThreadPoolExecutor the old code built and tore down per query)."""
+        return self._get_pool("_fanout_pool", max(4, self.fanout_pool_size),
+                              "pilosa-fanout")
+
+    @property
+    def batch_exec_pool(self):
+        """Inbound /internal/query-batch envelope execution. Deliberately
+        SEPARATE from fanout_pool: inbound entries run with remote=True —
+        purely local, never waiting on other nodes — so this pool always
+        drains; sharing the outbound pool could distributed-deadlock when
+        two coordinators fan out to each other under saturation."""
+        return self._get_pool("_batch_exec_pool",
+                              max(2, self.batch_exec_pool_size),
+                              "pilosa-qbatch")
+
+    @property
+    def hedge_pool(self):
+        """Hedged-read race threads — separate from fanout_pool so a hedge
+        never competes with the primaries for fan-out slots (created only
+        when hedge_delay > 0 fires the first race)."""
+        return self._get_pool("_hedge_pool", max(4, self.fanout_pool_size),
+                              "pilosa-hedge")
+
+    def shutdown(self) -> None:
+        """Stop the executor-owned pools (called from Server.close)."""
+        with self._pool_lock:
+            for attr in ("_fanout_pool", "_batch_exec_pool", "_hedge_pool"):
+                pool = getattr(self, attr)
+                if pool is not None:
+                    pool.shutdown(wait=False, cancel_futures=True)
+                    setattr(self, attr, None)
 
     def clear_caches(self) -> None:
         """Drop the host row cache and all HBM-resident leaves. Called on
@@ -1407,20 +1481,31 @@ class Executor:
                     self._map_node(index, fan_call, node_id, node_shards, set()))
             return self._reduce(call, partials, index, shards)
         # concurrent per-node fan-out — the goroutine-per-node mapper
-        # (executor.go:2256); reduce as responses land. Each submit runs in
-        # a fresh context copy: pool threads don't inherit contextvars, so
-        # tracing.current_trace_id would read None and drop the
-        # X-Pilosa-Trace-Id header on remote calls (Context.run is also
+        # (executor.go:2256); reduce as responses land. Submits go to the
+        # PERSISTENT executor-owned pool (a fresh ThreadPoolExecutor per
+        # query was pure churn: thread spawn + teardown on every request,
+        # and per-thread keep-alive connections never reused). Each submit
+        # runs in a fresh context copy: pool threads don't inherit
+        # contextvars, so tracing.current_trace_id would read None and drop
+        # the X-Pilosa-Trace-Id header on remote calls (Context.run is also
         # non-reentrant, hence one copy per future).
         import contextvars
-        from concurrent.futures import ThreadPoolExecutor
-        with ThreadPoolExecutor(max_workers=len(groups)) as pool:
-            futures = [
-                pool.submit(contextvars.copy_context().run, self._map_node,
-                            index, fan_call, node_id, node_shards, set())
-                for node_id, node_shards in groups.items()
-            ]
-            partials = [p for fut in futures for p in fut.result()]
+        pool = self.fanout_pool
+        local_shards = groups.pop(self.cluster.local_id, None)
+        futures = [
+            pool.submit(contextvars.copy_context().run, self._map_node,
+                        index, fan_call, node_id, node_shards, set())
+            for node_id, node_shards in groups.items()
+        ]
+        partials = []
+        if local_shards is not None:
+            # the local group runs INLINE on the request thread (no pool
+            # slot, no context copy, no future wait): its device execution
+            # overlaps the remote round trips already in flight above
+            partials.extend(self._map_node(index, fan_call,
+                                           self.cluster.local_id,
+                                           local_shards, set()))
+        partials.extend(p for fut in futures for p in fut.result())
         return self._reduce(call, partials, index, shards)
 
     def _map_node(self, index: Index, call: Call, node_id: str,
@@ -1436,10 +1521,8 @@ class Executor:
         err: Exception | None = None
         if node is not None and node.uri:
             try:
-                results = self.client.query_proto(
-                    node.uri, index.name, call.to_pql(),
-                    shards=node_shards, remote=True)
-                return [results[0]]
+                return [self._fanout_remote(index, call, node, node_shards,
+                                            excluded)]
             except ClientError as e:
                 err = e
         # failover: per-shard re-mapping onto surviving replicas
@@ -1462,6 +1545,140 @@ class Executor:
             partials.extend(self._map_node(index, call, cand, cand_shards,
                                            excluded))
         return partials
+
+    @classmethod
+    def _call_has_write(cls, call: Call) -> bool:
+        """True if any call in the tree is non-idempotent (hedge/coalesce
+        eligibility is decided on the WHOLE tree, defensively — the read
+        fan-out path should never see one, but a hedge IS a re-send and the
+        single-retry rule in net/client.py:70-95 forbids re-sending
+        side-effecting requests)."""
+        if call.name in cls.WRITE_CALLS:
+            return True
+        return any(cls._call_has_write(c) for c in call.children)
+
+    def _fanout_remote(self, index: Index, call: Call, node,
+                       node_shards: list[int], excluded: set):
+        """One remote node-batch query, with per-node latency accounting
+        and (when enabled + eligible) a hedged replica read. Returns the
+        node's partial result."""
+        if self.hedge_delay > 0 and not self._call_has_write(call):
+            hedge_node = self._hedge_candidate(index, node, node_shards,
+                                               excluded)
+            if hedge_node is not None:
+                return self._hedged_query(index, call, node, hedge_node,
+                                          node_shards)
+        return self._timed_node_query(index, call, node, node_shards)
+
+    def _timed_node_query(self, index: Index, call: Call, node,
+                          node_shards: list[int]):
+        """The node RPC itself: coalesced into a /internal/query-batch
+        envelope when the coalescer is on, per-query query_proto otherwise.
+        Wall time feeds the per-node fan-out latency histogram
+        (stats timing buckets; /debug/vars) — the signal hedge_delay should
+        be tuned against (docs/operations.md)."""
+        import time as _time
+        t0 = _time.perf_counter()
+        try:
+            if self.coalescer is not None:
+                results = self.coalescer.query(
+                    node.uri, index.name, call.to_pql(), shards=node_shards)
+            else:
+                results = self.client.query_proto(
+                    node.uri, index.name, call.to_pql(),
+                    shards=node_shards, remote=True)
+        finally:
+            self.stats.timing(f"fanoutLatency/{node.id}",
+                              (_time.perf_counter() - t0) * 1e3)
+        return results[0]
+
+    def _hedge_candidate(self, index: Index, node, node_shards: list[int],
+                         excluded: set):
+        """The next live replica holding EVERY shard of this node batch
+        (including this node itself as a local-execution hedge), or None.
+        Hedging is batch-granular: splitting the batch per shard would
+        re-create the per-query fan-out the coalescer exists to remove."""
+        common: Optional[set] = None
+        for s in node_shards:
+            owners = {n.id for n in self.cluster.shard_nodes(index.name, s)}
+            common = owners if common is None else common & owners
+            if not common:
+                return None
+        common.discard(node.id)
+        common -= set(excluded)
+        common = {c for c in common if not self.cluster.is_down(c)}
+        if not common:
+            return None
+        if self.cluster.local_id in common:
+            # prefer hedging onto the local device slice: no second RPC
+            return self.cluster.node_by_id(self.cluster.local_id)
+        # deterministic pick: cluster node order (the replica ring order)
+        for n in self.cluster.nodes:
+            if n.id in common:
+                return n
+        return None
+
+    def _hedged_query(self, index: Index, call: Call, node, hedge_node,
+                      node_shards: list[int]):
+        """Tail-latency hedge for a READ-ONLY node batch: the primary RPC
+        dispatches on the hedge pool; if it hasn't answered within
+        hedge_delay, the same batch re-issues to `hedge_node` (the next
+        live replica — or this node's own local slice) and the first
+        response wins. The loser is cancelled if still queued, discarded
+        if in flight — safe because only idempotent reads ever reach here
+        (_fanout_remote guards on _call_has_write), so a discarded
+        completion has no side effects and a winner is counted exactly
+        once. Both racers failing raises the primary's error, which feeds
+        the normal per-shard failover in _map_node."""
+        import contextvars
+        import threading as _threading
+        from concurrent.futures import FIRST_COMPLETED
+        from concurrent.futures import wait as _fwait
+
+        pool = self.hedge_pool
+        started = _threading.Event()
+
+        def _primary():
+            started.set()
+            return self._timed_node_query(index, call, node, node_shards)
+
+        primary = pool.submit(contextvars.copy_context().run, _primary)
+        # the hedge clock starts when the RPC actually STARTS, not at pool
+        # submit: under a saturated hedge pool a queued primary would
+        # otherwise "time out" before ever sending, firing spurious hedges
+        # that double the load exactly when the system is overloaded (and
+        # making hedgesFired meaningless as a tuning signal)
+        started.wait()
+        done, _ = _fwait([primary], timeout=self.hedge_delay)
+        if done:
+            return primary.result()
+        with self._hedge_lock:
+            self.hedges_fired += 1
+        if hedge_node.id == self.cluster.local_id:
+            backup = pool.submit(
+                contextvars.copy_context().run,
+                lambda: self._execute_call(index, call, node_shards))
+        else:
+            backup = pool.submit(contextvars.copy_context().run,
+                                 self._timed_node_query, index, call,
+                                 hedge_node, node_shards)
+        racers = [primary, backup]
+        done, pending = _fwait(racers, return_when=FIRST_COMPLETED)
+        winner = next((f for f in done if f.exception() is None), None)
+        if winner is None and pending:
+            # first finisher failed: defer to the survivor
+            done2, _ = _fwait(pending)
+            winner = next((f for f in done2 if f.exception() is None), None)
+        if winner is None:
+            raise primary.exception()  # both failed: normal failover path
+        loser = backup if winner is primary else primary
+        with self._hedge_lock:
+            if winner is backup:
+                self.hedges_won += 1
+            if not loser.done():
+                loser.cancel()  # drops it if still queued; else discarded
+                self.hedges_cancelled += 1
+        return winner.result()
 
     def _execute_write_distributed(self, index: Index, call: Call, shards):
         """Set/Clear/SetColumnAttrs fan out to every replica of the column's

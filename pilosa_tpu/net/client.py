@@ -169,6 +169,40 @@ class InternalClient:
             raise ClientError(f"remote query: {resp['err']}")
         return resp["results"]
 
+    def query_batch(self, uri: str, entries: list[dict]) -> list[dict]:
+        """Coalesced fan-out envelope (net/coalesce.py): N read-only
+        (index, query, shards) entries in ONE POST /internal/query-batch
+        round trip. Returns one decoded {"err", "results"} dict per entry,
+        in order. A peer that predates the route answers 404 — the caller
+        falls back to per-query query_proto (mixed-version clusters). The
+        envelope may carry ONLY reads: a stale keep-alive re-sends it once
+        (the retry rule above), which is safe iff every entry is
+        idempotent."""
+        from pilosa_tpu.encoding.protobuf import Serializer
+        s = Serializer()
+        return [s.decode_query_response(raw)
+                for raw in self.query_batch_raw(uri, entries)]
+
+    def query_batch_raw(self, uri: str, entries: list[dict]) -> list[bytes]:
+        """query_batch without the decode: one serialized QueryResponse
+        per entry. The coalescer dedups identical entries on the wire but
+        decodes PER WAITER from these bytes — result object graphs are
+        mutated downstream (translate, excludeColumns), so waiters must
+        never share one."""
+        from pilosa_tpu.encoding.protobuf import Serializer
+        s = Serializer()
+        body = s.encode_query_batch_request(entries)
+        out = self._request("POST", uri, "/internal/query-batch", body,
+                            "application/json", accept="application/json")
+        try:
+            return s.decode_query_batch_response_raw(out)
+        except Exception as e:  # noqa: BLE001 — normalize like transport
+            # a mangled 200 body (proxy truncation, mid-upgrade peer) must
+            # surface as ClientError so callers fail over per shard, the
+            # same as a transport-layer failure from this peer
+            raise ClientError(
+                f"query-batch: malformed response: {type(e).__name__}: {e}")
+
     def import_bits(self, uri: str, index: str, field: str, payload: dict) -> None:
         self._json("POST", uri, f"/index/{index}/field/{field}/import", payload)
 

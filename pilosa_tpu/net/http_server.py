@@ -55,6 +55,7 @@ ROUTES: list[tuple[str, re.Pattern, str]] = [
     ("DELETE", re.compile(r"^/internal/index/(?P<index>[^/]+)/field/(?P<field>[^/]+)/remote-available-shards/(?P<shard>\d+)$"), "delete_remote_available_shard"),
     ("GET", re.compile(r"^/internal/nodes$"), "get_nodes"),
     ("GET", re.compile(r"^/internal/probe$"), "get_internal_probe"),
+    ("POST", re.compile(r"^/internal/query-batch$"), "post_query_batch"),
     ("GET", re.compile(r"^/internal/shards/max$"), "get_shards_max"),
     ("GET", re.compile(r"^/internal/translate/data$"), "get_translate_data"),
     ("POST", re.compile(r"^/internal/translate/keys$"), "post_translate_keys"),
@@ -380,6 +381,17 @@ class Handler:
             mm = getattr(ex, "minmax_batcher", None)
             if mm is not None:
                 snap["minMaxBatcher"] = mm.snapshot()
+            # network-layer fan-out coalescing + hedging (net/coalesce.py):
+            # batch-size distribution, mean coalesce factor, 404-fallback
+            # counters, and the hedged-read race outcomes
+            coal = getattr(ex, "coalescer", None)
+            if coal is not None:
+                snap["netCoalesce"] = coal.snapshot()
+            snap["hedges"] = {
+                "hedgesFired": getattr(ex, "hedges_fired", 0),
+                "hedgesWon": getattr(ex, "hedges_won", 0),
+                "hedgesCancelled": getattr(ex, "hedges_cancelled", 0),
+            }
         holder = getattr(self.api, "holder", None)
         if holder is not None:
             # volatility surface (frozen bulk loads are NOT durable until
@@ -537,6 +549,23 @@ class Handler:
             raise ApiError("uri is required")
         alive = self.api.probe_peer(target)
         return self._json({"alive": alive})
+
+    def post_query_batch(self, params, query, body):
+        """Coalesced fan-out envelope (net/coalesce.py NodeCoalescer): N
+        read-only (index, pql, shards) entries execute through the normal
+        api/executor path — concurrently, so the device-side continuous
+        batchers see the whole envelope at once and network coalescing
+        compounds with device coalescing. Per-entry errors ride each
+        entry's QueryResponse.Err; only a malformed envelope fails whole.
+        Nodes that predate this route 404 it, and senders fall back to
+        per-query /index/{index}/query (mixed-version clusters)."""
+        try:
+            entries = self.serializer.decode_query_batch_request(body)
+        except ValueError as e:
+            raise ApiError(str(e))
+        results = self.api.query_batch(entries)
+        return (200, "application/json",
+                self.serializer.encode_query_batch_response(results))
 
     def get_shards_max(self, params, query, body):
         return self._json({"standard": self.api.max_shards()})

@@ -65,7 +65,11 @@ class Server:
                  tracing_endpoint: str = "",
                  gossip_port: Optional[int] = None,
                  gossip_seeds: Optional[list[str]] = None,
-                 gossip_config=None):
+                 gossip_config=None,
+                 fanout_pool_size: int = 32,
+                 fanout_coalesce_window: float = 0.002,
+                 fanout_coalesce_max_batch: int = 64,
+                 hedge_delay: float = 0.0):
         self.data_dir = data_dir
         self.holder = Holder(data_dir)
         self.node_id = node_id or self._load_or_create_id()
@@ -109,6 +113,15 @@ class Server:
                                  cluster=self.cluster, client=self.client)
         self.executor.stats = self.stats
         self.executor.tracer = self.tracer
+        # distributed fan-out knobs (net/coalesce.py; docs/operations.md
+        # "Fan-out and hedging"): persistent pool size, coalesce window /
+        # envelope cap, hedged-read delay (0 disables hedging)
+        self.executor.fanout_pool_size = fanout_pool_size
+        self.executor.hedge_delay = hedge_delay
+        if self.executor.coalescer is not None:
+            self.executor.coalescer.admission_s = fanout_coalesce_window
+            self.executor.coalescer.max_batch = max(
+                1, fanout_coalesce_max_batch)
         self.api = API(self.holder, self.cluster, executor=self.executor,
                        translate_store=self.cluster_translate)
         self.handler = Handler(self.api, cluster_message_fn=self.receive_message,
@@ -654,6 +667,7 @@ class Server:
             self._member_timer.cancel()
         if self._resize_watchdog is not None:
             self._resize_watchdog.cancel()
+        self.executor.shutdown()  # persistent fan-out / batch-exec pools
         self.runtime_monitor.close()
         self.diagnostics.close()
         if self.tracer.exporter is not None:

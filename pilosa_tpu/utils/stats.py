@@ -8,8 +8,18 @@ surface, a nop client, and a JSON snapshot for the /debug/vars endpoint.
 
 from __future__ import annotations
 
+import math
 import threading
 from typing import Optional
+
+
+def _pow2_bucket(value: float) -> str:
+    """Log2 histogram bucket label: the smallest power-of-two upper bound
+    for `value` (unit = whatever the caller reports in; fan-out latencies
+    report milliseconds). Negative/zero values collapse into "le0"."""
+    if value <= 0:
+        return "le0"
+    return f"le{2.0 ** math.ceil(math.log2(value)):g}"
 
 
 class StatsClient:
@@ -53,11 +63,17 @@ class StatsClient:
     def timing(self, name: str, value: float, rate: float = 1.0) -> None:
         with self._store["lock"]:
             t = self._store["timings"].setdefault(
-                self._key(name), {"count": 0, "sum": 0.0, "min": None, "max": None})
+                self._key(name), {"count": 0, "sum": 0.0, "min": None,
+                                  "max": None, "buckets": {}})
             t["count"] += 1
             t["sum"] += value
             t["min"] = value if t["min"] is None else min(t["min"], value)
             t["max"] = value if t["max"] is None else max(t["max"], value)
+            # log2 bucket distribution: count/sum/min/max can't answer
+            # "where is the tail" (the per-node fan-out latency histograms
+            # hedge_delay is tuned against, docs/operations.md)
+            b = _pow2_bucket(value)
+            t["buckets"][b] = t["buckets"].get(b, 0) + 1
 
     def snapshot(self) -> dict:
         """JSON-able dump for /debug/vars."""
@@ -65,7 +81,10 @@ class StatsClient:
             return {
                 "counts": dict(self._store["counts"]),
                 "gauges": dict(self._store["gauges"]),
-                "timings": {k: dict(v) for k, v in self._store["timings"].items()},
+                # deep-ish copy: the nested bucket dicts keep mutating
+                # under concurrent traffic after the snapshot is taken
+                "timings": {k: {**v, "buckets": dict(v["buckets"])}
+                            for k, v in self._store["timings"].items()},
                 "sets": {k: sorted(v) for k, v in self._store["sets"].items()},
             }
 

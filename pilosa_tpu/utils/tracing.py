@@ -10,12 +10,15 @@ InjectHTTPHeaders/extractTracing (tracing/tracing.go:22-26).
 from __future__ import annotations
 
 import contextvars
+import random
 import threading
 import time
-import uuid
 from typing import Optional
 
 TRACE_HEADER = "X-Pilosa-Trace-Id"
+
+# process-seeded PRNG for trace ids (see Tracer.start_span)
+_trace_rng = random.Random()
 
 # trace id of the request being served, for cross-node propagation: the HTTP
 # handler sets it from the incoming header, the InternalClient injects it
@@ -169,8 +172,13 @@ class Tracer:
         self.sampler_param = sampler_param
 
     def start_span(self, name: str, trace_id: Optional[str] = None) -> Span:
+        # random.getrandbits, not uuid4: a fresh trace id is minted on
+        # EVERY traced query without an inherited id, and uuid4 costs an
+        # os.urandom syscall per call (visible in serving-path profiles);
+        # trace ids need uniqueness, not cryptographic strength
         return Span(self, name,
-                    trace_id or current_trace_id.get() or uuid.uuid4().hex[:16])
+                    trace_id or current_trace_id.get()
+                    or f"{_trace_rng.getrandbits(64):016x}")
 
     def _sampled(self, span: Span) -> bool:
         if self.exporter is None or self.sampler_type == "off":

@@ -1,0 +1,631 @@
+"""Network-layer fan-out coalescing (net/coalesce.py NodeCoalescer), the
+/internal/query-batch envelope, mixed-version 404 fallback, the
+single-retry rule under coalesced senders, and hedged replica reads.
+
+Unit tests drive the coalescer against a scripted fake client; the
+integration tests run REAL multi-node clusters over HTTP and assert the
+coalesced path answers byte-identically to the per-query path."""
+
+import json
+import socket
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from pilosa_tpu.constants import SHARD_WIDTH
+from pilosa_tpu.encoding.protobuf import Serializer
+from pilosa_tpu.net.client import ClientError, InternalClient
+from pilosa_tpu.net.coalesce import NodeCoalescer
+
+SW = SHARD_WIDTH
+
+
+# --------------------------------------------------------------- unit level
+
+
+class FakeClient:
+    """Scripted InternalClient stand-in: query_batch_raw answers every
+    entry with RESULT_UINT64 = len(entry pql) (distinct pqls -> distinct
+    results, so misrouted batch slots are detectable); query_proto records
+    per-query fallback traffic."""
+
+    def __init__(self, batch_status: int = 0, err_for: str = ""):
+        self.batch_calls: list[list] = []
+        self.proto_calls: list[tuple] = []
+        self.batch_status = batch_status
+        self.err_for = err_for  # pql whose entry answers with Err
+        self.ser = Serializer()
+        self.lock = threading.Lock()
+
+    def query_batch_raw(self, uri, entries):
+        with self.lock:
+            self.batch_calls.append(list(entries))
+        if self.batch_status:
+            raise ClientError("scripted", status=self.batch_status)
+        out = []
+        for e in entries:
+            if self.err_for and e["query"] == self.err_for:
+                out.append(self.ser.encode_query_response([], err="boom"))
+            else:
+                out.append(self.ser.encode_query_response([len(e["query"])]))
+        return out
+
+    def query_proto(self, uri, index, pql, shards=None, remote=False):
+        with self.lock:
+            self.proto_calls.append((uri, index, pql))
+        return [len(pql)]
+
+
+def _run_threads(n, fn):
+    errs = []
+
+    def wrap(i):
+        try:
+            fn(i)
+        except Exception as e:  # noqa: BLE001
+            errs.append(e)
+
+    ts = [threading.Thread(target=wrap, args=(i,)) for i in range(n)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    if errs:
+        raise errs[0]
+
+
+def test_concurrent_queries_coalesce_and_route_correctly():
+    fc = FakeClient()
+    co = NodeCoalescer(fc, window_s=0.05)
+    results = {}
+
+    def go(i):
+        pql = "Count(Row(f=%d))" % i + "x" * i  # distinct lengths
+        results[i] = (co.query("http://n1:1", "idx", pql), len(pql))
+
+    _run_threads(12, go)
+    for i, (got, want) in results.items():
+        assert got == [want], (i, got, want)
+    n_entries = sum(len(b) for b in fc.batch_calls)
+    assert n_entries == 12
+    # concurrency + the admission window must actually coalesce
+    assert len(fc.batch_calls) < 12
+    snap = co.snapshot()
+    assert snap["batched_queries"] == 12
+    assert snap["mean_coalesce_factor"] > 1.0
+
+
+def test_singleflight_dedup_one_wire_entry_per_unique_query():
+    fc = FakeClient()
+    co = NodeCoalescer(fc, window_s=0.05)
+    out = []
+    lock = threading.Lock()
+
+    def go(i):
+        r = co.query("http://n1:1", "idx", "Count(Row(f=7))")
+        with lock:
+            out.append(r)
+
+    _run_threads(10, go)
+    assert all(r == [len("Count(Row(f=7))")] for r in out)
+    # identical entries dedup on the wire...
+    assert sum(len(b) for b in fc.batch_calls) < 10
+    assert co.snapshot()["deduped_queries"] > 0
+    # ...but every waiter decodes its OWN result object (downstream code
+    # mutates result graphs; deduped waiters must never share one)
+    ids = {id(r) for r in out}
+    assert len(ids) == len(out)
+
+
+def test_404_fallback_marks_legacy_and_serves_per_query():
+    fc = FakeClient(batch_status=404)
+    co = NodeCoalescer(fc, window_s=0.02)
+
+    def go(i):
+        assert co.query("http://old:1", "idx", "Count(Row(f=%d))" % i) \
+            == [len("Count(Row(f=%d))" % i)]
+
+    _run_threads(8, go)
+    # every query was answered per-query; at least one envelope was tried
+    assert len(fc.proto_calls) == 8
+    assert len(fc.batch_calls) >= 1
+    assert co.snapshot()["legacy_nodes"] == 1
+    # legacy destination now bypasses the coalescer entirely
+    before = len(fc.batch_calls)
+    assert co.query("http://old:1", "idx", "Count(Row(f=1))") \
+        == [len("Count(Row(f=1))")]
+    assert len(fc.batch_calls) == before
+
+
+def test_legacy_ttl_reprobes_the_destination():
+    fc = FakeClient(batch_status=404)
+    co = NodeCoalescer(fc, window_s=0.0, legacy_ttl=0.05)
+    out = co._compute(("http://old:1",), [("idx", "q", None, None)])
+    assert len(out) == 1  # fallback sentinel per waiter
+    assert co._is_legacy("http://old:1")
+    time.sleep(0.06)
+    assert not co._is_legacy("http://old:1")  # TTL expired: re-probe
+
+
+def test_per_entry_error_raises_only_that_waiter():
+    fc = FakeClient(err_for="Count(Row(f=13))")
+    co = NodeCoalescer(fc, window_s=0.05)
+    oks, errors = [], []
+
+    def go(i):
+        pql = "Count(Row(f=%d))" % i
+        try:
+            oks.append(co.query("http://n1:1", "idx", pql))
+        except ClientError as e:
+            errors.append((i, str(e)))
+
+    _run_threads(16, go)
+    assert len(errors) == 1 and errors[0][0] == 13
+    assert "boom" in errors[0][1]
+    assert len(oks) == 15
+
+
+def test_disabled_coalescer_goes_direct():
+    fc = FakeClient()
+    co = NodeCoalescer(fc)
+    co.enabled = False
+    assert co.query("http://n1:1", "idx", "Count(Row(f=1))") \
+        == [len("Count(Row(f=1))")]
+    assert fc.batch_calls == [] and len(fc.proto_calls) == 1
+
+
+# ------------------------------------ single-retry rule, coalesced senders
+
+
+class BatchEchoServer:
+    """Raw-socket HTTP server speaking just enough /internal/query-batch:
+    parses the envelope, answers every entry with RESULT_UINT64 =
+    len(entry pql). Per-REQUEST scripted actions: "ok" (respond, keep
+    alive), "close-after" (respond then close — the stale-keep-alive
+    shape), "truncate" (headers + partial body then close — the
+    mid-response failure that must NOT be retried)."""
+
+    def __init__(self, script):
+        self.script = list(script)
+        self.sock = socket.create_server(("127.0.0.1", 0))
+        self.port = self.sock.getsockname()[1]
+        self.connections = 0
+        self.requests = 0
+        self._lock = threading.Lock()
+        self._ser = Serializer()
+        threading.Thread(target=self._serve, daemon=True).start()
+
+    @property
+    def uri(self):
+        return f"http://127.0.0.1:{self.port}"
+
+    def _serve(self):
+        self.sock.settimeout(10)
+        while True:
+            try:
+                conn, _ = self.sock.accept()
+            except (OSError, socket.timeout):
+                return
+            with self._lock:
+                self.connections += 1
+            threading.Thread(target=self._handle, args=(conn,),
+                             daemon=True).start()
+
+    def _read_request(self, conn):
+        data = b""
+        while b"\r\n\r\n" not in data:
+            chunk = conn.recv(65536)
+            if not chunk:
+                return None
+            data += chunk
+        head, body = data.split(b"\r\n\r\n", 1)
+        clen = 0
+        for line in head.decode().split("\r\n"):
+            if line.lower().startswith("content-length:"):
+                clen = int(line.split(":", 1)[1])
+        while len(body) < clen:
+            chunk = conn.recv(65536)
+            if not chunk:
+                return None
+            body += chunk
+        return body
+
+    def _handle(self, conn):
+        try:
+            while True:
+                body = self._read_request(conn)
+                if body is None:
+                    return
+                with self._lock:
+                    self.requests += 1
+                    action = self.script.pop(0) if self.script else "ok"
+                entries = json.loads(body)["queries"]
+                resp = self._ser.encode_query_batch_response(
+                    [([len(e["query"])], "") for e in entries])
+                payload = (b"HTTP/1.1 200 OK\r\n"
+                           b"Content-Type: application/json\r\n"
+                           b"Content-Length: " + str(len(resp)).encode()
+                           + b"\r\n\r\n" + resp)
+                if action == "truncate":
+                    conn.sendall(payload[:len(payload) - len(resp) // 2])
+                    conn.close()
+                    return
+                conn.sendall(payload)
+                if action == "close-after":
+                    conn.close()
+                    return
+        except OSError:
+            pass
+
+    def close(self):
+        self.sock.close()
+
+
+def test_stale_keepalive_retry_is_transparent_for_coalesced_envelopes():
+    # the server closes its connection after the first envelope WITHOUT a
+    # Connection: close header; the same (persistent, pooled-connection)
+    # sender thread's next envelope hits the stale socket and must
+    # transparently reconnect — the envelope is all-reads, so the one
+    # re-send is safe under the single-retry rule
+    srv = BatchEchoServer(["close-after"] + ["ok"] * 50)
+    try:
+        client = InternalClient(timeout=5)
+        co = NodeCoalescer(client, window_s=0.01)
+        # deterministic stale path: this thread leads both envelopes, so
+        # envelope 2 rides the conn the server closed after envelope 1
+        assert co.query(srv.uri, "idx", "Count(Row(f=1))") \
+            == [len("Count(Row(f=1))")]
+        assert co.query(srv.uri, "idx", "Count(Row(f=2))") \
+            == [len("Count(Row(f=2))")]
+        assert srv.connections == 2  # exactly one transparent reconnect
+        assert srv.requests == 2
+
+        # and under concurrent coalesced senders mid-close: no error ever
+        # surfaces to a waiter
+        def go(i):
+            pql = "Count(Row(f=%d))" % i
+            assert co.query(srv.uri, "idx", pql) == [len(pql)]
+
+        _run_threads(4, go)
+    finally:
+        srv.close()
+
+
+def test_mid_response_failure_is_terminal_not_resent():
+    # headers arrived, body truncated: the peer processed the request, so
+    # the client must surface the error WITHOUT re-sending (a re-send
+    # could double-execute side effects on a non-idempotent route)
+    srv = BatchEchoServer(["truncate"])
+    try:
+        client = InternalClient(timeout=5)
+        with pytest.raises(ClientError):
+            client.query_batch_raw(srv.uri, [
+                {"index": "idx", "query": "Count(Row(f=1))"}])
+        time.sleep(0.05)
+        assert srv.requests == 1  # exactly one send: no retry after headers
+    finally:
+        srv.close()
+
+
+# --------------------------------------------------------- cluster fixtures
+
+
+def jpost(uri, path, payload=None, raw=None):
+    body = raw if raw is not None else json.dumps(payload or {}).encode()
+    req = urllib.request.Request(uri + path, data=body, method="POST")
+    with urllib.request.urlopen(req, timeout=30) as r:
+        return json.loads(r.read())
+
+
+def _build_cluster(tmp, n_nodes, replica_n, n_shards=6):
+    """Cluster with PINNED node ids ("a", "b", "c") and index "i": the
+    jump-hash placement is deterministic, and this (ids, index) choice
+    splits primary ownership across every node — so fan-out (and with it
+    the coalescer and the fan-out pool) is exercised from node 0 on every
+    run, not only when random uuids happen to land shards remotely."""
+    from pilosa_tpu.server import Server
+    servers = [Server(str(tmp / f"n{i}"), port=0, replica_n=replica_n,
+                      node_id=chr(ord("a") + i)).open()
+               for i in range(n_nodes)]
+    uris = [s.uri for s in servers]
+    for s in servers:
+        s.cluster_hosts = uris
+        s.refresh_membership()
+    rng = np.random.default_rng(61)
+    sets = {}
+    u = uris[0]
+    jpost(u, "/index/i", {})
+    jpost(u, "/index/i/field/f", {})
+    # rows drawn from a shared universe so intersections/differences are
+    # substantial (independent sparse draws over n_shards*2^20 columns
+    # would make every corpus model trivially ~0)
+    universe = rng.choice(n_shards * SW, 2400, replace=False)
+    row_ids, col_ids = [], []
+    for row in range(3):
+        cols = np.unique(rng.choice(universe, 1200))
+        sets[row] = set(int(c) for c in cols)
+        row_ids += [row] * cols.size
+        col_ids += cols.tolist()
+    jpost(u, "/index/i/field/f/import",
+          {"rowIDs": row_ids, "columnIDs": col_ids})
+    jpost(u, "/recalculate-caches")
+    # wait until every node answers the cross-shard count correctly
+    # (create-shard announcements are async)
+    expect = len(sets[0] & sets[1])
+    assert expect > 100  # the corpus models must be non-trivial
+    q = b"Count(Intersect(Row(f=0), Row(f=1)))"
+    deadline = time.monotonic() + 30
+    for uri in uris:
+        while True:
+            out = jpost(uri, "/index/i/query", raw=q)
+            if out["results"][0] == expect:
+                break
+            assert time.monotonic() < deadline, (uri, out, expect)
+            time.sleep(0.2)
+    return servers, uris, sets
+
+
+def _topn3_model(sets):
+    # n = the full row count: per-node phase-1 truncation (distributed
+    # TopN is approximate when n < rows, like the reference) cannot bite,
+    # so the assertion is deterministic on every topology
+    best = sorted(((len(cs), -r) for r, cs in sets.items()),
+                  reverse=True)[:3]
+    return [{"id": -nr, "count": c} for c, nr in best]
+
+
+CORPUS = [
+    ("Count(Intersect(Row(f=0), Row(f=1)))", lambda s: len(s[0] & s[1])),
+    ("Count(Union(Row(f=0), Row(f=2)))", lambda s: len(s[0] | s[2])),
+    ("Count(Difference(Row(f=1), Row(f=2)))", lambda s: len(s[1] - s[2])),
+    ("Count(Xor(Row(f=0), Row(f=2)))", lambda s: len(s[0] ^ s[2])),
+    ("TopN(f, n=3)", _topn3_model),
+]
+
+
+def _check_corpus(uri, sets, threads=8, rounds=2):
+    """Concurrent corpus queries — concurrency forces envelope traffic."""
+    def go(i):
+        for _ in range(rounds):
+            for pql, model in CORPUS:
+                out = jpost(uri, "/index/i/query", raw=pql.encode())
+                assert out["results"][0] == model(sets), pql
+    _run_threads(threads, go)
+
+
+@pytest.fixture(scope="module")
+def pair(tmp_path_factory):
+    """2-node replica_n=2 cluster: every shard lives on both nodes, so
+    every node batch has a hedge candidate (the local slice)."""
+    tmp = tmp_path_factory.mktemp("coalpair")
+    servers, uris, sets = _build_cluster(tmp, 2, 2)
+    yield servers, uris, sets
+    for s in servers:
+        s.close()
+
+
+# ------------------------------------------------- integration: coalescing
+
+
+def test_coalesced_cluster_answers_match_models(pair):
+    servers, uris, sets = pair
+    coal = servers[0].executor.coalescer
+    assert coal is not None and coal.enabled
+    b0 = coal.snapshot()
+    _check_corpus(uris[0], sets)
+    b1 = coal.snapshot()
+    # fan-out traffic actually rode the envelope route
+    assert b1["batches"] > b0["batches"]
+    assert b1["batched_queries"] > b0["batched_queries"]
+
+
+def test_persistent_fanout_pool_is_reused_across_queries(pair):
+    servers, uris, sets = pair
+    ex = servers[0].executor
+    jpost(uris[0], "/index/i/query", raw=b"Count(Row(f=0))")
+    pool = ex._fanout_pool
+    assert pool is not None  # created lazily by the first distributed query
+    jpost(uris[0], "/index/i/query", raw=b"Count(Row(f=1))")
+    assert ex._fanout_pool is pool  # no per-query executor churn
+
+
+def test_trace_id_propagates_through_coalesced_fanout(pair):
+    servers, uris, sets = pair
+    seen = []
+    orig = servers[1].handler.dispatch
+
+    def spy(method, path, query, body, headers=None):
+        if path == "/internal/query-batch":
+            seen.append((headers or {}).get("X-Pilosa-Trace-Id"))
+        return orig(method, path, query, body, headers=headers)
+
+    servers[1].handler.dispatch = spy
+    try:
+        req = urllib.request.Request(
+            uris[0] + "/index/i/query", data=b"Count(Row(f=0))",
+            method="POST", headers={"X-Pilosa-Trace-Id": "trace-xyz"})
+        with urllib.request.urlopen(req, timeout=30) as r:
+            json.loads(r.read())
+        deadline = time.monotonic() + 5
+        while not seen and time.monotonic() < deadline:
+            time.sleep(0.02)
+        # the fan-out pool thread ran in a copied context: the envelope
+        # carried the caller's trace id, not a fresh one
+        assert "trace-xyz" in seen, seen
+    finally:
+        servers[1].handler.dispatch = orig
+
+
+# ------------------------------------------- integration: mixed-version 404
+
+
+def test_mixed_version_cluster_falls_back_per_query(tmp_path):
+    servers, uris, sets = _build_cluster(tmp_path, 3, 1)
+    try:
+        # node 1 "predates" the batch route: 404 like an old binary
+        servers[1].handler.post_query_batch = \
+            lambda params, query, body: (404, "application/json",
+                                         b'{"error": "not found"}')
+        coal0 = servers[0].executor.coalescer
+        _check_corpus(uris[0], sets, threads=6, rounds=2)
+        # every corpus query answered correctly from every node
+        for uri in uris:
+            for pql, model in CORPUS:
+                out = jpost(uri, "/index/i/query", raw=pql.encode())
+                assert out["results"][0] == model(sets), (uri, pql)
+        snap = coal0.snapshot()
+        # the 404 node was detected and is now served per-query
+        assert snap["legacy_nodes"] >= 1 or snap["fallback_queries"] > 0
+    finally:
+        for s in servers:
+            s.close()
+
+
+# ------------------------------------------- integration: mid-batch death
+
+
+def test_mid_batch_node_death_fails_over_per_shard(tmp_path):
+    servers, uris, sets = _build_cluster(tmp_path, 3, 2)
+    try:
+        # kill node 2's HTTP surface abruptly (the process "dies"); the
+        # cluster still routes to it, so in-flight envelopes fail with
+        # ClientError and every waiter re-maps its shards onto surviving
+        # replicas — exactly the per-query failover contract
+        servers[2].http.close()
+        _check_corpus(uris[0], sets, threads=6, rounds=1)
+        for pql, model in CORPUS:
+            out = jpost(uris[0], "/index/i/query", raw=pql.encode())
+            assert out["results"][0] == model(sets), pql
+    finally:
+        for s in servers:
+            s.close()
+
+
+# ------------------------------------------------ integration: hedged reads
+
+
+def _slow_node(server, delay):
+    """Make a node's query surfaces slow (both the per-query route and the
+    batch envelope); returns a restore function."""
+    h = server.handler
+    orig_q, orig_b = h.post_query, h.post_query_batch
+
+    def slow_q(params, query, body):
+        time.sleep(delay)
+        return orig_q(params, query, body)
+
+    def slow_b(params, query, body):
+        time.sleep(delay)
+        return orig_b(params, query, body)
+
+    h.post_query, h.post_query_batch = slow_q, slow_b
+
+    def restore():
+        h.post_query, h.post_query_batch = orig_q, orig_b
+
+    return restore
+
+
+def test_hedge_wins_over_slow_replica_without_double_counting(pair):
+    servers, uris, sets = pair
+    ex = servers[0].executor
+    restore = _slow_node(servers[1], 0.6)
+    ex.hedge_delay = 0.05
+    fired0, won0 = ex.hedges_fired, ex.hedges_won
+    try:
+        expect = len(sets[0] & sets[1])
+        t0 = time.perf_counter()
+        out = jpost(uris[0], "/index/i/query",
+                    raw=b"Count(Intersect(Row(f=0), Row(f=1)))")
+        elapsed = time.perf_counter() - t0
+        # the hedge (local replica) won, the count is exact — the slow
+        # primary's eventual response was discarded, not added
+        assert out["results"][0] == expect, out
+        assert elapsed < 0.55, elapsed
+        assert ex.hedges_fired > fired0
+        assert ex.hedges_won > won0
+    finally:
+        ex.hedge_delay = 0.0
+        restore()
+
+
+def test_writes_are_never_hedged_or_coalesced(pair):
+    """Fuzz-style sweep over every write call: with hedging enabled and a
+    coalescer installed, no write ever rides the batch envelope and no
+    write is ever hedged (a hedge IS a re-send; net/client.py:70-95)."""
+    servers, uris, sets = pair
+    ex = servers[0].executor
+    coal = ex.coalescer
+    rng = np.random.default_rng(7)
+    coalesced_pqls = []
+    orig_query = coal.query
+
+    def spy(uri, index, pql, shards=None):
+        coalesced_pqls.append(pql)
+        return orig_query(uri, index, pql, shards=shards)
+
+    coal.query = spy
+    ex.hedge_delay = 0.001  # aggressively hedge-eligible, were writes reads
+    fired0 = ex.hedges_fired
+    try:
+        writes = []
+        for _ in range(3):
+            col = int(rng.integers(0, 2 * SW))
+            row = int(rng.integers(0, 3))
+            writes += [
+                f"Set({col}, f={row})",
+                f"Clear({col}, f={row})",
+                f"Store(Row(f={row}), f=9)",
+                "ClearRow(f=9)",
+                f"SetRowAttrs(f, {row}, hot=true)",
+                f"SetColumnAttrs({col}, note=\"x\")",
+            ]
+        for pql in writes:
+            jpost(uris[0], "/index/i/query", raw=pql.encode())
+        assert ex.hedges_fired == fired0  # no write ever hedged
+        for pql in coalesced_pqls:  # no write ever rode an envelope
+            for w in ex.WRITE_CALLS:
+                assert not pql.startswith(w), pql
+        # and the writes landed exactly once: a fresh Set is visible with
+        # count +1 from every node (no duplicate side effects). The column
+        # lives past the imported shard range, so it cannot collide with
+        # fixture data
+        col = 7 * SW + 4242
+        base = jpost(uris[0], "/index/i/query",
+                     raw=b"Count(Row(f=0))")["results"][0]
+        jpost(uris[0], "/index/i/query", raw=f"Set({col}, f=0)".encode())
+        for uri in uris:
+            got = jpost(uri, "/index/i/query",
+                        raw=b"Count(Row(f=0))")["results"][0]
+            assert got == base + 1, (uri, got, base)
+    finally:
+        ex.hedge_delay = 0.0
+        coal.query = orig_query
+
+
+# --------------------------------------------------------- observability
+
+
+def test_debug_vars_expose_coalesce_and_hedge_metrics(pair):
+    servers, uris, sets = pair
+    jpost(uris[0], "/index/i/query", raw=b"Count(Row(f=0))")
+    with urllib.request.urlopen(uris[0] + "/debug/vars", timeout=10) as r:
+        dv = json.loads(r.read())
+    assert "netCoalesce" in dv
+    for k in ("batches", "batched_queries", "netCoalesceBatchSize",
+              "mean_coalesce_factor", "deduped_queries",
+              "fallback_queries"):
+        assert k in dv["netCoalesce"], k
+    assert set(dv["hedges"]) == {"hedgesFired", "hedgesWon",
+                                 "hedgesCancelled"}
+    # per-node fan-out latency histogram: a timing entry per remote node
+    # with log2 buckets
+    fanout = {k: v for k, v in dv.get("timings", {}).items()
+              if k.startswith("fanoutLatency/")}
+    assert fanout, dv.get("timings", {}).keys()
+    for entry in fanout.values():
+        assert entry["count"] >= 1
+        assert entry["buckets"], entry
