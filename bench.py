@@ -816,6 +816,90 @@ def bench_http(tmpdir) -> dict:
         srv.close()
 
 
+PROFILER_ROUNDS = int(os.environ.get("PILOSA_BENCH_PROFILER_ROUNDS", "5"))
+PROFILER_QUERIES = int(os.environ.get("PILOSA_BENCH_PROFILER_QUERIES", "60"))
+
+
+def bench_profiler(tmpdir) -> dict:
+    """Profiler overhead A/B: the distributed query profiler must add
+    ~zero overhead when disabled (the nop fast path: one ContextVar.get
+    per instrumentation site) and bounded overhead when on. Protocol:
+    one server, warm residency, interleaved off/on rounds of keep-alive
+    Count queries (the shared host drifts; per-round ratios are the
+    honest signal, the median ratio the headline). `profile_mode=off`
+    takes the identical code path a pre-profiler binary took minus the
+    per-site None-checks, so `median_ms_profile_off` vs the http stage's
+    single-stream number (same query shape, same protocol) bounds the
+    disabled-path cost; `overhead_on_vs_off_pct` is the full cost of
+    recording a profile."""
+    import http.client
+    import statistics
+
+    from pilosa_tpu.server import Server
+
+    srv = Server(os.path.join(tmpdir, "prof"), port=0).open()
+    try:
+        host = srv.uri.split("//", 1)[1]
+        conn = http.client.HTTPConnection(host, timeout=60)
+
+        def post(path, body):
+            conn.request("POST", path, body=body)
+            resp = conn.getresponse()
+            out = resp.read()
+            if resp.status != 200:
+                raise RuntimeError(f"{path}: {resp.status}: {out[:200]}")
+            return json.loads(out)
+
+        post("/index/p", b"{}")
+        post("/index/p/field/f", b"{}")
+        rng = np.random.default_rng(23)
+        cols = rng.choice(4 * SHARD_WIDTH, size=100_000, replace=False)
+        half = len(cols) // 2
+        post("/index/p/field/f/import", json.dumps({
+            "rowIDs": [0] * half + [1] * (len(cols) - half),
+            "columnIDs": cols.tolist()}).encode())
+        q = b"Count(Intersect(Row(f=0), Row(f=1)))"
+        for _ in range(5):
+            post("/index/p/query", q)  # warm residency + compile
+
+        def median_ms(mode: str) -> float:
+            srv.api.profile_mode = mode
+            lats = []
+            for _ in range(PROFILER_QUERIES):
+                t0 = time.perf_counter()
+                post("/index/p/query", q)
+                lats.append((time.perf_counter() - t0) * 1e3)
+            return statistics.median(lats)
+
+        rounds = []
+        for _ in range(PROFILER_ROUNDS):
+            rnd = {"ms_off": round(median_ms("off"), 4),
+                   "ms_on": round(median_ms("on"), 4)}
+            rnd["overhead_pct"] = round(
+                100.0 * (rnd["ms_on"] / rnd["ms_off"] - 1.0), 2) \
+                if rnd["ms_off"] else 0.0
+            rounds.append(rnd)
+        srv.api.profile_mode = "auto"
+        med_off = statistics.median(r["ms_off"] for r in rounds)
+        med_on = statistics.median(r["ms_on"] for r in rounds)
+        overheads = sorted(r["overhead_pct"] for r in rounds)
+        return {
+            "metric": "profiler_overhead_pct",
+            "value": overheads[len(overheads) // 2],
+            "unit": "% (profile on vs off, median latency)",
+            "median_ms_profile_off": round(med_off, 4),
+            "median_ms_profile_on": round(med_on, 4),
+            "rounds": rounds,
+            "vs_baseline": 0.0,
+            "path": "single-stream keep-alive Count(Intersect) loopback, "
+                    "interleaved profile_mode=off/on rounds; off = the nop "
+                    "fast path (one ContextVar.get per site), on = full "
+                    "QueryProfile recording incl. dispatch attribution",
+        }
+    finally:
+        srv.close()
+
+
 DIST_SHARDS = 16
 DIST_NODES = int(os.environ.get("PILOSA_BENCH_DIST_NODES", "3"))
 DIST_THREADS = 8
@@ -1127,6 +1211,7 @@ def worker() -> None:
         staged("bsi", lambda: (ex, build_bsi_index(holder)), bench_bsi)
         holder.close()
         stage("http", bench_http, tmp)
+        stage("profiler", bench_profiler, tmp)
         stage("distributed", bench_distributed, tmp)
     finally:
         shutil.rmtree(tmp, ignore_errors=True)

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import csv
 import io
+import os
 from datetime import datetime, timezone
 from typing import Optional
 
@@ -34,6 +35,8 @@ from pilosa_tpu.parallel.cluster import (
     STATE_STARTING,
     Cluster,
 )
+from pilosa_tpu.utils import profile as qprofile
+from pilosa_tpu.utils import tracing
 from pilosa_tpu.utils.translate import TranslateStore
 
 
@@ -103,6 +106,20 @@ class API:
         self.long_query_time = 0.0
         self.max_writes_per_request = 5000  # server/config.go:47 default
         self.logger = None
+        # distributed query profiler (utils/profile.py). Modes:
+        #   "off"  — never profile (even ?profile=true returns no tree)
+        #   "auto" — profile when the request asks (?profile=true /
+        #            QueryRequest.Profile) or when long-query-time is set
+        #            (so the slow-query history carries full profiles)
+        #   "on"   — profile every query
+        # PILOSA_TPU_PROFILE=0 is the kill switch over any mode.
+        self.profile_mode = "auto"
+        self._profile_killed = os.environ.get(
+            "PILOSA_TPU_PROFILE", "1") == "0"
+        # structured slow-query ring (GET /debug/query-history): replaces
+        # the one-line printf as the operator surface; size is the
+        # [cluster] query-history-size knob
+        self.query_history = qprofile.QueryHistory(100)
 
     def _broadcast(self, msg: dict) -> None:
         if self.broadcast_fn is not None:
@@ -119,15 +136,31 @@ class API:
 
     # -- queries ------------------------------------------------------------
 
+    def _should_profile(self, explicit: bool) -> bool:
+        """Whether this query gets a QueryProfile (see profile_mode)."""
+        if self._profile_killed or self.profile_mode == "off":
+            return False
+        if self.profile_mode == "on":
+            return True
+        return explicit or self.long_query_time > 0
+
     def query_results(self, index_name: str, pql: str,
                       shards: Optional[list[int]] = None,
                       remote: bool = False,
                       exclude_row_attrs: bool = False,
-                      exclude_columns: bool = False) -> list:
+                      exclude_columns: bool = False,
+                      profile: bool = False) -> list:
         """Execute PQL and return raw result objects (Row/Pairs/ValCount/...).
 
         Both wire writers consume this: query() renders JSON, the protobuf
         path encodes with encoding.protobuf.Serializer (api.Query, api.go:102).
+
+        `profile=True` (the ?profile=true / QueryRequest.Profile request
+        flag) asks for a QueryProfile; whether one is recorded also depends
+        on profile_mode. The finished profile is published through
+        `utils.profile.last_profile` (same context, so the calling handler
+        reads it after return without a return-type change), and queries
+        over long-query-time land in `query_history` with it attached.
         """
         self._validate("query")
         index = self.holder.index(index_name)
@@ -153,6 +186,24 @@ class API:
                     f"too many writes in a single request: {writes} > "
                     f"{self.max_writes_per_request}")
         import time as _time
+        profiling = self._should_profile(profile)
+        slow_armed = self.long_query_time > 0
+        trace_tok = None
+        if ((profiling or slow_armed)
+                and tracing.current_trace_id.get() is None):
+            # mint one trace id for the whole request so the slow-query
+            # log line, /debug/query-history and exported spans (local AND
+            # remote — the id fans out via X-Pilosa-Trace-Id) all join;
+            # without it each span mints its own and nothing correlates
+            trace_tok = tracing.current_trace_id.set(tracing.new_trace_id())
+        prof = None
+        prof_tok = None
+        if profiling and qprofile.current_profile.get() is None:
+            prof = qprofile.QueryProfile(
+                trace_id=tracing.current_trace_id.get() or "",
+                node_id=self.cluster.local_id, index=index_name,
+                pql=qprofile.truncate_pql(pql))
+            prof_tok = qprofile.current_profile.set(prof)
         start = _time.perf_counter()
         try:
             results = self.executor.execute(index_name, query, shards=shards,
@@ -172,31 +223,59 @@ class API:
             raise ApiError(str(e))
         finally:
             elapsed = _time.perf_counter() - start
-            if (self.long_query_time > 0 and elapsed > self.long_query_time
-                    and self.logger is not None):
-                self.logger.printf("%.3fs SLOW QUERY %s %s",
-                                   elapsed, index_name, pql)
+            if prof_tok is not None:
+                qprofile.current_profile.reset(prof_tok)
+            if prof is not None:
+                prof.finish()
+            qprofile.last_profile.set(prof)
+            if slow_armed and elapsed > self.long_query_time:
+                trace_id = tracing.current_trace_id.get() or "-"
+                short_pql = qprofile.truncate_pql(pql)
+                self.query_history.append({
+                    "time": datetime.now(timezone.utc).isoformat(),
+                    "index": index_name,
+                    "pql": short_pql,
+                    "elapsed": round(elapsed, 6),
+                    "traceId": trace_id,
+                    "profile": prof.to_dict() if prof is not None else None,
+                })
+                if self.logger is not None:
+                    # truncated PQL (an import-sized query must not flood
+                    # the log) + trace= so the line joins to
+                    # /debug/query-history and exported spans
+                    self.logger.printf("%.3fs SLOW QUERY %s %s trace=%s",
+                                       elapsed, index_name, short_pql,
+                                       trace_id)
+            if trace_tok is not None:
+                tracing.current_trace_id.reset(trace_tok)
 
     def query(self, index_name: str, pql: str,
               shards: Optional[list[int]] = None, remote: bool = False,
               column_attrs: bool = False,
               exclude_row_attrs: bool = False,
-              exclude_columns: bool = False) -> dict:
+              exclude_columns: bool = False,
+              profile: bool = False) -> dict:
         """POST /index/{index}/query (api.Query, api.go:102)."""
         results = self.query_results(index_name, pql, shards=shards,
                                      remote=remote,
                                      exclude_row_attrs=exclude_row_attrs,
-                                     exclude_columns=exclude_columns)
+                                     exclude_columns=exclude_columns,
+                                     profile=profile)
         index = self.holder.index(index_name)
         out = {"results": [self._result_to_json(index, r) for r in results]}
         if column_attrs:
             out["columnAttrSets"] = self.column_attr_sets(index_name, results)
+        if profile:
+            prof = qprofile.last_profile.get()
+            if prof is not None:
+                out["profile"] = prof.to_dict()
         return out
 
     def query_batch(self, entries: list[dict]) -> list[tuple]:
         """Execute a coalesced fan-out envelope (POST /internal/query-batch,
         net/coalesce.py): N read-only query entries, answered in order as
-        (results, err) pairs. Entries run through query_results — the same
+        (results, err[, profile]) tuples (profile = this node's
+        QueryProfile fragment dict when the entry asked for one). Entries run through query_results — the same
         validation/translation path as the per-query route — but
         CONCURRENTLY on the executor's inbound batch pool, so the
         envelope's device dispatches coalesce in CountBatcher /
@@ -214,6 +293,7 @@ class API:
 
         def one(e: dict) -> tuple:
             dl_token = None
+            tr_token = None
             try:
                 timeout = e.get("timeout")
                 if timeout is not None:
@@ -225,16 +305,31 @@ class API:
                     cur = qctx.deadline.get()
                     dl_token = qctx.deadline.set(
                         entry_dl if cur is None else min(entry_dl, cur))
-                query = parse_string_cached(e.get("query", ""))
+                trace_id = e.get("traceId")
+                if trace_id:
+                    # per-entry trace context (the deadline's twin): the
+                    # envelope leader's header carried ITS trace id, but
+                    # each coalesced caller's spans must join the caller's
+                    # own trace, not the leader's
+                    tr_token = tracing.current_trace_id.set(str(trace_id))
+                pql = e.get("query", "")
+                query = parse_string_cached(pql)
                 for c in query.calls:
                     inner = (c.children[0]
                              if c.name == "Options" and c.children else c)
                     if inner.name in self.executor.WRITE_CALLS:
                         return (None, f"{inner.name}() cannot ride a "
                                       "coalesced query batch (not idempotent)")
-                return (self.query_results(
-                    e.get("index", ""), query, shards=e.get("shards"),
-                    remote=bool(e.get("remote", True))), "")
+                want_prof = bool(e.get("profile"))
+                # pass the RAW string (re-parse is a cache hit): profiles,
+                # history entries and slow-log lines must show the PQL the
+                # coordinator sent, not a parsed Query repr
+                results = self.query_results(
+                    e.get("index", ""), pql, shards=e.get("shards"),
+                    remote=bool(e.get("remote", True)), profile=want_prof)
+                prof = qprofile.last_profile.get() if want_prof else None
+                return (results, "",
+                        prof.to_dict() if prof is not None else None)
             except qctx.QueryTimeoutError as exc:
                 return (None, str(exc) or "query deadline exceeded")
             except (ApiError, ValueError) as exc:
@@ -244,6 +339,8 @@ class API:
             finally:
                 if dl_token is not None:
                     qctx.deadline.reset(dl_token)
+                if tr_token is not None:
+                    tracing.current_trace_id.reset(tr_token)
 
         if len(entries) <= 1:
             return [one(e) for e in entries]

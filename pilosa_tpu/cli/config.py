@@ -60,6 +60,14 @@ class ClusterConfig:
     fanout_coalesce_window: float = 0.002
     fanout_coalesce_max_batch: int = 64
     hedge_delay: float = 0.0
+    # distributed query profiler (utils/profile.py): "off" never profiles,
+    # "auto" (default) profiles when a request asks (?profile=true) or
+    # when long-query-time is set (so /debug/query-history carries full
+    # profile trees), "on" profiles every query. PILOSA_TPU_PROFILE=0 is
+    # the env kill switch over any mode.
+    profile: str = "auto"
+    # slow-query ring size served at GET /debug/query-history
+    query_history_size: int = 100
 
 
 @dataclass
@@ -215,6 +223,8 @@ class Config:
             f"fanout-coalesce-window = {self.cluster.fanout_coalesce_window}",
             f"fanout-coalesce-max-batch = {self.cluster.fanout_coalesce_max_batch}",
             f"hedge-delay = {self.cluster.hedge_delay}",
+            f'profile = "{self.cluster.profile}"',
+            f"query-history-size = {self.cluster.query_history_size}",
             "",
             "[anti-entropy]",
             f"interval = {self.anti_entropy.interval}",

@@ -136,6 +136,8 @@ def cmd_server(args) -> int:
         fanout_coalesce_window=cfg.cluster.fanout_coalesce_window,
         fanout_coalesce_max_batch=cfg.cluster.fanout_coalesce_max_batch,
         hedge_delay=cfg.cluster.hedge_delay,
+        profile_mode=cfg.cluster.profile,
+        query_history_size=cfg.cluster.query_history_size,
         max_writes_per_request=cfg.max_writes_per_request,
         metric_service=cfg.metric.service,
         metric_host=cfg.metric.host,

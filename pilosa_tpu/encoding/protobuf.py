@@ -163,8 +163,10 @@ class Serializer:
 
     def encode_query_request(self, pql: str, shards: Optional[list[int]] = None,
                              remote: bool = False,
-                             column_attrs: bool = False) -> bytes:
-        m = pb.QueryRequest(Query=pql, Remote=remote, ColumnAttrs=column_attrs)
+                             column_attrs: bool = False,
+                             profile: bool = False) -> bytes:
+        m = pb.QueryRequest(Query=pql, Remote=remote, ColumnAttrs=column_attrs,
+                            Profile=profile)
         if shards:
             m.Shards.extend(shards)
         return m.SerializeToString()
@@ -175,23 +177,37 @@ class Serializer:
         return {"query": m.Query, "shards": list(m.Shards) or None,
                 "remote": m.Remote, "columnAttrs": m.ColumnAttrs,
                 "excludeRowAttrs": m.ExcludeRowAttrs,
-                "excludeColumns": m.ExcludeColumns}
+                "excludeColumns": m.ExcludeColumns,
+                "profile": m.Profile}
 
     def encode_query_response(self, results: list, err: str = "",
-                              column_attr_sets=None) -> bytes:
+                              column_attr_sets=None,
+                              profile: Optional[dict] = None) -> bytes:
         m = pb.QueryResponse(Err=err)
         m.Results.extend(_encode_result(r) for r in results)
         for cas in column_attr_sets or []:
             c = pb.ColumnAttrSet(ID=int(cas["id"]), Key=cas.get("key", ""))
             c.Attrs.extend(_encode_attrs(cas.get("attrs", {})))
             m.ColumnAttrSets.append(c)
+        if profile is not None:
+            # JSON inside the proto field: the fragment schema (see
+            # utils/profile.py to_dict) evolves without descriptor bumps,
+            # and an absent field decodes as b"" -> no fragment (legacy)
+            m.Profile = json.dumps(profile).encode()
         return m.SerializeToString()
 
     def decode_query_response(self, data: bytes) -> dict:
         m = pb.QueryResponse()
         m.ParseFromString(data)
+        profile = None
+        if m.Profile:
+            try:
+                profile = json.loads(m.Profile)
+            except ValueError:
+                profile = None  # mangled fragment must never fail the query
         return {"err": m.Err,
                 "results": [decode_result(r) for r in m.Results],
+                "profile": profile,
                 "columnAttrSets": [
                     {"id": c.ID, "attrs": _decode_attrs(c.Attrs),
                      **({"key": c.Key} if c.Key else {})}
@@ -215,6 +231,13 @@ class Serializer:
                 entry["shards"] = [int(s) for s in e["shards"]]
             if e.get("timeout") is not None:
                 entry["timeout"] = float(e["timeout"])
+            if e.get("traceId"):
+                # per-entry trace context (mirrors the per-entry deadline):
+                # without it, remote spans of a coalesced query start a
+                # fresh trace instead of joining the coordinator's
+                entry["traceId"] = str(e["traceId"])
+            if e.get("profile"):
+                entry["profile"] = True
             out.append(entry)
         return json.dumps({"queries": out}).encode()
 
@@ -229,12 +252,17 @@ class Serializer:
         return queries
 
     def encode_query_batch_response(self, results_or_errs: list) -> bytes:
-        """`results_or_errs`: one (results, err) pair per entry; results
-        may be None when err is set."""
-        resps = [
-            base64.b64encode(
-                self.encode_query_response(results or [], err=err)).decode()
-            for results, err in results_or_errs]
+        """`results_or_errs`: one (results, err) or (results, err, profile)
+        tuple per entry; results may be None when err is set, profile is a
+        JSON-able fragment dict or None (it rides each entry's
+        QueryResponse.Profile slot, so the coalesced path carries the same
+        per-node fragment the per-query path does)."""
+        resps = []
+        for item in results_or_errs:
+            results, err, *rest = item
+            profile = rest[0] if rest else None
+            resps.append(base64.b64encode(self.encode_query_response(
+                results or [], err=err, profile=profile)).decode())
         return json.dumps({"responses": resps}).encode()
 
     def decode_query_batch_response_raw(self, data: bytes) -> list[bytes]:

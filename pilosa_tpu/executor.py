@@ -43,6 +43,7 @@ from pilosa_tpu.parallel.mesh import DeviceRunner
 from pilosa_tpu.pql import Call, Condition, Query, parse_string_cached
 from pilosa_tpu.pql.ast import BETWEEN, EQ, GT, GTE, LT, LTE, NEQ
 from pilosa_tpu.utils import qctx
+from pilosa_tpu.utils import profile as qprofile
 
 WORDS = SHARD_WIDTH // 32
 
@@ -270,9 +271,11 @@ class Executor:
                     if timeout else None)
         try:
             results = []
+            prof = qprofile.current_profile.get()  # None = profiling off
             for call in query.calls:
                 qctx.check()
                 self.stats.count(f"query/{call.name}")
+                t_call = _time.perf_counter() if prof is not None else 0.0
                 with self.tracer.start_span(f"executor.{call.name}") as span:
                     if distributed:
                         result = self._execute_distributed(index, call, shards)
@@ -285,6 +288,9 @@ class Executor:
                         result = self._translate_result(index, call, result)
                     results.append(result)
                     span.set_tag("index", index_name)
+                if prof is not None:
+                    prof.record_call(
+                        call.name, (_time.perf_counter() - t_call) * 1e3)
             return results
         finally:
             if dl_token is not None:
@@ -1515,8 +1521,16 @@ class Executor:
         Returns a list of partials."""
         from pilosa_tpu.net.client import ClientError
         qctx.check()  # abort between node batches (executor.go:2591)
+        prof = qprofile.current_profile.get()
         if node_id == self.cluster.local_id:
-            return [self._execute_call(index, call, node_shards)]
+            if prof is None:
+                return [self._execute_call(index, call, node_shards)]
+            import time as _time
+            t0 = _time.perf_counter()
+            out = [self._execute_call(index, call, node_shards)]
+            prof.record_fanout(node_id, len(node_shards),
+                               (_time.perf_counter() - t0) * 1e3, "local")
+            return out
         node = self.cluster.node_by_id(node_id)
         err: Exception | None = None
         if node is not None and node.uri:
@@ -1525,6 +1539,12 @@ class Executor:
                                             excluded)]
             except ClientError as e:
                 err = e
+        if prof is not None:
+            # the batch re-maps shard-by-shard onto replicas below; the
+            # profile keeps the evidence (which node failed, how many
+            # shards had to re-route, why)
+            prof.record_retry(node_id, len(node_shards), str(err or
+                              "node unknown / no uri"))
         # failover: per-shard re-mapping onto surviving replicas
         excluded = excluded | {node_id}
         regroup: dict[str, list[int]] = {}
@@ -1571,25 +1591,37 @@ class Executor:
         return self._timed_node_query(index, call, node, node_shards)
 
     def _timed_node_query(self, index: Index, call: Call, node,
-                          node_shards: list[int]):
+                          node_shards: list[int], hedge: bool = False):
         """The node RPC itself: coalesced into a /internal/query-batch
         envelope when the coalescer is on, per-query query_proto otherwise.
         Wall time feeds the per-node fan-out latency histogram
         (stats timing buckets; /debug/vars) — the signal hedge_delay should
-        be tuned against (docs/operations.md)."""
+        be tuned against (docs/operations.md) — and, when this query is
+        being profiled, a per-shard-group fanout record with the transport
+        actually used (coalesced envelope vs per-query proto)."""
         import time as _time
         t0 = _time.perf_counter()
+        err = ""
+        coalesced = self.coalescer is not None
         try:
-            if self.coalescer is not None:
+            if coalesced:
                 results = self.coalescer.query(
                     node.uri, index.name, call.to_pql(), shards=node_shards)
             else:
                 results = self.client.query_proto(
                     node.uri, index.name, call.to_pql(),
                     shards=node_shards, remote=True)
+        except BaseException as e:
+            err = f"{type(e).__name__}: {e}"
+            raise
         finally:
-            self.stats.timing(f"fanoutLatency/{node.id}",
-                              (_time.perf_counter() - t0) * 1e3)
+            ms = (_time.perf_counter() - t0) * 1e3
+            self.stats.timing(f"fanoutLatency/{node.id}", ms)
+            prof = qprofile.current_profile.get()
+            if prof is not None:
+                prof.record_fanout(node.id, len(node_shards), ms,
+                                   "coalesced" if coalesced else "proto",
+                                   error=err, hedge=hedge)
         return results[0]
 
     def _hedge_candidate(self, index: Index, node, node_shards: list[int],
@@ -1655,13 +1687,28 @@ class Executor:
         with self._hedge_lock:
             self.hedges_fired += 1
         if hedge_node.id == self.cluster.local_id:
-            backup = pool.submit(
-                contextvars.copy_context().run,
-                lambda: self._execute_call(index, call, node_shards))
+            def _local_backup():
+                # timed like _map_node's local branch, so a hedge won by
+                # the local slice still leaves a per-shard-group timing in
+                # the profile (the primary's record may land after the
+                # response seals — the winner's must not be missing)
+                prof = qprofile.current_profile.get()
+                if prof is None:
+                    return self._execute_call(index, call, node_shards)
+                import time as _time
+                t0 = _time.perf_counter()
+                out = self._execute_call(index, call, node_shards)
+                prof.record_fanout(hedge_node.id, len(node_shards),
+                                   (_time.perf_counter() - t0) * 1e3,
+                                   "local", hedge=True)
+                return out
+
+            backup = pool.submit(contextvars.copy_context().run,
+                                 _local_backup)
         else:
             backup = pool.submit(contextvars.copy_context().run,
                                  self._timed_node_query, index, call,
-                                 hedge_node, node_shards)
+                                 hedge_node, node_shards, True)
         racers = [primary, backup]
         done, pending = _fwait(racers, return_when=FIRST_COMPLETED)
         winner = next((f for f in done if f.exception() is None), None)
@@ -1678,6 +1725,9 @@ class Executor:
             if not loser.done():
                 loser.cancel()  # drops it if still queued; else discarded
                 self.hedges_cancelled += 1
+        prof = qprofile.current_profile.get()
+        if prof is not None:
+            prof.record_hedge(node.id, hedge_node.id, won=winner is backup)
         return winner.result()
 
     def _execute_write_distributed(self, index: Index, call: Call, shards):

@@ -18,6 +18,7 @@ import urllib.parse
 from typing import Optional
 
 from pilosa_tpu.utils import qctx, tracing
+from pilosa_tpu.utils import profile as qprofile
 
 
 class ClientError(Exception):
@@ -158,15 +159,26 @@ class InternalClient:
                     remote: bool = False) -> list:
         """Remote query over the protobuf wire codec; returns raw decoded
         result objects (the reference's internal fan-out path — remoteExec
-        sends QueryRequest protobuf, executor.go:2142-2159)."""
+        sends QueryRequest protobuf, executor.go:2142-2159).
+
+        When the calling query is being profiled (utils/profile.py
+        contextvar — fan-out pool threads run in copied contexts, so it is
+        readable here), the request sets QueryRequest.Profile and the
+        peer's QueryResponse.Profile fragment is grafted onto the caller's
+        profile tree. A legacy peer ignores the flag and returns no
+        fragment — the tree just lacks that child."""
         from pilosa_tpu.encoding.protobuf import CONTENT_TYPE, Serializer
         s = Serializer()
-        body = s.encode_query_request(pql, shards=shards, remote=remote)
+        prof = qprofile.current_profile.get()
+        body = s.encode_query_request(pql, shards=shards, remote=remote,
+                                      profile=prof is not None)
         out = self._request("POST", uri, f"/index/{index}/query", body,
                             CONTENT_TYPE, accept=CONTENT_TYPE)
         resp = s.decode_query_response(out)
         if resp["err"]:
             raise ClientError(f"remote query: {resp['err']}")
+        if prof is not None and resp.get("profile"):
+            prof.add_remote_fragment(uri, resp["profile"])
         return resp["results"]
 
     def query_batch(self, uri: str, entries: list[dict]) -> list[dict]:

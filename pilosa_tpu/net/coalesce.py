@@ -40,7 +40,8 @@ from typing import Optional
 
 from pilosa_tpu.net.client import ClientError
 from pilosa_tpu.parallel.batcher import ContinuousBatcher
-from pilosa_tpu.utils import qctx
+from pilosa_tpu.utils import qctx, tracing
+from pilosa_tpu.utils import profile as qprofile
 
 # per-waiter sentinel: the destination 404'd the batch route; re-issue
 # this entry per-query on the waiter's own thread (keeps the transitional
@@ -87,15 +88,20 @@ class NodeCoalescer(ContinuousBatcher):
         """One read-only remote query; returns raw decoded results (the
         `query_proto` contract). Concurrent callers to the same `uri`
         coalesce into one envelope. Each entry carries its own caller's
-        remaining deadline, so followers' budgets are not replaced by the
-        leader's."""
+        remaining deadline AND its own trace id (the remote installs it
+        before executing the entry, so remote spans join the caller's
+        trace instead of starting a fresh one), so followers' budgets and
+        trace context are not replaced by the leader's."""
         rem = qctx.remaining()
         if rem is not None and rem <= 0:
             raise qctx.QueryTimeoutError("query deadline exceeded")
         if not self.enabled or self._is_legacy(uri):
             return self.client.query_proto(uri, index, pql, shards=shards,
                                            remote=True)
-        out = self.submit((uri,), (index, pql, shards, rem))
+        prof = qprofile.current_profile.get()
+        out = self.submit((uri,), (index, pql, shards, rem,
+                                   tracing.current_trace_id.get(),
+                                   prof is not None))
         if out is _FALLBACK:
             with self._meta_lock:
                 self.fallback_queries += 1
@@ -103,7 +109,12 @@ class NodeCoalescer(ContinuousBatcher):
                                            remote=True)
         if isinstance(out, ClientError):
             raise out  # per-entry remote error (QueryResponse.Err)
-        return out
+        results, fragment = out
+        if prof is not None and fragment:
+            # grafted on the WAITER's thread, not the envelope leader's:
+            # the leader serves strangers whose profiles it must not touch
+            prof.add_remote_fragment(uri, fragment)
+        return results
 
     # -- in-flight window -------------------------------------------------
 
@@ -146,7 +157,7 @@ class NodeCoalescer(ContinuousBatcher):
         slots: list[int] = []
         uniq: dict[tuple, int] = {}
         entries: list[dict] = []
-        for (i, q, s, rem) in payloads:
+        for (i, q, s, rem, trace_id, want_prof) in payloads:
             k = (i, q, tuple(s) if s is not None else None)
             at = uniq.get(k)
             if at is None:
@@ -154,12 +165,24 @@ class NodeCoalescer(ContinuousBatcher):
                 entries.append(
                     {"index": i, "query": q, "shards": s, "remote": True,
                      **({"timeout": round(rem, 3)} if rem is not None
-                        else {})})
-            elif rem is not None and "timeout" in entries[at]:
-                entries[at]["timeout"] = max(entries[at]["timeout"],
-                                             round(rem, 3))
-            elif "timeout" in entries[at]:
-                del entries[at]["timeout"]  # a no-deadline caller joined
+                        else {}),
+                     # per-entry trace context (the per-entry deadline's
+                     # twin): the remote installs it before executing, so
+                     # its spans join the caller's trace. Deduped
+                     # followers share the FIRST caller's id (one remote
+                     # execution can only belong to one trace).
+                     **({"traceId": trace_id} if trace_id else {}),
+                     **({"profile": True} if want_prof else {})})
+            else:
+                if rem is not None and "timeout" in entries[at]:
+                    entries[at]["timeout"] = max(entries[at]["timeout"],
+                                                 round(rem, 3))
+                elif "timeout" in entries[at]:
+                    del entries[at]["timeout"]  # a no-deadline caller joined
+                if want_prof:
+                    # any profiled dup makes the shared execution profiled
+                    # (unprofiled dups just ignore the fragment)
+                    entries[at]["profile"] = True
             slots.append(at)
         # the send runs with the ENVELOPE's deadline — the loosest of the
         # entries' budgets — not the leader's own: the leader is just
@@ -169,7 +192,7 @@ class NodeCoalescer(ContinuousBatcher):
         # preserved per entry: each carries its own timeout, the remote
         # re-bounds each entry, and every caller's own qctx still applies
         # locally.
-        rems = [rem for (_, _, _, rem) in payloads]
+        rems = [p[3] for p in payloads]
         env_dl = (None if any(r is None for r in rems)
                   else time.monotonic() + max(rems))
         dl_token = qctx.deadline.set(env_dl)
@@ -218,7 +241,10 @@ class NodeCoalescer(ContinuousBatcher):
             if resp["err"]:
                 out.append(ClientError(f"remote query: {resp['err']}"))
             else:
-                out.append(resp["results"])
+                # (results, profile fragment) — query() unpacks on the
+                # waiter's own thread and grafts the fragment onto the
+                # waiter's profile (None/absent for legacy peers)
+                out.append((resp["results"], resp.get("profile")))
         return out
 
     # -- legacy (mixed-version) tracking ----------------------------------

@@ -42,6 +42,8 @@ ROUTES: list[tuple[str, re.Pattern, str]] = [
     ("GET", re.compile(r"^/status$"), "get_status"),
     ("GET", re.compile(r"^/version$"), "get_version"),
     ("GET", re.compile(r"^/debug/vars$"), "get_debug_vars"),
+    ("GET", re.compile(r"^/debug/query-history$"), "get_query_history"),
+    ("GET", re.compile(r"^/metrics$"), "get_metrics"),
     ("GET", re.compile(r"^/debug/pprof(?:/(?P<profile>[^/]*))?$"), "get_debug_pprof"),
     # internal
     ("POST", re.compile(r"^/internal/cluster/message$"), "post_cluster_message"),
@@ -68,7 +70,8 @@ ROUTES: list[tuple[str, re.Pattern, str]] = [
 # applies to routes in the spec).
 ALLOWED_QUERY_ARGS: dict[str, frozenset] = {
     "post_query": frozenset({"shards", "remote", "columnAttrs",
-                             "excludeRowAttrs", "excludeColumns", "timeout"}),
+                             "excludeRowAttrs", "excludeColumns", "timeout",
+                             "profile"}),
     "get_export": frozenset({"index", "field", "shard"}),
     "get_fragment_blocks": frozenset({"index", "field", "view", "shard"}),
     "get_fragment_block_data": frozenset({"index", "field", "view", "shard",
@@ -233,6 +236,7 @@ class Handler:
             column_attrs = bool(req.get("columnAttrs"))
             ex_attrs = bool(req.get("excludeRowAttrs"))
             ex_cols = bool(req.get("excludeColumns"))
+            want_profile = bool(req.get("profile"))
         else:
             shards = self._arg(query, "shards")
             shard_list = [int(s) for s in shards.split(",")] if shards else None
@@ -240,22 +244,33 @@ class Handler:
             column_attrs = self._arg(query, "columnAttrs") in ("1", "true")
             ex_attrs = self._arg(query, "excludeRowAttrs") in ("1", "true")
             ex_cols = self._arg(query, "excludeColumns") in ("1", "true")
+            want_profile = self._arg(query, "profile") in ("1", "true")
             pql = body.decode()
         if self._wants_proto():
             results = self.api.query_results(params["index"], pql,
                                              shards=shard_list, remote=remote,
                                              exclude_row_attrs=ex_attrs,
-                                             exclude_columns=ex_cols)
+                                             exclude_columns=ex_cols,
+                                             profile=want_profile)
             cas = (self.api.column_attr_sets(params["index"], results)
                    if column_attrs else None)
+            prof = None
+            if want_profile:
+                # published by api.query_results in this same context; rides
+                # QueryResponse.Profile (absent for legacy/off — decoders
+                # degrade gracefully)
+                from pilosa_tpu.utils import profile as qprofile
+                got = qprofile.last_profile.get()
+                prof = got.to_dict() if got is not None else None
             payload = self.serializer.encode_query_response(
-                results, column_attr_sets=cas)
+                results, column_attr_sets=cas, profile=prof)
             return 200, PROTO_CONTENT_TYPE, payload
         return self._json(self.api.query(params["index"], pql,
                                          shards=shard_list, remote=remote,
                                          column_attrs=column_attrs,
                                          exclude_row_attrs=ex_attrs,
-                                         exclude_columns=ex_cols))
+                                         exclude_columns=ex_cols,
+                                         profile=want_profile))
 
     def get_indexes(self, params, query, body):
         return self._json(self.api.schema())
@@ -415,6 +430,27 @@ class Handler:
             if vol:
                 snap["volatileFragments"] = vol
         return self._json(snap)
+
+    def get_query_history(self, params, query, body):
+        """Structured slow-query history (the SLOW QUERY printf grown into
+        an operator surface): the last `query-history-size` queries over
+        long-query-time, newest first — trace id, truncated PQL, elapsed
+        seconds, and the full cross-node profile tree when profiling was
+        on for that query (profile_mode auto profiles every query while
+        long-query-time is set, so slow queries normally carry one)."""
+        return self._json({"queries": self.api.query_history.snapshot()})
+
+    def get_metrics(self, params, query, body):
+        """Prometheus text exposition of the StatsClient snapshot
+        (GET /metrics): counters, gauges, set cardinalities, and the log2
+        timing buckets converted to cumulative `_bucket{le=...}` series
+        with `_sum`/`_count` (utils/stats.py prometheus_exposition). The
+        expvar JSON at /debug/vars stays; this is the scrape surface."""
+        from pilosa_tpu.utils.stats import prometheus_exposition
+        snap = self.stats.snapshot() if self.stats is not None else {}
+        body_out = prometheus_exposition(snap)
+        return (200, "text/plain; version=0.0.4; charset=utf-8",
+                body_out.encode())
 
     def get_debug_pprof(self, params, query, body):
         """Runtime profiling surface (/debug/pprof, http/handler.go:242).

@@ -53,6 +53,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from pilosa_tpu.ops.bitvector import popcount
+from pilosa_tpu.utils import profile as qprofile
 
 MAX_BATCH = 512
 _LEGACY = object()  # _dispatch sentinel: subclass only implements _compute
@@ -91,7 +92,7 @@ def _pow2(n: int) -> int:
 
 class _Req:
     __slots__ = ("payload", "event", "result", "exc", "promoted", "done",
-                 "server")
+                 "server", "profile")
 
     def __init__(self, payload):
         self.payload = payload
@@ -101,6 +102,10 @@ class _Req:
         self.promoted = False  # woken to take over leadership, not served
         self.done = False  # result/exc actually delivered (event alone is
         # ambiguous: promotion also sets it)
+        # the submitting query's QueryProfile (or None): dispatch
+        # attribution must be recorded against the SUBMITTER — the batch
+        # is served on a leader thread belonging to a different query
+        self.profile = qprofile.current_profile.get()
         self.server: Optional[threading.Thread] = None  # thread serving the
         # batch this request was popped into (set at the cut; liveness
         # checks must consult it, not the leadership slot — leadership
@@ -241,15 +246,17 @@ class ContinuousBatcher:
                 # slab's key would otherwise linger forever
                 del self._pending[key]
         handle = _FAILED
+        t_cut = time.perf_counter()  # dispatch+finalize wall (attribution)
         if batch:
             try:
                 handle = self._dispatch(key, [r.payload for r in batch])
             except BaseException as e:  # noqa: BLE001 — waiters must wake
                 self._deliver_exc(batch, e)
         if batch and handle is not _FAILED:
-            self._run(key, batch, handle)
+            self._run(key, batch, handle, t_cut)
 
-    def _run(self, key: tuple, batch: list[_Req], handle) -> None:
+    def _run(self, key: tuple, batch: list[_Req], handle,
+             t_cut: Optional[float] = None) -> None:
         try:
             results = self._finalize(key, handle,
                                      [r.payload for r in batch])
@@ -263,6 +270,21 @@ class ContinuousBatcher:
                 self.batches += 1
                 self.batched_queries += len(batch)
                 self.max_batch_seen = max(self.max_batch_seen, len(batch))
+                seq = self.batches
+            if t_cut is not None and any(r.profile is not None
+                                         for r in batch):
+                # dispatch attribution: every profiled co-batched query
+                # learns which dispatch served it, the batch size it
+                # shared, and its wall-time share (utils/profile.py) —
+                # NodeCoalescer envelopes ride this same hook, so the
+                # envelope coalesce factor is the batchSize of a
+                # "NodeCoalescer" dispatch record
+                wall_ms = (time.perf_counter() - t_cut) * 1e3
+                kind = type(self).__name__
+                for r in batch:
+                    if r.profile is not None:
+                        r.profile.record_dispatch(kind, seq, len(batch),
+                                                  wall_ms)
             for r, res in zip(batch, results):
                 r.result = res
                 r.done = True

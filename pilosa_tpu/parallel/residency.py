@@ -24,6 +24,8 @@ from typing import Callable, Optional
 import jax
 import numpy as np
 
+from pilosa_tpu.utils import profile as qprofile
+
 DEFAULT_BUDGET_BYTES = 4 << 30  # half a v5e chip's HBM
 
 
@@ -50,16 +52,28 @@ class DeviceResidency:
         jax.Array already composed on device (e.g. a BSI comparison mask) —
         the latter is cached as-is, avoiding a device->host->device round
         trip."""
+        prof = qprofile.current_profile.get()  # None = profiling off
         with self._lock:
             arr = self._lru.get(key)
             if arr is not None:
                 self._lru.move_to_end(key)
                 self.hits += 1
-                return arr
             epoch = self.epoch
+        if arr is not None:
+            # recorded OUTSIDE the LRU lock: the hit path is the hottest
+            # section in here and must not also serialize on the
+            # profile's own lock while holding it
+            if prof is not None:
+                prof.record_residency(hit=True)
+            return arr
         host = make()
-        arr = host if isinstance(host, jax.Array) else \
-            self.runner.put_leaf(host)
+        uploaded = not isinstance(host, jax.Array)
+        arr = self.runner.put_leaf(host) if uploaded else host
+        if prof is not None:
+            # host->device bytes count only real uploads: a mask already
+            # composed on device (bsicmp results) costs no link transfer
+            prof.record_residency(hit=False,
+                                  nbytes=arr.nbytes if uploaded else 0)
         with self._lock:
             self.misses += 1
             if self.epoch != epoch:

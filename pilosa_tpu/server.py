@@ -69,7 +69,9 @@ class Server:
                  fanout_pool_size: int = 32,
                  fanout_coalesce_window: float = 0.002,
                  fanout_coalesce_max_batch: int = 64,
-                 hedge_delay: float = 0.0):
+                 hedge_delay: float = 0.0,
+                 profile_mode: str = "auto",
+                 query_history_size: int = 100):
         self.data_dir = data_dir
         self.holder = Holder(data_dir)
         self.node_id = node_id or self._load_or_create_id()
@@ -124,6 +126,18 @@ class Server:
                 1, fanout_coalesce_max_batch)
         self.api = API(self.holder, self.cluster, executor=self.executor,
                        translate_store=self.cluster_translate)
+        # distributed query profiler knobs ([cluster] profile /
+        # query-history-size; PILOSA_TPU_PROFILE=0 kill switch is read by
+        # the API itself): mode gates when a QueryProfile is recorded, the
+        # ring holds the /debug/query-history entries
+        if profile_mode not in ("off", "auto", "on"):
+            # a typo'd mode must fail the boot, not silently act as "auto"
+            raise ValueError(
+                f"invalid [cluster] profile mode {profile_mode!r} "
+                "(expected off | auto | on)")
+        self.api.profile_mode = profile_mode
+        from pilosa_tpu.utils.profile import QueryHistory
+        self.api.query_history = QueryHistory(query_history_size)
         self.handler = Handler(self.api, cluster_message_fn=self.receive_message,
                                stats=self.stats, query_timeout=query_timeout)
         self.http = HTTPServer(self.handler, host=host, port=port,

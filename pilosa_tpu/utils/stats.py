@@ -167,6 +167,135 @@ class StatsDClient:
         self._sock.close()
 
 
+_NAME_SANITIZE = None  # compiled lazily (module import stays cheap)
+
+
+def _prom_name(raw: str) -> str:
+    """Legal Prometheus metric-name fragment: [a-zA-Z_:][a-zA-Z0-9_:]*.
+    Illegal runs collapse to "_"; a leading digit gets a "_" prefix."""
+    global _NAME_SANITIZE
+    if _NAME_SANITIZE is None:
+        import re
+        _NAME_SANITIZE = re.compile(r"[^a-zA-Z0-9_:]+")
+    out = _NAME_SANITIZE.sub("_", raw)
+    if not out:
+        return "_"
+    if out[0].isdigit():
+        out = "_" + out
+    return out
+
+
+def _prom_escape(val: str) -> str:
+    """Label-value escaping per the text exposition format."""
+    return val.replace("\\", r"\\").replace("\n", r"\n").replace('"', r'\"')
+
+
+def _split_key(key: str) -> tuple[str, str]:
+    """Stats key -> (family name, label string).
+
+    StatsClient keys are "<name>[,tag,...]" with "/"-namespaced names
+    ("query/Count", "fanoutLatency/<node-id>"). The first "/" segment
+    becomes the family; the remainder rides a `key` label, and each tag
+    becomes `tag="t"` (or `k="v"` for colon-form tags) — so per-node /
+    per-call cardinality lives in labels, not in metric-name explosion."""
+    base, _, tag_part = key.partition(",")
+    labels = []
+    bare: list[str] = []
+    if "/" in base:
+        family, _, rest = base.partition("/")
+        labels.append(f'key="{_prom_escape(rest)}"')
+    else:
+        family = base
+    for tag in [t for t in tag_part.split(",") if t]:
+        k, sep, v = tag.partition(":")
+        if sep:
+            labels.append(f'{_prom_name(k)}="{_prom_escape(v)}"')
+        else:
+            bare.append(tag)
+    if bare:
+        # ONE `tag` label holding all bare tags: repeating a label name
+        # ({tag="a",tag="b"}) is illegal in the exposition format
+        labels.append(f'tag="{_prom_escape(",".join(bare))}"')
+    return _prom_name(family), ("{" + ",".join(labels) + "}") if labels else ""
+
+
+def _bucket_bound(label: str) -> float:
+    """Inverse of _pow2_bucket: "le512" -> 512.0, "le0.25" -> 0.25,
+    "le0" -> 0.0 (the non-positive catch-all)."""
+    return float(label[2:])
+
+
+def prometheus_exposition(snapshot: dict, prefix: str = "pilosa_") -> str:
+    """Render a StatsClient snapshot() as Prometheus text exposition
+    (version 0.0.4): counts -> counters (`_total`), gauges -> gauges,
+    sets -> `_cardinality` gauges, and the log2 `timings` buckets ->
+    proper cumulative histograms (`_bucket{le=...}` + `_sum` + `_count`).
+    Families group across keys so every `# TYPE` line appears once.
+    Conformance (legal names, non-decreasing cumulative buckets,
+    `_count` == the `+Inf` bucket) is pinned by the tier-1 test in
+    tests/test_metrics_conformance.py."""
+    out: list[str] = []
+    seen_types: set[str] = set()
+
+    def emit(family: str, kind: str, samples: list[tuple[str, str, float]]):
+        # samples: (suffix, labels, value)
+        if family not in seen_types:
+            out.append(f"# TYPE {family} {kind}")
+            seen_types.add(family)
+        for suffix, labels, value in samples:
+            if value == int(value):
+                out.append(f"{family}{suffix}{labels} {int(value)}")
+            else:
+                out.append(f"{family}{suffix}{labels} {value}")
+
+    by_family: dict = {}
+    for key, value in sorted(snapshot.get("counts", {}).items()):
+        fam, labels = _split_key(key)
+        by_family.setdefault(prefix + fam + "_total", []).append(
+            ("", labels, float(value)))
+    for fam, samples in by_family.items():
+        emit(fam, "counter", samples)
+
+    by_family = {}
+    for key, value in sorted(snapshot.get("gauges", {}).items()):
+        fam, labels = _split_key(key)
+        by_family.setdefault(prefix + fam, []).append(
+            ("", labels, float(value)))
+    for fam, samples in by_family.items():
+        emit(fam, "gauge", samples)
+
+    by_family = {}
+    for key, members in sorted(snapshot.get("sets", {}).items()):
+        fam, labels = _split_key(key)
+        by_family.setdefault(prefix + fam + "_cardinality", []).append(
+            ("", labels, float(len(members))))
+    for fam, samples in by_family.items():
+        emit(fam, "gauge", samples)
+
+    hist_family: dict = {}
+    for key, t in sorted(snapshot.get("timings", {}).items()):
+        fam, labels = _split_key(key)
+        hist_family.setdefault(prefix + fam, []).append((labels, t))
+    for fam, series in hist_family.items():
+        samples = []
+        for labels, t in series:
+            base_labels = labels[1:-1] if labels else ""  # strip {}
+            cum = 0
+            for blabel in sorted(t.get("buckets", {}), key=_bucket_bound):
+                cum += t["buckets"][blabel]
+                le = f'le="{_bucket_bound(blabel):g}"'
+                lb = "{" + (base_labels + "," if base_labels else "") + le + "}"
+                samples.append(("_bucket", lb, float(cum)))
+            inf = "{" + (base_labels + "," if base_labels else "") \
+                + 'le="+Inf"}'
+            samples.append(("_bucket", inf, float(t["count"])))
+            samples.append(("_sum", labels, float(t["sum"])))
+            samples.append(("_count", labels, float(t["count"])))
+        emit(fam, "histogram", samples)
+
+    return "\n".join(out) + ("\n" if out else "")
+
+
 def new_stats_client(service: str = "expvar", host: str = "127.0.0.1:8125"):
     """metric.service selection (server/server.go:361-374):
     expvar (default, in-memory /debug/vars), statsd (UDP agent), nop."""

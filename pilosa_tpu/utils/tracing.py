@@ -28,6 +28,14 @@ current_trace_id: contextvars.ContextVar[Optional[str]] = contextvars.ContextVar
     "pilosa_trace_id", default=None)
 
 
+def new_trace_id() -> str:
+    """Mint a fresh trace id (same PRNG scheme as Tracer.start_span —
+    uniqueness, not cryptographic strength). Used by the API layer to give
+    an untraced query one id for the whole request, so the slow-query log,
+    /debug/query-history and exported spans all join on it."""
+    return f"{_trace_rng.getrandbits(64):016x}"
+
+
 class Span:
     __slots__ = ("tracer", "name", "trace_id", "start", "end", "tags",
                  "start_wall")

@@ -1,0 +1,228 @@
+"""GET /metrics conformance (tier-1): a minimal Prometheus text-format
+parser scrapes a LIVE server and validates every emitted family — legal
+metric names from arbitrary stats keys, cumulative non-decreasing
+`le` buckets, `_count` == the `+Inf` bucket — so a malformed exposition
+can never ship. Unit tests additionally pin the renderer against
+adversarial stats keys (slashes, colons, tags, unicode)."""
+
+import json
+import re
+import urllib.request
+
+import pytest
+
+from pilosa_tpu.utils.stats import StatsClient, prometheus_exposition
+
+METRIC_NAME = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+SAMPLE_LINE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?:\{(?P<labels>.*)\})? (?P<value>[0-9eE+.\-]+|NaN|\+Inf|-Inf)$")
+LABEL = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+
+def parse_exposition(text: str):
+    """-> (types: {family: type}, samples: [(name, {label: value}, float)]).
+    Raises AssertionError on any malformed line — the conformance core."""
+    types: dict = {}
+    samples: list = []
+    for lineno, line in enumerate(text.splitlines(), 1):
+        if not line.strip():
+            continue
+        if line.startswith("# TYPE "):
+            _, _, rest = line.partition("# TYPE ")
+            fam, _, kind = rest.partition(" ")
+            assert METRIC_NAME.match(fam), f"line {lineno}: bad family {fam!r}"
+            assert kind in ("counter", "gauge", "histogram", "summary",
+                            "untyped"), f"line {lineno}: bad type {kind!r}"
+            assert fam not in types, f"line {lineno}: duplicate TYPE {fam}"
+            types[fam] = kind
+            continue
+        if line.startswith("#"):
+            continue  # HELP / comments
+        m = SAMPLE_LINE.match(line)
+        assert m, f"line {lineno}: unparseable sample {line!r}"
+        assert METRIC_NAME.match(m["name"]), \
+            f"line {lineno}: illegal metric name {m['name']!r}"
+        labels = {}
+        if m["labels"]:
+            consumed = LABEL.findall(m["labels"])
+            # every byte of the label block must belong to a legal pair
+            rebuilt = ",".join(f'{k}="{v}"' for k, v in consumed)
+            assert rebuilt == m["labels"], \
+                f"line {lineno}: malformed labels {m['labels']!r}"
+            labels = dict(consumed)
+        value = float("inf") if m["value"] == "+Inf" else float(m["value"])
+        samples.append((m["name"], labels, value))
+    return types, samples
+
+
+def check_conformance(text: str):
+    """Full family validation; returns (types, samples) for extra asserts."""
+    types, samples = parse_exposition(text)
+    # every sample belongs to a declared family (name or name+suffix)
+    fams = set(types)
+    for name, _, _ in samples:
+        base = name
+        for suffix in ("_bucket", "_sum", "_count"):
+            if name.endswith(suffix) and name[: -len(suffix)] in fams:
+                base = name[: -len(suffix)]
+        assert base in fams, f"sample {name} has no # TYPE"
+    # histograms: per label-series, le buckets cumulative + capped by +Inf
+    hist_fams = [f for f, k in types.items() if k == "histogram"]
+    for fam in hist_fams:
+        series: dict = {}
+        counts: dict = {}
+        for name, labels, value in samples:
+            key = tuple(sorted((k, v) for k, v in labels.items()
+                               if k != "le"))
+            if name == fam + "_bucket":
+                series.setdefault(key, []).append((labels["le"], value))
+            elif name == fam + "_count":
+                counts[key] = value
+        assert series, f"histogram {fam} emitted no buckets"
+        for key, buckets in series.items():
+            bounds = [float("inf") if le == "+Inf" else float(le)
+                      for le, _ in buckets]
+            assert bounds == sorted(bounds), \
+                f"{fam}{key}: le bounds out of order: {bounds}"
+            assert bounds[-1] == float("inf"), f"{fam}{key}: no +Inf bucket"
+            vals = [v for _, v in buckets]
+            assert vals == sorted(vals), \
+                f"{fam}{key}: buckets not cumulative: {vals}"
+            assert key in counts, f"{fam}{key}: missing _count"
+            assert counts[key] == vals[-1], \
+                f"{fam}{key}: _count {counts[key]} != +Inf bucket {vals[-1]}"
+    return types, samples
+
+
+# ------------------------------------------------------------------- unit
+
+
+def test_renderer_sanitizes_hostile_keys():
+    s = StatsClient()
+    s.count("query/Count")  # slash namespacing -> key label
+    s.count("weird name!@#")  # illegal chars collapse
+    s.with_tags("index:idx-1", "bare").count("tagged/x", 3)
+    s.gauge("memory/rss", 123.5)
+    s.set("uniq/things", "a")
+    s.set("uniq/things", "b")
+    s.timing("fanoutLatency/node-id-with-dashes", 0.7)
+    s.timing("fanoutLatency/node-id-with-dashes", 3.0)
+    s.timing("fanoutLatency/node-id-with-dashes", -1.0)  # le0 bucket
+    text = prometheus_exposition(s.snapshot())
+    types, samples = check_conformance(text)
+    assert types["pilosa_query_total"] == "counter"
+    assert ("pilosa_query_total", {"key": "Count"}, 1.0) in samples
+    assert types["pilosa_fanoutLatency"] == "histogram"
+    by_name = {}
+    for name, labels, value in samples:
+        by_name.setdefault(name, []).append((labels, value))
+    assert by_name["pilosa_uniq_cardinality"][0][1] == 2.0
+    # tag forms: colon tags become k="v" labels, bare tags tag="..."
+    tagged = by_name["pilosa_tagged_total"][0][0]
+    assert tagged["index"] == "idx-1" and tagged["tag"] == "bare"
+    # the le0 catch-all renders as le="0" and the cascade stays cumulative
+    les = [lbl["le"] for lbl, _ in by_name["pilosa_fanoutLatency_bucket"]]
+    assert "0" in les and "+Inf" in les
+
+
+def test_multiple_bare_tags_fold_into_one_label():
+    """Repeating a label name ({tag="a",tag="b"}) is illegal exposition;
+    multiple bare tags must fold into one `tag` label."""
+    s = StatsClient().with_tags("a", "b")
+    s.count("multi", 1)
+    s.timing("multi_t", 2.0)
+    text = prometheus_exposition(s.snapshot())
+    types, samples = check_conformance(text)
+    labels = next(lbl for n, lbl, _ in samples
+                  if n == "pilosa_multi_total")
+    assert labels["tag"] == "a,b"
+    # the label block parsed cleanly (no duplicate label names survived
+    # check_conformance's full-consumption label check)
+    assert types["pilosa_multi_t"] == "histogram"
+
+
+def test_renderer_empty_snapshot():
+    assert prometheus_exposition({}) == ""
+    types, samples = parse_exposition(prometheus_exposition({}))
+    assert not types and not samples
+
+
+def test_histogram_count_equals_top_bucket_many_series():
+    s = StatsClient()
+    for node in ("n1", "n2"):
+        for v in (0.3, 1.0, 900.0, 2.5, 2.5):
+            s.timing(f"fanoutLatency/{node}", v)
+    types, samples = check_conformance(
+        prometheus_exposition(s.snapshot()))
+    counts = [v for name, labels, v in samples
+              if name == "pilosa_fanoutLatency_count"]
+    assert counts == [5.0, 5.0]
+    sums = [v for name, _, v in samples
+            if name == "pilosa_fanoutLatency_sum"]
+    assert all(abs(v - 906.3) < 1e-6 for v in sums)
+
+
+# ------------------------------------------------------------ live server
+
+
+@pytest.fixture(scope="module")
+def pair(tmp_path_factory):
+    """2-node cluster: distributed traffic populates counter AND
+    histogram families (fanoutLatency timings need real fan-out)."""
+    from pilosa_tpu.server import Server
+
+    tmp = tmp_path_factory.mktemp("metrics")
+    servers = [Server(str(tmp / f"n{i}"), port=0,
+                      node_id=chr(ord("a") + i)).open() for i in range(2)]
+    uris = [s.uri for s in servers]
+    for s in servers:
+        s.cluster_hosts = uris
+        s.refresh_membership()
+
+    def jpost(path, payload=None, raw=None):
+        body = raw if raw is not None else json.dumps(payload or {}).encode()
+        req = urllib.request.Request(uris[0] + path, data=body,
+                                     method="POST")
+        with urllib.request.urlopen(req, timeout=30) as r:
+            return json.loads(r.read())
+
+    jpost("/index/m", {})
+    jpost("/index/m/field/f", {})
+    cols = list(range(0, 4 * 2 ** 20, 9001))
+    jpost("/index/m/field/f/import",
+          {"rowIDs": [0] * len(cols), "columnIDs": cols})
+    for _ in range(3):
+        jpost("/index/m/query", raw=b"Count(Row(f=0))")
+    yield servers, uris
+    for s in servers:
+        s.close()
+
+
+def test_live_metrics_scrape_conforms(pair):
+    servers, uris = pair
+    with urllib.request.urlopen(uris[0] + "/metrics", timeout=10) as r:
+        assert r.status == 200
+        assert r.headers["Content-Type"].startswith("text/plain")
+        text = r.read().decode()
+    types, samples = check_conformance(text)
+    # real traffic produced counters...
+    assert any(n == "pilosa_query_total" for n, _, _ in samples), text[:400]
+    # ...and, when fan-out happened, the log2 timings render as histograms
+    if any(k.startswith("fanoutLatency/") for k in
+           servers[0].stats.snapshot().get("timings", {})):
+        assert types.get("pilosa_fanoutLatency") == "histogram"
+        count = next(v for n, _, v in samples
+                     if n == "pilosa_fanoutLatency_count")
+        assert count >= 1
+
+
+def test_metrics_endpoint_without_stats_client(pair):
+    """A handler with no stats wired still answers 200 with an empty
+    (legal) exposition."""
+    from pilosa_tpu.net.http_server import Handler
+    servers, _ = pair
+    h = Handler(servers[0].api, stats=None)
+    status, ctype, body = h.dispatch("GET", "/metrics", {}, b"")
+    assert status == 200 and ctype.startswith("text/plain")
+    parse_exposition(body.decode())

@@ -1,0 +1,128 @@
+"""/debug/pprof handlers (net/http_server.py get_debug_pprof): the index
+listing, the `goroutine` thread-stack dump, the `profile` sampling
+profiler (?seconds=), and the unknown-profile 404 — previously untested
+beyond a smoke check. Driven at the Handler level (no network flakiness)
+plus one live-server pass."""
+
+import json
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from pilosa_tpu.server import Server
+
+
+@pytest.fixture(scope="module")
+def server(tmp_path_factory):
+    s = Server(str(tmp_path_factory.mktemp("pprof") / "node"), port=0).open()
+    yield s
+    s.close()
+
+
+def dispatch(server, path, query=None):
+    return server.handler.dispatch("GET", path, query or {}, b"")
+
+
+def test_index_listing_default_and_explicit(server):
+    for path in ("/debug/pprof", "/debug/pprof/", "/debug/pprof/index"):
+        status, ctype, body = dispatch(server, path)
+        assert status == 200, path
+        out = json.loads(body)
+        assert out["profiles"] == ["goroutine", "profile"], path
+
+
+def test_goroutine_dumps_every_thread_stack(server):
+    marker = threading.Event()
+    release = threading.Event()
+
+    def parked_thread_for_pprof_test():
+        marker.set()
+        release.wait(10)
+
+    t = threading.Thread(target=parked_thread_for_pprof_test, daemon=True)
+    t.start()
+    marker.wait(5)
+    try:
+        status, _, body = dispatch(server, "/debug/pprof/goroutine")
+        assert status == 200
+        out = json.loads(body)
+        assert out["threads"] >= 2  # at least us + the parked thread
+        assert len(out["stacks"]) == out["threads"]
+        # stacks are real formatted frames: the parked thread's function
+        # name appears in exactly the dump, with file:line context
+        flat = "".join(f for frames in out["stacks"].values()
+                       for f in frames)
+        assert "parked_thread_for_pprof_test" in flat
+        assert ".py" in flat and "line" in flat
+    finally:
+        release.set()
+        t.join(5)
+
+
+def test_profile_samples_busy_thread(server):
+    stop = threading.Event()
+
+    def busy_loop_for_pprof_test():
+        x = 0
+        while not stop.is_set():
+            x += 1
+        return x
+
+    t = threading.Thread(target=busy_loop_for_pprof_test, daemon=True)
+    t.start()
+    try:
+        t0 = time.monotonic()
+        status, _, body = dispatch(server, "/debug/pprof/profile",
+                                   {"seconds": ["0.3"]})
+        elapsed = time.monotonic() - t0
+        assert status == 200
+        out = json.loads(body)
+        assert out["samples"] >= 1
+        assert elapsed >= 0.3  # honored the requested window
+        assert elapsed < 5.0
+        # the top-sites table attributes samples to the busy loop
+        assert out["top"], out
+        sites = " ".join(e["site"] for e in out["top"])
+        assert "busy_loop_for_pprof_test" in sites, out["top"][:5]
+        for entry in out["top"]:
+            assert entry["samples"] >= 1
+            assert ":" in entry["site"]  # file:line function shape
+    finally:
+        stop.set()
+        t.join(5)
+
+
+def test_profile_seconds_is_capped(server):
+    """?seconds= is clamped to 30 — a scrape typo must not pin a handler
+    thread for an hour (the sampler loop holds no locks, but still)."""
+    t0 = time.monotonic()
+    status, _, body = dispatch(server, "/debug/pprof/profile",
+                               {"seconds": ["0.05"]})
+    assert status == 200
+    assert time.monotonic() - t0 < 5.0
+    assert json.loads(body)["samples"] >= 0
+
+
+def test_unknown_profile_404(server):
+    for name in ("heapz", "mutex", "block", "cmdline"):
+        status, _, body = dispatch(server, f"/debug/pprof/{name}")
+        assert status == 404, name
+        assert "unknown profile" in json.loads(body)["error"]
+
+
+def test_pprof_over_live_http(server):
+    """One end-to-end pass over the real socket (the Handler-level tests
+    above cover the matrix)."""
+    with urllib.request.urlopen(server.uri + "/debug/pprof/goroutine",
+                                timeout=10) as r:
+        assert r.status == 200
+        assert json.loads(r.read())["threads"] >= 1
+    # unknown query args on a spec'd endpoint still 400 (typo guard)
+    try:
+        urllib.request.urlopen(
+            server.uri + "/debug/pprof/profile?second=1", timeout=10)
+        raise AssertionError("expected 400")
+    except urllib.error.HTTPError as e:
+        assert e.code == 400
