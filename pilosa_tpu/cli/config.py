@@ -71,8 +71,27 @@ class ClusterConfig:
 
 
 @dataclass
+class StorageConfig:
+    """[storage] — durability knobs (docs/operations.md "Failure modes and
+    recovery"). wal-fsync: "off" (default; matches the reference, which
+    writes through an unbuffered file but does not fsync) or "always"
+    (fsync per acked op: survives power loss, ~100x write cost).
+    Precedence: the PILOSA_TPU_WAL_FSYNC env var, when set, overrides this
+    setting per fragment (kept as the emergency toggle that needs no
+    config rollout); unset env → this knob; neither → off."""
+    wal_fsync: str = "off"
+
+
+@dataclass
 class AntiEntropyConfig:
     interval: float = 0.0  # seconds; 0 disables (server.go:430-445)
+    # scrubber tuning: jitter spreads node passes apart (fraction of the
+    # interval, +/-); pace sleeps between per-fragment scrubs so a pass
+    # never starves live queries; max-blocks bounds block repairs per
+    # fragment per pass (0 = unbounded)
+    jitter: float = 0.25
+    pace: float = 0.0
+    max_blocks: int = 0
 
 
 @dataclass
@@ -143,6 +162,7 @@ class Config:
     verbose: bool = False
     tls: TLSConfig = field(default_factory=TLSConfig)
     cluster: ClusterConfig = field(default_factory=ClusterConfig)
+    storage: StorageConfig = field(default_factory=StorageConfig)
     anti_entropy: AntiEntropyConfig = field(default_factory=AntiEntropyConfig)
     metric: MetricConfig = field(default_factory=MetricConfig)
     diagnostics: DiagnosticsConfig = field(default_factory=DiagnosticsConfig)
@@ -169,7 +189,7 @@ class Config:
     def _apply_dict(self, data: dict) -> None:
         for key, value in data.items():
             attr = key.replace("-", "_")
-            if attr in ("tls", "cluster", "anti_entropy", "metric", "diagnostics", "tracing", "mesh", "gossip") and isinstance(value, dict):
+            if attr in ("tls", "cluster", "storage", "anti_entropy", "metric", "diagnostics", "tracing", "mesh", "gossip") and isinstance(value, dict):
                 sub = getattr(self, attr)
                 for k, v in value.items():
                     sk = k.replace("-", "_")
@@ -191,7 +211,7 @@ class Config:
 
     def _set_path(self, parts: list[str], raw: str) -> None:
         # try sub-config first (cluster_replicas -> cluster.replicas)
-        for sub_name in ("tls", "cluster", "anti_entropy", "metric", "diagnostics", "tracing", "mesh", "gossip"):
+        for sub_name in ("tls", "cluster", "storage", "anti_entropy", "metric", "diagnostics", "tracing", "mesh", "gossip"):
             sub_parts = sub_name.split("_")
             if parts[: len(sub_parts)] == sub_parts and len(parts) > len(sub_parts):
                 sub = getattr(self, sub_name)
@@ -226,8 +246,14 @@ class Config:
             f'profile = "{self.cluster.profile}"',
             f"query-history-size = {self.cluster.query_history_size}",
             "",
+            "[storage]",
+            f'wal-fsync = "{self.storage.wal_fsync}"',
+            "",
             "[anti-entropy]",
             f"interval = {self.anti_entropy.interval}",
+            f"jitter = {self.anti_entropy.jitter}",
+            f"pace = {self.anti_entropy.pace}",
+            f"max-blocks = {self.anti_entropy.max_blocks}",
             "",
             "[metric]",
             f'service = "{self.metric.service}"',

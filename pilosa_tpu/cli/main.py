@@ -40,6 +40,9 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--cluster-replicas", type=int, help="replica count")
     sp.add_argument("--anti-entropy-interval", type=float,
                     help="seconds between anti-entropy passes (0 = off)")
+    sp.add_argument("--wal-fsync", choices=["off", "always"],
+                    help="fsync the WAL per acked op ([storage] wal-fsync; "
+                         "the PILOSA_TPU_WAL_FSYNC env var overrides both)")
     sp.add_argument("--join", action="store_true",
                     help="join an existing cluster via --cluster-hosts seeds "
                          "(triggers a coordinator resize)")
@@ -104,6 +107,8 @@ def cmd_server(args) -> int:
         cfg.cluster.replicas = args.cluster_replicas
     if args.anti_entropy_interval is not None:
         cfg.anti_entropy.interval = args.anti_entropy_interval
+    if getattr(args, "wal_fsync", None):
+        cfg.storage.wal_fsync = args.wal_fsync
     if getattr(args, "mesh_devices", None):
         cfg.mesh.devices = args.mesh_devices
 
@@ -129,6 +134,10 @@ def cmd_server(args) -> int:
         probe_timeout=cfg.cluster.probe_timeout,
         membership_interval=cfg.cluster.membership_interval,
         anti_entropy_interval=cfg.anti_entropy.interval,
+        anti_entropy_jitter=cfg.anti_entropy.jitter,
+        anti_entropy_pace=cfg.anti_entropy.pace,
+        anti_entropy_max_blocks=cfg.anti_entropy.max_blocks,
+        wal_fsync=cfg.storage.wal_fsync,
         join=getattr(args, "join", False),
         long_query_time=cfg.cluster.long_query_time,
         query_timeout=cfg.cluster.query_timeout,

@@ -1600,6 +1600,12 @@ class Executor:
         being profiled, a per-shard-group fanout record with the transport
         actually used (coalesced envelope vs per-query proto)."""
         import time as _time
+        from pilosa_tpu.net.client import ClientError
+        from pilosa_tpu.utils import failpoints
+
+        # failpoint: raises ClientError so the injected fault drives the
+        # same per-shard failover a real peer failure would
+        failpoints.hit("executor.fanout", exc=ClientError)
         t0 = _time.perf_counter()
         err = ""
         coalesced = self.coalescer is not None

@@ -65,11 +65,14 @@ class FieldOptions:
 
 class Field:
     def __init__(self, path: str, index: str, name: str,
-                 options: Optional[FieldOptions] = None):
+                 options: Optional[FieldOptions] = None,
+                 wal_fsync: Optional[bool] = None):
         self.path = path
         self.index = index
         self.name = name
         self.options = options or FieldOptions()
+        # [storage] wal-fsync, plumbed down to every fragment of every view
+        self.wal_fsync = wal_fsync
         self.views: dict[str, View] = {}
         # two concurrent first-writes must not both construct a View for
         # the same name: each would open (flock) the same fragment files
@@ -157,7 +160,8 @@ class Field:
                              track_rank=self._track_rank()
                              and not name.startswith(VIEW_BSI_PREFIX),
                              cache_size=self.options.cache_size,
-                             cache_type=self.options.cache_type).open()
+                             cache_type=self.options.cache_type,
+                             wal_fsync=self.wal_fsync).open()
                     self.views[name] = v
         return v
 

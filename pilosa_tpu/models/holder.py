@@ -15,18 +15,22 @@ from pilosa_tpu.models.index import Index, validate_name
 
 
 class Holder:
-    def __init__(self, path: str):
+    def __init__(self, path: str, wal_fsync=None):
         self.path = path
         self.indexes: dict[str, Index] = {}
         self.opened = False
         self.shard_hook = None
+        # [storage] wal-fsync (None = default off; PILOSA_TPU_WAL_FSYNC env
+        # overrides at the fragment): plumbed down the whole tree
+        self.wal_fsync = wal_fsync
 
     def open(self) -> "Holder":
         os.makedirs(self.path, exist_ok=True)
         for name in sorted(os.listdir(self.path)):
             ipath = os.path.join(self.path, name)
             if os.path.isdir(ipath) and not name.startswith("."):
-                self.indexes[name] = Index(ipath, name).open()
+                self.indexes[name] = Index(ipath, name,
+                                           wal_fsync=self.wal_fsync).open()
         self.opened = True
         return self
 
@@ -45,7 +49,8 @@ class Holder:
         if name in self.indexes:
             raise ValueError(f"index already exists: {name}")
         idx = Index(os.path.join(self.path, name), name, keys=keys,
-                    track_existence=track_existence)
+                    track_existence=track_existence,
+                    wal_fsync=self.wal_fsync)
         idx.save_meta()
         idx.open()
         if self.shard_hook is not None:
@@ -87,3 +92,34 @@ class Holder:
 
     def schema(self) -> list[dict]:
         return [idx.schema_dict() for _, idx in sorted(self.indexes.items())]
+
+    def walk_fragments(self):
+        """Yield every (index_name, field_name, view_name, shard, fragment)
+        under a point-in-time snapshot of the tree (list() copies: handler
+        threads create schema objects concurrently)."""
+        for iname, idx in list(self.indexes.items()):
+            for fname, fld in list(idx.fields.items()):
+                for vname, view in list(fld.views.items()):
+                    for shard, frag in list(view.fragments.items()):
+                        yield iname, fname, vname, shard, frag
+
+    def damaged_fragments(self) -> list[dict]:
+        """Corruption-recovery report for /debug/vars and the scrubber:
+        fragments that were quarantined at open (awaiting or done with a
+        replica rebuild) or had a torn WAL tail truncated."""
+        out = []
+        for iname, fname, vname, shard, frag in self.walk_fragments():
+            if frag.quarantine_path is None \
+                    and not frag.wal_truncated_bytes:
+                continue
+            out.append({
+                "index": iname, "field": fname, "view": vname,
+                "shard": shard,
+                "quarantinePath": frag.quarantine_path,
+                "corruptionError": frag.corruption_error,
+                "rebuiltFrom": frag.rebuilt_from,
+                "needsRebuild": frag.needs_rebuild,
+                "walTruncatedBytes": frag.wal_truncated_bytes,
+                "walTruncateError": frag.wal_truncate_error,
+            })
+        return out

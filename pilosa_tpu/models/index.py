@@ -29,12 +29,15 @@ def validate_name(name: str) -> None:
 
 class Index:
     def __init__(self, path: str, name: str, keys: bool = False,
-                 track_existence: bool = True):
+                 track_existence: bool = True,
+                 wal_fsync: Optional[bool] = None):
         validate_name(name)
         self.path = path
         self.name = name
         self.keys = keys
         self.track_existence = track_existence
+        # [storage] wal-fsync, plumbed down to every field/view/fragment
+        self.wal_fsync = wal_fsync
         self.fields: dict[str, Field] = {}
         # guards concurrent field creation (two racing first-imports must
         # not both construct a Field: duplicate stores + fragment flocks)
@@ -66,7 +69,8 @@ class Index:
         for fname in sorted(os.listdir(self.path)):
             fpath = os.path.join(self.path, fname)
             if os.path.isdir(fpath):
-                self.fields[fname] = Field(fpath, self.name, fname).open()
+                self.fields[fname] = Field(fpath, self.name, fname,
+                                           wal_fsync=self.wal_fsync).open()
         if self.track_existence and EXISTENCE_FIELD_NAME not in self.fields:
             self._create_existence_field()
         return self
@@ -85,7 +89,8 @@ class Index:
     def _create_existence_field(self) -> Field:
         opts = FieldOptions(type=FieldType.SET, cache_type="none")
         f = Field(os.path.join(self.path, EXISTENCE_FIELD_NAME),
-                  self.name, EXISTENCE_FIELD_NAME, opts)
+                  self.name, EXISTENCE_FIELD_NAME, opts,
+                  wal_fsync=self.wal_fsync)
         f.open()
         self.fields[EXISTENCE_FIELD_NAME] = f
         return f
@@ -105,7 +110,8 @@ class Index:
         with self._field_mu:
             if name in self.fields:
                 raise ValueError(f"field already exists: {name}")
-            f = Field(os.path.join(self.path, name), self.name, name, options)
+            f = Field(os.path.join(self.path, name), self.name, name, options,
+                      wal_fsync=self.wal_fsync)
             f.save_meta()
             f.open()
             f.on_shard_added = self.shard_hook

@@ -34,11 +34,15 @@ def view_path(field_path: str, name: str) -> str:
 class View:
     def __init__(self, path: str, index: str, field: str, name: str,
                  track_rank: bool = False, cache_size: int = 50000,
-                 cache_type: str = CACHE_TYPE_RANKED):
+                 cache_type: str = CACHE_TYPE_RANKED,
+                 wal_fsync: Optional[bool] = None):
         self.path = path
         self.index = index
         self.field = field
         self.name = name
+        # [storage] wal-fsync, plumbed holder->index->field->view->fragment
+        # (None = fragment default; PILOSA_TPU_WAL_FSYNC env overrides)
+        self.wal_fsync = wal_fsync
         self.fragments: dict[int, Fragment] = {}
         # serializes fragment creation: two HTTP threads racing
         # create_fragment_if_not_exists would both construct + open() the
@@ -88,6 +92,7 @@ class View:
         frag = Fragment(
             os.path.join(self.path, "fragments", str(shard)),
             self.index, self.field, self.name, shard,
+            wal_fsync=self.wal_fsync,
         ).open()
         self.fragments[shard] = frag
         if self.track_rank:

@@ -17,7 +17,7 @@ import threading
 import urllib.parse
 from typing import Optional
 
-from pilosa_tpu.utils import qctx, tracing
+from pilosa_tpu.utils import failpoints, qctx, tracing
 from pilosa_tpu.utils import profile as qprofile
 
 
@@ -75,6 +75,9 @@ class InternalClient:
         for attempt in (0, 1):
             conn, fresh = self._conn_for(key, sock_timeout)
             try:
+                # failpoint: an injected FailpointError is an OSError, so it
+                # rides the normal transport-failure path below (no retry)
+                failpoints.hit("net.client.send")
                 conn.request(method, path, body=body, headers=headers)
                 resp = conn.getresponse()
             except socket.timeout as e:
@@ -106,6 +109,27 @@ class InternalClient:
                 # unreliable by contract, so normalize them
                 self._drop_conn(key)
                 raise ClientError(f"{method} {path}: {type(e).__name__}: {e}")
+            # failpoint: partial-read models a mangling middlebox; a raise
+            # kind normalizes like any mid-body transport failure
+            try:
+                data = failpoints.corrupt_read("net.client.read", data)
+            except failpoints.FailpointError as e:
+                self._drop_conn(key)
+                raise ClientError(f"{method} {path}: {type(e).__name__}: {e}")
+            # short-body guard: a protobuf truncated at a field boundary
+            # can DECODE cleanly with fields silently missing — wrong data,
+            # the one outcome recovery must never allow. For real sockets
+            # http.client already raises IncompleteRead on a short body
+            # (normalized above); this re-check catches truncation
+            # introduced AFTER the read — the partial-read failpoint, or
+            # any future read-path wrapper bug — so the chaos invariant
+            # ("clean error, never wrong data") holds by construction.
+            clen = resp.getheader("Content-Length")
+            if clen is not None and clen.isdigit() and len(data) != int(clen):
+                self._drop_conn(key)
+                raise ClientError(
+                    f"{method} {path}: short body: read {len(data)} of "
+                    f"{clen} bytes")
             if resp.will_close:
                 self._drop_conn(key)
             if resp.status >= 400:

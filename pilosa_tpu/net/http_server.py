@@ -157,6 +157,10 @@ class Handler:
                 try:
                     # inside the try: an invalid ?timeout= must map to a
                     # clean 400 like any other ApiError, not escape dispatch
+                    # (and an injected dispatch fault surfaces as a 500 the
+                    # same way a real handler crash would)
+                    from pilosa_tpu.utils import failpoints
+                    failpoints.hit("http.server.dispatch")
                     dl_token = self._set_deadline(name, query, headers)
                     return handler(match.groupdict(), query, body)
                 except qctx.QueryTimeoutError as e:
@@ -415,20 +419,27 @@ class Handler:
             # acknowledged writes on restart, and how many such writes
             # have been taken
             vol = []
-            # list() copies: handler threads may be creating indexes/
-            # fields/views/fragments concurrently (holder.py walk rule)
-            for iname, idx in list(holder.indexes.items()):
-                for fname, fld in list(idx.fields.items()):
-                    for vname, view in list(fld.views.items()):
-                        for shard, frag in list(view.fragments.items()):
-                            if getattr(frag, "_volatile", False):
-                                vol.append({
-                                    "index": iname, "field": fname,
-                                    "view": vname, "shard": shard,
-                                    "mutations": frag.volatile_mutations,
-                                })
+            for iname, fname, vname, shard, frag in holder.walk_fragments():
+                if getattr(frag, "_volatile", False):
+                    vol.append({
+                        "index": iname, "field": fname,
+                        "view": vname, "shard": shard,
+                        "mutations": frag.volatile_mutations,
+                    })
             if vol:
                 snap["volatileFragments"] = vol
+            # corruption-recovery surface: quarantined snapshots (pending /
+            # completed replica rebuilds) and truncated torn WAL tails
+            damaged = holder.damaged_fragments()
+            if damaged:
+                snap["damagedFragments"] = damaged
+        # fault-injection counters (utils/failpoints.py): which points are
+        # armed, per-point evaluation/fired counts, the chaos seed, and the
+        # tail of the fired-action log — how a chaos run is audited live
+        from pilosa_tpu.utils import failpoints
+        fps = failpoints.snapshot()
+        if fps["points"] or fps["armed"]:
+            snap["failpoints"] = fps
         return self._json(snap)
 
     def get_query_history(self, params, query, body):
@@ -446,8 +457,15 @@ class Handler:
         timing buckets converted to cumulative `_bucket{le=...}` series
         with `_sum`/`_count` (utils/stats.py prometheus_exposition). The
         expvar JSON at /debug/vars stays; this is the scrape surface."""
+        from pilosa_tpu.utils import failpoints
         from pilosa_tpu.utils.stats import prometheus_exposition
         snap = self.stats.snapshot() if self.stats is not None else {}
+        fired = {f"failpoints/{name}": c["fired"]
+                 for name, c in failpoints.counters().items() if c["fired"]}
+        if fired:
+            counts = dict(snap.get("counts", {}))
+            counts.update(fired)
+            snap = dict(snap, counts=counts)
         body_out = prometheus_exposition(snap)
         return (200, "text/plain; version=0.0.4; charset=utf-8",
                 body_out.encode())

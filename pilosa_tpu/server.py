@@ -42,6 +42,10 @@ class Server:
                  cluster_hosts: Optional[list[str]] = None,
                  replica_n: int = 1,
                  anti_entropy_interval: float = 0.0,
+                 anti_entropy_jitter: float = 0.25,
+                 anti_entropy_pace: float = 0.0,
+                 anti_entropy_max_blocks: int = 0,
+                 wal_fsync: str = "off",
                  cache_flush_interval: float = 60.0,
                  membership_interval: float = 5.0,
                  liveness_threshold: int = 3,
@@ -73,7 +77,15 @@ class Server:
                  profile_mode: str = "auto",
                  query_history_size: int = 100):
         self.data_dir = data_dir
-        self.holder = Holder(data_dir)
+        # [storage] wal-fsync, plumbed down the model tree to every
+        # Fragment (PILOSA_TPU_WAL_FSYNC env overrides per fragment —
+        # precedence documented in docs/operations.md)
+        if wal_fsync not in ("off", "always"):
+            raise ValueError(
+                f"invalid [storage] wal-fsync {wal_fsync!r} "
+                "(expected off | always)")
+        self.wal_fsync = wal_fsync
+        self.holder = Holder(data_dir, wal_fsync=(wal_fsync == "always"))
         self.node_id = node_id or self._load_or_create_id()
         self.cluster = Cluster(
             self.node_id, replica_n=replica_n,
@@ -147,6 +159,24 @@ class Server:
         self.long_query_time = long_query_time
         self.max_writes_per_request = max_writes_per_request
         self.anti_entropy_interval = anti_entropy_interval
+        # scrubber tuning (docs/operations.md "Failure modes and
+        # recovery"): jitter de-synchronizes the nodes' scrub passes (a
+        # cluster whose replicas all scrub at the same instant doubles its
+        # own fan-out load spike); pace sleeps between per-fragment scrubs
+        # so a pass never starves the query fan-out pool; max_blocks
+        # bounds the blocks merged per fragment per pass (0 = unbounded)
+        if not 0.0 <= anti_entropy_jitter < 1.0:
+            # a FRACTION of the interval, not seconds — jitter >= 1 would
+            # sample negative intervals, i.e. a continuous scrub storm
+            raise ValueError(
+                f"invalid [anti-entropy] jitter {anti_entropy_jitter!r} "
+                "(a fraction: expected 0 <= jitter < 1)")
+        if anti_entropy_pace < 0 or anti_entropy_max_blocks < 0:
+            raise ValueError("[anti-entropy] pace and max-blocks must be >= 0")
+        self.anti_entropy_jitter = anti_entropy_jitter
+        self.anti_entropy_pace = anti_entropy_pace
+        self.anti_entropy_max_blocks = anti_entropy_max_blocks
+        self._scrub_passes = 0
         self.cache_flush_interval = cache_flush_interval
         self._cache_flush_timer: Optional[threading.Timer] = None
         self.membership_interval = membership_interval
@@ -233,6 +263,22 @@ class Server:
     def open(self) -> "Server":
         self.translate.open()
         self.holder.open()
+        for d in self.holder.damaged_fragments():
+            # recovery happened inside Fragment.open; make it LOUD for the
+            # operator (also surfaced in /debug/vars damagedFragments)
+            if d["quarantinePath"]:
+                self.logger.printf(
+                    "storage: fragment %s/%s/%s/%d failed its integrity "
+                    "check (%s): quarantined to %s, reopened empty — the "
+                    "scrubber will rebuild it from a replica",
+                    d["index"], d["field"], d["view"], d["shard"],
+                    d["corruptionError"], d["quarantinePath"])
+            if d["walTruncatedBytes"]:
+                self.logger.printf(
+                    "storage: fragment %s/%s/%s/%d had a torn WAL tail "
+                    "(%s): truncated %d un-acked bytes",
+                    d["index"], d["field"], d["view"], d["shard"],
+                    d["walTruncateError"], d["walTruncatedBytes"])
         self.holder.set_shard_hook(self._on_shard_added)
         self.http.serve_background()
         me = Node(id=self.node_id, uri=self.http.uri,
@@ -1271,21 +1317,103 @@ class Server:
                             dropped += 1
         return dropped
 
-    # -- anti-entropy (server.go:430-483; fragmentSyncer fragment.go:2170) --
+    # -- anti-entropy scrubber (server.go:430-483; fragment.go:2170) --------
 
     def _schedule_anti_entropy(self) -> None:
         if self.closed:
             return
-        self._ae_timer = threading.Timer(self.anti_entropy_interval,
+        import random as _random
+        interval = self.anti_entropy_interval
+        if self.anti_entropy_jitter > 0:
+            # de-synchronize replicas: every node scrubbing at the same
+            # instant turns anti-entropy into a cluster-wide load spike
+            interval *= 1.0 + _random.uniform(-self.anti_entropy_jitter,
+                                              self.anti_entropy_jitter)
+        self._ae_timer = threading.Timer(max(interval, 0.01),
                                          self._anti_entropy_tick)
         self._ae_timer.daemon = True
         self._ae_timer.start()
 
     def _anti_entropy_tick(self) -> None:
         try:
-            self.sync_holder()
+            self.scrub_pass()
+        except Exception as e:  # noqa: BLE001 — a failed pass (dead peer,
+            # injected fault) must never kill the ticker: the next pass
+            # retries everything from scratch
+            self.logger.printf("anti-entropy: pass failed: %s", e)
         finally:
             self._schedule_anti_entropy()
+
+    def _resize_active(self) -> bool:
+        with self._resize_lock:
+            return (self.cluster.state == STATE_RESIZING
+                    or self.cluster.active_job is not None)
+
+    def scrub_pass(self) -> int:
+        """One full scrubber pass: rebuild quarantined fragments from live
+        replicas, then walk owned fragments diffing block checksums against
+        replicas and repairing divergence via merge_block_majority
+        (sync_holder). Skipped while a resize is migrating fragments — the
+        two would fight over the same shards (and sync_holder re-checks
+        per fragment, since a paced pass can span minutes and a resize can
+        start mid-pass). Returns blocks merged."""
+        import time as _time
+        if self._resize_active():
+            return 0
+        t0 = _time.monotonic()
+        rebuilt = self.repair_quarantined()
+        merged = self.sync_holder()
+        self._scrub_passes += 1
+        self.stats.count("antiEntropy/passes")
+        if merged:
+            self.stats.count("antiEntropy/blocksMerged", merged)
+        if rebuilt:
+            self.stats.count("antiEntropy/fragmentsRebuilt", rebuilt)
+        self.stats.gauge("antiEntropy/lastPassSeconds",
+                         _time.monotonic() - t0)
+        return merged
+
+    def repair_quarantined(self) -> int:
+        """Rebuild fragments that open() quarantined (corrupt snapshot →
+        emptied) by streaming a replica's full snapshot over the resize
+        copy path (RetrieveShardFromURI analog). Block-level anti-entropy
+        would converge them too, but a whole-fragment fetch is one RPC
+        instead of a block-by-block vote, and it marks the fragment healthy
+        immediately. No live replica → left empty; the next pass retries.
+        Returns fragments rebuilt."""
+        rebuilt = 0
+        for iname, fname, vname, shard, frag in \
+                list(self.holder.walk_fragments()):
+            if not frag.needs_rebuild:
+                continue
+            for node in self.cluster.shard_nodes(iname, shard):
+                if node.id == self.node_id or not node.uri \
+                        or self.cluster.is_down(node.id):
+                    continue
+                try:
+                    data = self.client.retrieve_shard(
+                        node.uri, iname, fname, vname, shard)
+                except ClientError:
+                    continue  # replica has no copy / unreachable: next one
+                try:
+                    # bulk union into the emptied fragment; import_roaring
+                    # auto-snapshots, so the rebuild is durable (fresh
+                    # integrity trailer included) before we mark it healthy
+                    frag.import_roaring(data)
+                except (ValueError, OSError) as e:
+                    self.logger.printf(
+                        "scrubber: rebuild of %s/%s/%s/%d from %s failed: %s",
+                        iname, fname, vname, shard, node.id, e)
+                    continue
+                frag.rebuilt_from = node.id
+                rebuilt += 1
+                self.logger.printf(
+                    "scrubber: rebuilt quarantined fragment %s/%s/%s/%d "
+                    "from replica %s (%d bits; corrupt file kept at %s)",
+                    iname, fname, vname, shard, node.id, frag.bit_count(),
+                    frag.quarantine_path)
+                break
+        return rebuilt
 
     def _schedule_cache_flush(self) -> None:
         if self.closed:
@@ -1322,9 +1450,21 @@ class Server:
                     self.client.row_attr_diff(uri, iname, fn, blocks, rng))
                 for vname, view in field.views.items():
                     for shard in view.shards():
+                        if self._resize_active():
+                            # a resize started mid-pass (paced passes can
+                            # span minutes): stop — merging blocks against
+                            # a topology that is migrating under us would
+                            # race the fragment copies. The next pass
+                            # finishes the walk.
+                            return merged
                         if not self.cluster.owns_shard(self.node_id, iname, shard):
                             continue
                         merged += self._sync_fragment(iname, fname, vname, shard)
+                        if self.anti_entropy_pace > 0:
+                            # paced: a scrub pass shares the node with live
+                            # queries — it must trickle, not starve the
+                            # fan-out pool / HTTP threads of CPU and peers
+                            time.sleep(self.anti_entropy_pace)
         return merged
 
     # attr blocks per diff request: bounds both the request body and the
@@ -1390,7 +1530,9 @@ class Server:
         import numpy as np
         from pilosa_tpu.storage.roaring import Bitmap
         from pilosa_tpu.constants import SHARD_WIDTH
+        from pilosa_tpu.utils import failpoints
 
+        failpoints.hit("server.scrub.fragment")
         frag = self.holder.index(iname).field(fname).view(vname).fragment(shard)
         if frag is None:
             return 0
@@ -1438,6 +1580,9 @@ class Server:
         adopted = False  # any local change -> snapshot for the WAL
         sw = np.uint64(SHARD_WIDTH)
         for blk in sorted(all_blocks):
+            if self.anti_entropy_max_blocks > 0 \
+                    and merged >= self.anti_entropy_max_blocks:
+                break  # bounded pass; the next pass picks up where diffs remain
             lc = local_blocks.get(blk)
             if lc is not None and all(remote.get(blk) == lc.hex()
                                       for _, remote, _ in peers):

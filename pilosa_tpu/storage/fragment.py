@@ -126,9 +126,15 @@ class Fragment:
         # fsync per acked op. Default (off) matches the reference, which
         # writes through an unbuffered os.File but does not fsync
         # (roaring.go:977); "always" survives power loss, not just process
-        # death, at ~100x write cost.
-        if wal_fsync is None:
-            wal_fsync = os.environ.get("PILOSA_TPU_WAL_FSYNC", "") == "always"
+        # death, at ~100x write cost. Precedence (docs/operations.md):
+        # PILOSA_TPU_WAL_FSYNC env (any non-empty value; "always" enables)
+        # overrides the [storage] wal-fsync config plumbed down as the
+        # `wal_fsync` parameter; unset both = off.
+        env = os.environ.get("PILOSA_TPU_WAL_FSYNC", "")
+        if env:
+            wal_fsync = env == "always"
+        elif wal_fsync is None:
+            wal_fsync = False
         self.wal_fsync = wal_fsync
         # per-fragment write lock (fragment.mu, fragment.go:76); RLock:
         # bulk paths snapshot() while holding it
@@ -159,6 +165,16 @@ class Fragment:
         # in /debug/vars volatileFragments so the volatility is visible
         # to operators, not just a code comment
         self.volatile_mutations = 0
+        # corruption recovery state: when open() finds a damaged snapshot
+        # section it moves the file to <path>.corrupt-<ts> and reopens
+        # empty; the scrubber rebuilds from a live replica and stamps
+        # rebuilt_from. A torn WAL tail is milder: recovery truncates it
+        # in place and records how much was dropped.
+        self.quarantine_path: Optional[str] = None
+        self.corruption_error: Optional[str] = None
+        self.rebuilt_from: Optional[str] = None
+        self.wal_truncated_bytes = 0
+        self.wal_truncate_error: Optional[str] = None
         # Cached block checksums, invalidated per-block on writes
         # (fragment.go:1226-1305).
         self._block_checksums: dict[int, bytes] = {}
@@ -178,10 +194,31 @@ class Fragment:
         locking the data file itself would open a window where two processes
         hold "the" lock on different inodes. A second opener fails fast
         instead of silently corrupting the data-dir. Container payloads stay
-        in the mmap until first access (LazyContainer), so holder open cost
-        is proportional to container *metadata*, not data bytes.
+        in the mmap until first access (LazyContainer), so the *parse* cost
+        at open is proportional to container metadata, not data bytes —
+        though verifying the integrity trailer (below) is one sequential
+        blake2b pass over the snapshot section, the price of catching
+        bit-rot before serving from it.
+
+        Crash/corruption recovery: a torn or corrupt WAL TAIL is truncated
+        at the last valid record (un-acked damage must not be fatal —
+        fragment.go reopens after crashes the same way); a damaged SNAPSHOT
+        section (failed blake2b trailer, truncated containers) quarantines
+        the file to `<path>.corrupt-<ts>` and reopens empty, leaving the
+        anti-entropy scrubber to rebuild from a live replica. Either way the
+        node comes up; only a second consecutive failure (disk errors on
+        the fresh file) releases the lock and raises.
         """
+        from pilosa_tpu.utils import failpoints
+
         _reap_held_locks()  # release flocks whose mmap views have died
+        # fresh recovery report per open: this open's findings, not a
+        # previous incarnation's (a rebuilt-then-reopened fragment is clean)
+        self.quarantine_path = None
+        self.corruption_error = None
+        self.rebuilt_from = None
+        self.wal_truncated_bytes = 0
+        self.wal_truncate_error = None
         os.makedirs(os.path.dirname(self.path), exist_ok=True)
         self._lock_file = open(self.path + LOCK_EXT, "ab")
         try:
@@ -192,28 +229,58 @@ class Fragment:
             self._lock_file = None
             raise RuntimeError(
                 f"fragment file locked by another process: {self.path}")
-        try:
-            # Unbuffered: every acked op must reach the kernel before the
-            # write returns (the reference appends through an os.File
-            # syscall, roaring.go:977 writeOp — a userspace-buffered WAL
-            # loses acked writes on crash, defeating its purpose).
-            self._op_file = open(self.path, "ab", buffering=0)
-            if os.path.getsize(self.path) == 0:
-                # Seed an empty snapshot header so the WAL has something to
-                # append to (openStorage marshals the empty bitmap into a
-                # fresh file, fragment.go:190-247).
-                self.storage.write_to(self._op_file)
-                self._op_file.flush()
-            self._map()
-        except Exception:
-            # don't leak the lock/handles on a corrupt file — and don't
-            # mask the parse error with a bogus "locked" on retry
-            if self._op_file is not None:
-                self._op_file.close()
-                self._op_file = None
-            self._lock_file.close()
-            self._lock_file = None
-            raise
+        for attempt in (0, 1):
+            try:
+                # Unbuffered: every acked op must reach the kernel before the
+                # write returns (the reference appends through an os.File
+                # syscall, roaring.go:977 writeOp — a userspace-buffered WAL
+                # loses acked writes on crash, defeating its purpose).
+                self._op_file = open(self.path, "ab", buffering=0)
+                if os.path.getsize(self.path) == 0:
+                    # Seed an empty snapshot (with integrity trailer) so the
+                    # WAL has something to append to (openStorage marshals
+                    # the empty bitmap into a fresh file, fragment.go:190).
+                    self.storage.write_snapshot(self._op_file)
+                    self._op_file.flush()
+                failpoints.hit("storage.fragment.open")
+                self._map()
+                break
+            except ValueError as e:
+                # snapshot-section damage (CorruptionError trailer mismatch,
+                # truncated container payloads, bad header): quarantine the
+                # file and retry ONCE with a fresh empty one — the node must
+                # come up, and the scrubber heals from replicas. Handles are
+                # closed either way so a retry can't trip its own flock or
+                # mask the parse error with a bogus "locked".
+                if self._op_file is not None:
+                    self._op_file.close()
+                    self._op_file = None
+                if attempt == 0:
+                    self.corruption_error = str(e)
+                    self.quarantine_path = self._quarantine()
+                    self.storage = Bitmap()
+                    continue
+                self._lock_file.close()
+                self._lock_file = None
+                raise
+            except Exception:
+                # non-corruption failure (disk error, injected fault):
+                # don't leak the lock/handles
+                if self._op_file is not None:
+                    self._op_file.close()
+                    self._op_file = None
+                self._lock_file.close()
+                self._lock_file = None
+                raise
+        if self.storage.wal_error is not None:
+            # torn WAL tail: every record before the tear replayed; drop
+            # the damage so the next open is clean and appends are sane.
+            # (The mmap spans the old length, but nothing reads past the
+            # snapshot section, which always precedes the ops.)
+            valid_end = self.storage.wal_valid_end
+            self.wal_truncated_bytes = os.path.getsize(self.path) - valid_end
+            self.wal_truncate_error = self.storage.wal_error
+            os.truncate(self.path, valid_end)
         self.op_n = self.storage.op_n
         if self.op_n:
             # op-log replay can leave stale encodings (array grown past
@@ -226,14 +293,49 @@ class Fragment:
         self.closed = False
         return self
 
-    def _map(self) -> None:
-        """(Re)map the file and lazy-parse it into self.storage."""
+    def _map(self, verify: bool = True) -> None:
+        """(Re)map the file and lazy-parse it into self.storage.
+        verify=False skips the trailer digest (the remap right after a
+        snapshot wrote it — re-hashing the whole section there would
+        double compaction I/O for nothing)."""
         with open(self.path, "rb") as f:
             mm = mmap.mmap(f.fileno(), 0, prot=mmap.PROT_READ)
-        if hasattr(mm, "madvise"):
-            mm.madvise(mmap.MADV_RANDOM)  # fragment.go:2391 madvise
-        self.storage = Bitmap.from_bytes(mm, lazy=True)
+        try:
+            if hasattr(mm, "madvise"):
+                mm.madvise(mmap.MADV_RANDOM)  # fragment.go:2391 madvise
+            storage = Bitmap.from_bytes(mm, lazy=True, recover_wal=True,
+                                        verify=verify)
+        except Exception:
+            try:
+                mm.close()  # parse failed: drop the mapping
+            except BufferError:
+                # a memoryview in the propagating exception's traceback
+                # still pins the mapping; refcounting reclaims it as soon
+                # as the handler in open() consumes the exception
+                pass
+            raise
+        self.storage = storage
         self._mmap = mm
+
+    def _quarantine(self) -> str:
+        """Move the corrupt data file aside to `<path>.corrupt-<ts>` —
+        preserved for operator forensics (docs/operations.md runbook),
+        out of the way of the fresh file the retry creates."""
+        import time as _time
+        ts = _time.strftime("%Y%m%d-%H%M%S")
+        dest = f"{self.path}.corrupt-{ts}"
+        i = 1
+        while os.path.exists(dest):
+            dest = f"{self.path}.corrupt-{ts}-{i}"
+            i += 1
+        os.replace(self.path, dest)
+        return dest
+
+    @property
+    def needs_rebuild(self) -> bool:
+        """True while this fragment was quarantined-and-emptied and no
+        replica rebuild has completed yet (the scrubber's work list)."""
+        return self.quarantine_path is not None and self.rebuilt_from is None
 
     def close(self) -> None:
         if self._op_file is not None:
@@ -787,33 +889,80 @@ class Fragment:
             self.snapshot()
 
     def snapshot(self) -> None:
+        from pilosa_tpu.utils import failpoints
+
         tmp = self.path + SNAPSHOT_EXT
         os.makedirs(os.path.dirname(self.path), exist_ok=True)
         if self._op_file is not None:
             self._op_file.flush()
             self._op_file.close()
             self._op_file = None
-        # re-pick in-memory encodings (introduces run containers where
-        # smallest — roaring.go:1594 Optimize before write); lazy entries
-        # keep their already-optimal on-disk encoding
-        self.storage.optimize()
-        with open(tmp, "wb") as f:
-            # still-lazy containers pass their raw payloads straight from
-            # the old mmap — unread data is never parsed, only copied; the
-            # optimize() above already picked encodings, so write skips a
-            # second selection scan
-            self.storage.write_to(f, optimized=True)
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, self.path)
-        self.op_n = 0
-        self.storage.op_n = 0
-        if not self.closed:
-            # the sidecar lock is held throughout — no ownership window
-            self._op_file = open(self.path, "ab", buffering=0)
-            self._remap_after_snapshot()
-            self.storage.op_writer = self._op_file
-            self.storage.op_sync = self.wal_fsync
+        try:
+            # re-pick in-memory encodings (introduces run containers where
+            # smallest — roaring.go:1594 Optimize before write); lazy entries
+            # keep their already-optimal on-disk encoding
+            self.storage.optimize()
+            with open(tmp, "wb") as f:
+                # still-lazy containers pass their raw payloads straight from
+                # the old mmap — unread data is never parsed, only copied; the
+                # optimize() above already picked encodings, so write skips a
+                # second selection scan. The blake2b trailer makes any later
+                # in-place damage detectable at open().
+                self.storage.write_snapshot(
+                    failpoints.wrap_writer("storage.snapshot.write", f),
+                    optimized=True)
+                f.flush()
+                os.fsync(f.fileno())
+            failpoints.hit("storage.snapshot.replace")
+            os.replace(tmp, self.path)
+        except Exception:
+            # the write-then-rename protocol means a failure ANYWHERE here
+            # leaves the old snapshot + WAL intact on disk: drop the partial
+            # tmp file and re-attach the WAL so the fragment keeps serving
+            # (and the next snapshot attempt starts clean)
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+            if not self.closed and self._op_file is None:
+                self._op_file = open(self.path, "ab", buffering=0)
+                self.storage.op_writer = self._op_file
+                self.storage.op_sync = self.wal_fsync
+            raise
+        # the snapshot has landed: whatever happens below (dir fsync EIO,
+        # reopen/remap failure), the WAL-attachment invariant must be
+        # restored — a closed op_writer left dangling would fail every
+        # later write with a misleading "closed file" error
+        try:
+            if self.wal_fsync:
+                # fsync the directory so the rename itself survives power
+                # loss (the file's fsync alone doesn't persist the dir entry)
+                dfd = os.open(os.path.dirname(self.path) or ".", os.O_RDONLY)
+                try:
+                    os.fsync(dfd)
+                finally:
+                    os.close(dfd)
+            if not self.closed:
+                # the sidecar lock is held throughout — no ownership window
+                self._op_file = open(self.path, "ab", buffering=0)
+                # the trailer digest was computed by write_snapshot one
+                # syscall ago: skip re-hashing the whole section on remap
+                self._remap_after_snapshot()
+        finally:
+            if not self.closed:
+                if self._op_file is None:
+                    try:
+                        self._op_file = open(self.path, "ab", buffering=0)
+                    except OSError:
+                        # can't reopen the WAL at all: POISON it so writes
+                        # refuse loudly — op_writer=None alone would make
+                        # _write_op ack writes while logging nothing
+                        # (silent durability loss)
+                        self.storage.wal_poisoned = True
+                self.storage.op_writer = self._op_file
+                self.storage.op_sync = self.wal_fsync
+            self.op_n = 0
+            self.storage.op_n = 0
         self._volatile = False  # persisted: WAL re-attached, durable again
         self.volatile_mutations = 0
 
@@ -832,7 +981,9 @@ class Fragment:
         from pilosa_tpu.storage.roaring import LazyContainer
 
         old = self.storage
-        self._map()  # fresh lazy parse of the new file
+        # fresh lazy parse of the new file; this process just computed the
+        # trailer digest while writing it, so skip the re-verification
+        self._map(verify=False)
         if getattr(old.containers, "VECTORIZED_STORE", False):
             # the snapshot just serialized base+overlay compacted; the
             # fresh parse covers everything, and walking a billion-entry

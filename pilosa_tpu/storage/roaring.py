@@ -34,9 +34,11 @@ File format (docs/architecture.md, roaring/roaring.go:812-985):
 
 from __future__ import annotations
 
+import hashlib
 import io
 import os
 import struct
+import zlib
 from typing import Iterator, Optional
 
 import numpy as np
@@ -62,6 +64,79 @@ TYPE_RUN = 3
 OP_ADD = 0
 OP_REMOVE = 1
 OP_SIZE = 13
+
+# CRC-framed WAL records (v1): legacy 13-byte records begin with the op
+# type (0 or 1) and carry an fnv1a32 of the body; framed records carry a
+# magic + version prefix and a zlib CRC32 over the whole body, so recovery
+# can distinguish "torn tail" from "valid record" byte-exactly. Both forms
+# parse; new appends are always framed.
+OP_MAGIC = 0xFA  # never a legacy op type, never the snapshot-trailer magic
+OP_VERSION = 1
+FRAMED_OP_SIZE = 15  # magic u8 | version u8 | type u8 | value u64 | crc32 u32
+
+# Snapshot integrity trailer, appended by write_snapshot() after the
+# container section: magic | snapshot-section length u64 | blake2b-16
+# digest of the section. The WAL appends AFTER the trailer; parse skips it
+# once verified. Files without one (legacy, or network payloads written by
+# write_to/to_bytes) parse unverified.
+SNAP_TRAILER_MAGIC = b"PTS1"
+SNAP_TRAILER_SIZE = 4 + 8 + 16
+
+
+class CorruptionError(ValueError):
+    """Snapshot-section integrity failure (trailer digest mismatch): the
+    file's container data cannot be trusted. Distinct from a torn WAL tail,
+    which recovery truncates — this is the quarantine signal."""
+
+
+def frame_op(typ: int, value: int) -> bytes:
+    """One CRC32-framed WAL record."""
+    body = struct.pack("<BBBQ", OP_MAGIC, OP_VERSION, typ, value)
+    return body + struct.pack("<I", zlib.crc32(body))
+
+
+class _HashingWriter:
+    """Pass-through writer computing a running blake2b-16 + byte count —
+    how write_snapshot digests the stream without buffering it."""
+
+    __slots__ = ("w", "h", "n")
+
+    def __init__(self, w):
+        self.w = w
+        self.h = hashlib.blake2b(digest_size=16)
+        self.n = 0
+
+    def write(self, data) -> int:
+        self.w.write(data)
+        self.h.update(data)
+        # nbytes, not len(): the frozen store streams memoryviews of
+        # structured/uint16 arrays, where len() counts elements
+        n = memoryview(data).nbytes
+        self.n += n
+        return n
+
+
+def _valid_record_after(data, pos: int, n: int) -> bool:
+    """True if any offset past `pos` parses as a checksum-valid op record
+    — the discriminator between a torn TAIL (garbage to EOF; safe to
+    truncate, nothing after it was acked) and mid-log bit-rot (intact
+    acked records follow the damage; truncation would silently discard
+    them). False-positive odds are one checksum collision in random
+    garbage (~2^-32 per candidate byte), and the failure mode of a false
+    positive is the conservative one (quarantine + replica rebuild)."""
+    for off in range(pos + 1, n - FRAMED_OP_SIZE + 1):
+        lead = data[off]
+        if lead == OP_MAGIC:
+            _m, ver, typ, _value, chk = struct.unpack_from("<BBBQI", data,
+                                                           off)
+            if ver == OP_VERSION and typ in (OP_ADD, OP_REMOVE) \
+                    and chk == zlib.crc32(bytes(data[off:off + 11])):
+                return True
+        elif lead in (OP_ADD, OP_REMOVE) and off + OP_SIZE <= n:
+            (chk,) = struct.unpack_from("<I", data, off + 9)
+            if chk == fnv1a32(bytes(data[off:off + 9])):
+                return True
+    return False
 
 
 def fnv1a32(data: bytes) -> int:
@@ -485,6 +560,16 @@ class Bitmap:
         self.op_writer: Optional[io.RawIOBase] = None
         self.op_sync = False  # fsync after each op (fragment plumbs config)
         self.op_n = 0
+        # WAL recovery report, set by from_bytes(recover_wal=True): the
+        # absolute offset where valid op records end, and the parse error
+        # (None = clean) — Fragment.open truncates the torn tail there
+        self.wal_valid_end: Optional[int] = None
+        self.wal_error: Optional[str] = None
+        # set when a failed append could not be rewound off the log: the
+        # file ends in garbage that recovery would truncate ALONG WITH any
+        # record appended after it, so further appends must refuse rather
+        # than ack doomed writes (cleared by snapshot, which rewrites)
+        self.wal_poisoned = False
         if values is not None:
             self.add_many(np.asarray(values, dtype=np.uint64))
 
@@ -550,11 +635,41 @@ class Bitmap:
         self._write_op(OP_REMOVE, value)
         return changed
 
+    def _check_wal_clean(self) -> None:
+        if self.wal_poisoned:
+            raise OSError(
+                "WAL poisoned by an earlier failed append (un-rewindable "
+                "torn record); snapshot the fragment to restore durability")
+
+    def _rewind_torn_write(self, n_written: int, torn: Exception) -> None:
+        """A surviving process must not leave torn bytes mid-log: recovery
+        truncates at the FIRST bad record, so any record acked after the
+        garbage would be silently discarded at the next open. Rewind the
+        file to the pre-write boundary (a crash between write and rewind
+        leaves the torn tail — exactly what recovery truncates, with
+        nothing acked after it). If even the rewind fails (dying disk),
+        poison the WAL so no future append can be acked-but-doomed."""
+        try:
+            end = os.fstat(self.op_writer.fileno()).st_size
+            os.ftruncate(self.op_writer.fileno(), end - n_written)
+        except (OSError, ValueError):
+            self.wal_poisoned = True
+        raise torn
+
     def _write_op(self, typ: int, value: int) -> None:
+        # poisoned check FIRST: a poisoned WAL may have op_writer=None
+        # (failed re-attach after snapshot) and must refuse, not silently
+        # ack writes that would never be logged
+        self._check_wal_clean()
         if self.op_writer is None:
             return
-        body = struct.pack("<BQ", typ, value)
-        self.op_writer.write(body + struct.pack("<I", fnv1a32(body)))
+        from pilosa_tpu.utils import failpoints
+        rec, torn = failpoints.corrupt_write("storage.wal.append",
+                                             frame_op(typ, value))
+        self.op_writer.write(rec)
+        if torn is not None:
+            # the op was NOT acked: rewind the partial record off the log
+            self._rewind_torn_write(len(rec), torn)
         if self.op_sync:
             os.fsync(self.op_writer.fileno())
         self.op_n += 1
@@ -565,16 +680,22 @@ class Bitmap:
         anti-entropy adoptions, where the alternative is a full snapshot
         rewriting the whole fragment. Caller has already applied the
         mutations; these are redo records for replay."""
+        self._check_wal_clean()  # before the None check — see _write_op
         if self.op_writer is None:
             return
+        from pilosa_tpu.utils import failpoints
         parts = []
         for typ, vals in ((OP_ADD, adds), (OP_REMOVE, removes)):
             for v in np.asarray(vals, dtype=np.uint64).tolist():
-                body = struct.pack("<BQ", typ, int(v))
-                parts.append(body + struct.pack("<I", fnv1a32(body)))
+                parts.append(frame_op(typ, int(v)))
         if not parts:
             return
-        self.op_writer.write(b"".join(parts))
+        buf, torn = failpoints.corrupt_write("storage.wal.append",
+                                             b"".join(parts))
+        self.op_writer.write(buf)
+        if torn is not None:
+            # all-or-nothing: the whole delta is unacked, rewind it all
+            self._rewind_torn_write(len(buf), torn)
         if self.op_sync:
             os.fsync(self.op_writer.fileno())
         self.op_n += len(parts)
@@ -879,15 +1000,37 @@ class Bitmap:
         self.write_to(buf)
         return buf.getvalue()
 
+    def write_snapshot(self, w, optimized: bool = False) -> int:
+        """write_to + the blake2b integrity trailer — the durable-file
+        variant (Fragment snapshots and fresh-file seeds). Network payloads
+        and non-authoritative writes keep using write_to: the trailer is a
+        property of files that a crash or bit-rot can damage in place."""
+        hw = _HashingWriter(w)
+        self.write_to(hw, optimized=optimized)
+        w.write(SNAP_TRAILER_MAGIC + struct.pack("<Q", hw.n)
+                + hw.h.digest())
+        return hw.n + SNAP_TRAILER_SIZE
+
     @classmethod
-    def from_bytes(cls, data, lazy: bool = False) -> "Bitmap":
+    def from_bytes(cls, data, lazy: bool = False,
+                   recover_wal: bool = False,
+                   verify: bool = True) -> "Bitmap":
         """Parse either Pilosa format (magic 12348, + trailing op-log replay,
         roaring/roaring.go:886-975) or the official RoaringFormatSpec
         (cookies 12346/12347, roaring/roaring.go:3825-3985).
 
         lazy=True (Pilosa format only — `data` should be an mmap) defers
         container payload parsing to first access via LazyContainer: the
-        zero-copy UnmarshalBinary analog (fragment.go:224)."""
+        zero-copy UnmarshalBinary analog (fragment.go:224).
+
+        recover_wal=True (fragment open path): a torn/corrupt op-log TAIL
+        stops replay at the last valid record instead of raising — the
+        caller truncates the file there (wal_error / wal_valid_end record
+        what happened). Snapshot-section damage (a failed trailer digest)
+        still raises CorruptionError: that file needs quarantine, not a
+        trim. verify=False skips the trailer digest computation (callers
+        that just wrote the file themselves); structural trailer checks
+        still apply."""
         if len(data) < HEADER_BASE_SIZE:
             raise ValueError("data too small")
         (magic,) = struct.unpack_from("<H", data, 0)
@@ -917,7 +1060,8 @@ class Bitmap:
             # per-container loop below is interpreter-bound at this scale
             b.containers, ops_offset = parse_pilosa_frozen(
                 data, key_n, desc_off, off_off)
-            return cls._replay_ops(b, data, ops_offset)
+            return cls._replay_ops(b, data, ops_offset, recover=recover_wal,
+                                   verify=verify)
         for i in range(key_n):
             key, code, n_minus_1 = struct.unpack_from("<QHH", data, desc_off + i * 12)
             (offset,) = struct.unpack_from("<I", data, off_off + i * 4)
@@ -936,48 +1080,132 @@ class Bitmap:
                 c, consumed = Container.from_payload(code, n_minus_1 + 1, mv[offset:])
                 b._store(int(key), c)
             ops_offset = offset + consumed
-        return cls._replay_ops(b, data, ops_offset)
+        return cls._replay_ops(b, data, ops_offset, recover=recover_wal,
+                               verify=verify)
 
     @classmethod
-    def _replay_ops(cls, b: "Bitmap", data, ops_offset: int) -> "Bitmap":
-        """Trailing op-log replay — batched native parse when available
-        (order-preserving runs applied via the bulk paths). Shared by the
-        per-container and frozen parse paths."""
-        if ops_offset < len(data):
+    def _verify_trailer(cls, data, ops_offset: int,
+                        verify: bool = True) -> int:
+        """Detect + verify the snapshot trailer at ops_offset; returns the
+        offset where op records actually start (past the trailer, or
+        ops_offset unchanged for trailer-less data). Raises CorruptionError
+        on a digest/length mismatch — the quarantine signal. verify=False
+        skips the digest (still parses + length-checks the trailer)."""
+        n = len(data)
+        if n - ops_offset < SNAP_TRAILER_SIZE \
+                or bytes(data[ops_offset:ops_offset + 4]) != SNAP_TRAILER_MAGIC:
+            return ops_offset
+        (body_len,) = struct.unpack_from("<Q", data, ops_offset + 4)
+        digest = bytes(data[ops_offset + 12:ops_offset + 28])
+        if body_len != ops_offset:
+            raise CorruptionError(
+                f"snapshot trailer length mismatch: trailer says {body_len} "
+                f"bytes, container section is {ops_offset}")
+        if verify:
+            actual = hashlib.blake2b(memoryview(data)[:ops_offset],
+                                     digest_size=16).digest()
+            if actual != digest:
+                raise CorruptionError(
+                    "snapshot integrity check failed: blake2b digest "
+                    f"mismatch over {ops_offset} bytes")
+        return ops_offset + SNAP_TRAILER_SIZE
+
+    @classmethod
+    def _replay_ops(cls, b: "Bitmap", data, ops_offset: int,
+                    recover: bool = False, verify: bool = True) -> "Bitmap":
+        """Trailing op-log replay: skip/verify the snapshot trailer, then
+        parse framed (CRC32) and legacy (fnv1a32) records in sequence —
+        mixed logs happen when an old file gains framed appends after an
+        upgrade. Batched native parse still serves fully-legacy logs.
+
+        recover=True: a torn/corrupt record STOPS replay — b.wal_error and
+        b.wal_valid_end record the damage for the caller to truncate.
+        Truncation is only safe for a genuine TAIL tear (nothing acked
+        follows a crash's partial write); if intact, checksum-valid
+        records exist AFTER the damage, the corruption is mid-log bit-rot
+        and those records are acked data — that raises CorruptionError so
+        the caller quarantines and rebuilds from a replica instead of
+        silently discarding them. recover=False (network payloads): raise,
+        as before."""
+        ops_offset = cls._verify_trailer(data, ops_offset, verify=verify)
+        n = len(data)
+        pos = ops_offset
+        if pos < n and data[pos] in (OP_ADD, OP_REMOVE):
             from pilosa_tpu import native
-            parsed = native.oplog_parse(bytes(data[ops_offset:]))
+            parsed = native.oplog_parse(bytes(data[pos:]))
             if parsed is not None:
                 types, values = parsed
-                if types.size:
-                    bounds = np.flatnonzero(np.diff(types)) + 1
-                    for t_run, v_run in zip(np.split(types, bounds),
-                                            np.split(values, bounds)):
-                        if t_run[0] == OP_ADD:
-                            b.add_many(v_run)
-                        else:
-                            b.remove_many(v_run)
+                cls._apply_op_runs(b, types, values)
                 b.op_n += int(types.size)
+                b.wal_valid_end = n
                 return b
-        pos = ops_offset
-        while pos < len(data):
-            if pos + OP_SIZE > len(data):
-                raise ValueError(f"op data out of bounds: len={len(data) - pos}")
-            body = data[pos : pos + 9]
-            (chk,) = struct.unpack_from("<I", data, pos + 9)
-            if chk != fnv1a32(body):
-                raise ValueError("checksum mismatch")
-            typ, value = struct.unpack("<BQ", body)
-            saved, b.op_writer = b.op_writer, None
-            if typ == OP_ADD:
-                b.add(value)
-            elif typ == OP_REMOVE:
-                b.remove(value)
+        ops_t: list[int] = []
+        ops_v: list[int] = []
+        err = None
+        while pos < n:
+            lead = data[pos]
+            if lead == OP_MAGIC:
+                if pos + FRAMED_OP_SIZE > n:
+                    err = f"op data out of bounds: len={n - pos}"
+                    break
+                _magic, ver, typ, value, chk = struct.unpack_from(
+                    "<BBBQI", data, pos)
+                if ver != OP_VERSION:
+                    err = f"unknown op record version: {ver}"
+                    break
+                if chk != zlib.crc32(bytes(data[pos:pos + 11])):
+                    err = "checksum mismatch"
+                    break
+                if typ not in (OP_ADD, OP_REMOVE):
+                    err = f"invalid op type: {typ}"
+                    break
+                size = FRAMED_OP_SIZE
+            elif lead in (OP_ADD, OP_REMOVE):
+                if pos + OP_SIZE > n:
+                    err = f"op data out of bounds: len={n - pos}"
+                    break
+                body = data[pos:pos + 9]
+                (chk,) = struct.unpack_from("<I", data, pos + 9)
+                if chk != fnv1a32(body):
+                    err = "checksum mismatch"
+                    break
+                typ, value = struct.unpack("<BQ", body)
+                size = OP_SIZE
             else:
-                raise ValueError(f"invalid op type: {typ}")
-            b.op_writer = saved
-            b.op_n += 1
-            pos += OP_SIZE
+                err = f"invalid op type: {lead}"
+                break
+            ops_t.append(typ)
+            ops_v.append(value)
+            pos += size
+        if err is not None and not recover:
+            raise ValueError(err)
+        if err is not None and _valid_record_after(data, pos, n):
+            raise CorruptionError(
+                f"op log corrupt mid-stream at offset {pos} ({err}) with "
+                "valid records after the damage — acked data would be "
+                "lost by truncation; quarantining for replica rebuild")
+        if ops_t:
+            cls._apply_op_runs(b, np.asarray(ops_t, dtype=np.uint8),
+                               np.asarray(ops_v, dtype=np.uint64))
+            b.op_n += len(ops_t)
+        b.wal_valid_end = pos
+        b.wal_error = err
         return b
+
+    @staticmethod
+    def _apply_op_runs(b: "Bitmap", types: np.ndarray,
+                       values: np.ndarray) -> None:
+        """Apply an op sequence via the bulk paths, preserving order
+        (consecutive same-type runs collapse into one add_many/remove_many)."""
+        if types.size == 0:
+            return
+        bounds = np.flatnonzero(np.diff(types)) + 1
+        for t_run, v_run in zip(np.split(types, bounds),
+                                np.split(values, bounds)):
+            if t_run[0] == OP_ADD:
+                b.add_many(v_run)
+            else:
+                b.remove_many(v_run)
 
     # Official RoaringFormatSpec cookies (readOfficialHeader,
     # roaring/roaring.go:3825): 12347 = with runs, 12346 = without.

@@ -15,8 +15,38 @@ from pilosa_tpu.parallel.mesh import force_platform
 force_platform("cpu", host_devices=8)
 
 import jax  # noqa: E402
+import pytest  # noqa: E402
 
 
 def pytest_sessionstart(session):
     assert jax.devices()[0].platform == "cpu", jax.devices()
     assert len(jax.devices()) == 8, jax.devices()
+
+
+@pytest.fixture(autouse=True)
+def _failpoint_isolation():
+    """Failpoint state is process-global (utils/failpoints.py): reset it
+    around every test so a leaked activation can never bleed into an
+    unrelated test's I/O paths."""
+    from pilosa_tpu.utils import failpoints
+
+    failpoints.reset()
+    yield
+    failpoints.reset()
+
+
+@pytest.hookimpl(hookwrapper=True)
+def pytest_runtest_makereport(item, call):
+    """On a chaos-marked test failure, print the chaos seed and the exact
+    fired-failpoint schedule — the replay recipe (re-arm the same seed, or
+    re-fire the logged schedule via explicit configure() calls)."""
+    out = yield
+    rep = out.get_result()
+    if rep.when == "call" and rep.failed \
+            and item.get_closest_marker("chaos") is not None:
+        from pilosa_tpu.utils import failpoints
+
+        rep.sections.append((
+            "chaos replay",
+            "deterministic replay recipe (seed + fired schedule):\n"
+            + failpoints.describe()))

@@ -1,0 +1,454 @@
+"""Failpoint framework + crash/corruption recovery units.
+
+Covers utils/failpoints.py (registry, actions, chaos schedule, counters),
+the CRC-framed WAL (torn-tail truncation on reopen, legacy/mixed logs), the
+blake2b snapshot trailer (quarantine on digest failure), and the hardened
+snapshot/open error paths.
+"""
+
+import io
+import os
+import struct
+
+import numpy as np
+import pytest
+
+from pilosa_tpu.storage.fragment import Fragment
+from pilosa_tpu.storage.roaring import (
+    OP_ADD,
+    OP_MAGIC,
+    OP_REMOVE,
+    SNAP_TRAILER_MAGIC,
+    Bitmap,
+    CorruptionError,
+    fnv1a32,
+    frame_op,
+)
+from pilosa_tpu.utils import failpoints
+
+
+def legacy_op(typ, value):
+    body = struct.pack("<BQ", typ, value)
+    return body + struct.pack("<I", fnv1a32(body))
+
+
+# -- framework --------------------------------------------------------------
+
+
+def test_unknown_failpoint_name_rejected():
+    with pytest.raises(KeyError):
+        failpoints.configure("storage.wal.appendd", "raise")
+
+
+def test_kind_must_be_allowed_for_point():
+    with pytest.raises(ValueError, match="does not support"):
+        failpoints.configure("net.client.send", "truncate-write")
+
+
+def test_raise_delay_times_and_counters():
+    fired = 0
+    with failpoints.failpoint("storage.fragment.open", "raise", times=2):
+        for _ in range(4):
+            try:
+                failpoints.hit("storage.fragment.open")
+            except failpoints.FailpointError:
+                fired += 1
+    assert fired == 2  # times=2 bounds total firings
+    c = failpoints.counters()["storage.fragment.open"]
+    assert c["evaluations"] == 4 and c["fired"] == 2
+    # inactive after the context manager — and with nothing armed, hit()
+    # is a no-op that doesn't even count (the zero-overhead fast path)
+    failpoints.hit("storage.fragment.open")
+    snap = failpoints.snapshot()
+    assert snap["points"]["storage.fragment.open"]["evaluations"] == 4
+    assert len(snap["logTail"]) == 2
+    assert snap["logTail"][0]["kind"] == "raise"
+
+
+def test_custom_exception_type():
+    class Boom(Exception):
+        pass
+
+    with failpoints.failpoint("executor.fanout", "raise"):
+        with pytest.raises(Boom):
+            failpoints.hit("executor.fanout", exc=Boom)
+
+
+def test_corrupt_write_and_read_helpers():
+    with failpoints.failpoint("storage.wal.append", "truncate-write",
+                              arg=0.5):
+        data, exc = failpoints.corrupt_write("storage.wal.append",
+                                             b"0123456789")
+        assert data == b"01234" and isinstance(exc, failpoints.FailpointError)
+    with failpoints.failpoint("net.client.read", "partial-read", arg=0.3):
+        assert failpoints.corrupt_read("net.client.read", b"0123456789") \
+            == b"012"
+    # inactive: pass-through
+    data, exc = failpoints.corrupt_write("storage.wal.append", b"xy")
+    assert data == b"xy" and exc is None
+
+
+def test_chaos_schedule_is_deterministic_per_seed():
+    def run():
+        failpoints.reset()
+        failpoints.arm_chaos(1234, rate=0.5,
+                             points={"executor.fanout", "net.client.send"})
+        outcomes = []
+        for i in range(40):
+            name = ("executor.fanout", "net.client.send")[i % 2]
+            try:
+                act = failpoints.hit(name)
+                outcomes.append(("ok", None if act is None else act.kind))
+            except failpoints.FailpointError:
+                outcomes.append(("raise", None))
+        log = failpoints.schedule_log()
+        failpoints.reset()
+        return outcomes, log
+
+    a, la = run()
+    b, lb = run()
+    assert a == b and la == lb
+    assert any(kind == "raise" for kind, _ in a)  # rate=0.5 actually fires
+    assert la and la[0]["seq"] == 1
+
+
+def test_chaos_env_arming(monkeypatch):
+    monkeypatch.setenv("PILOSA_TPU_CHAOS_SEED", "77")
+    monkeypatch.setenv("PILOSA_TPU_CHAOS_RATE", "1.0")
+    monkeypatch.setenv("PILOSA_TPU_CHAOS_POINTS", "net.client.send")
+    failpoints.reset()
+    failpoints._maybe_arm_from_env()
+    snap = failpoints.snapshot()
+    assert snap["armed"] and snap["chaos"]["seed"] == 77
+    assert snap["chaos"]["points"] == ["net.client.send"]
+    # only the listed point draws (rate=1.0: every evaluation fires some
+    # allowed kind — raise or delay)
+    for _ in range(5):
+        try:
+            failpoints.hit("net.client.send")
+        except failpoints.FailpointError:
+            pass
+        failpoints.hit("executor.fanout")  # not in points: never fires
+    c = failpoints.counters()
+    assert c["net.client.send"]["fired"] == 5
+    assert c.get("executor.fanout", {"fired": 0})["fired"] == 0
+
+
+# -- CRC-framed WAL ---------------------------------------------------------
+
+
+def test_framed_record_roundtrip_and_crc():
+    rec = frame_op(OP_ADD, 12345)
+    assert len(rec) == 15 and rec[0] == OP_MAGIC
+    b = Bitmap(np.array([1], dtype=np.uint64))
+    data = b.to_bytes() + rec
+    back = Bitmap.from_bytes(data)
+    assert back.contains(12345) and back.op_n == 1
+    # flip a byte in the value: CRC catches it
+    bad = bytearray(rec)
+    bad[5] ^= 0xFF
+    with pytest.raises(ValueError, match="checksum"):
+        Bitmap.from_bytes(b.to_bytes() + bytes(bad))
+
+
+def test_legacy_and_mixed_oplog_replay():
+    b = Bitmap(np.array([7], dtype=np.uint64))
+    snap = b.to_bytes()
+    # legacy-only (pre-framing files), then legacy + framed (a log that
+    # gained framed appends after an upgrade)
+    legacy = legacy_op(OP_ADD, 100) + legacy_op(OP_REMOVE, 7)
+    back = Bitmap.from_bytes(snap + legacy)
+    assert back.contains(100) and not back.contains(7) and back.op_n == 2
+    mixed = legacy + frame_op(OP_ADD, 200) + frame_op(OP_REMOVE, 100)
+    back = Bitmap.from_bytes(snap + mixed)
+    assert back.contains(200) and not back.contains(100)
+    assert back.op_n == 4
+
+
+def test_network_parse_still_rejects_torn_tail():
+    b = Bitmap(np.array([1], dtype=np.uint64))
+    torn = b.to_bytes() + frame_op(OP_ADD, 5)[:9]
+    with pytest.raises(ValueError, match="out of bounds"):
+        Bitmap.from_bytes(torn)  # recover_wal=False: refuse, as before
+    back = Bitmap.from_bytes(torn, recover_wal=True)
+    assert back.wal_error is not None
+    assert back.wal_valid_end == len(b.to_bytes())
+    assert not back.contains(5)
+
+
+def test_torn_write_in_surviving_process_is_rewound(tmp_path):
+    """A torn append in a process that KEEPS RUNNING must rewind the file
+    to the record boundary: otherwise a later acked record lands after
+    the garbage, and the next open's truncate-at-first-tear would silently
+    discard it (acked-write loss)."""
+    path = str(tmp_path / "frag")
+    f = Fragment(path, "i", "f", "standard", 0).open()
+    for col in range(10):
+        f.set_bit(1, col)  # acked, WAL-framed
+    with failpoints.failpoint("storage.wal.append", "truncate-write",
+                              arg=0.4, times=1):
+        with pytest.raises(failpoints.FailpointError):
+            f.set_bit(2, 999)
+    # the partial record was rewound off the log: later acked writes are
+    # safe even though the process never restarted
+    f.set_bit(3, 5)  # acked AFTER the tear
+    f.close()
+    g = Fragment(path, "i", "f", "standard", 0).open()
+    assert g.wal_truncated_bytes == 0  # nothing torn on disk
+    assert g.row_columns(1).tolist() == list(range(10))
+    assert g.row_count(2) == 0  # the torn op was never acked
+    assert g.contains(3, 5)  # the post-tear acked write survived
+    g.close()
+
+
+def test_fragment_reopen_truncates_torn_wal_tail(tmp_path):
+    """A crash mid-append (no chance to rewind) leaves a partial record at
+    EOF: reopen replays everything acked and truncates the tear."""
+    path = str(tmp_path / "frag")
+    f = Fragment(path, "i", "f", "standard", 0).open()
+    for col in range(10):
+        f.set_bit(1, col)
+    f.close()
+    with open(path, "ab") as fh:  # the crash's torn half-record
+        fh.write(frame_op(OP_ADD, 12345)[:7])
+    g = Fragment(path, "i", "f", "standard", 0).open()
+    assert g.row_columns(1).tolist() == list(range(10))
+    assert not g.contains(0, 12345 % (1 << 20))
+    assert g.wal_truncated_bytes == 7 and g.wal_truncate_error
+    # the file is clean again: appends + reopen work
+    g.set_bit(3, 5)
+    g.close()
+    h = Fragment(path, "i", "f", "standard", 0).open()
+    assert h.wal_truncated_bytes == 0
+    assert h.contains(3, 5) and h.row_count(1) == 10
+    h.close()
+
+
+def test_fragment_reopen_truncates_garbage_tail(tmp_path):
+    """Arbitrary appended garbage (bit-rot past the last record) is
+    truncated, not fatal — the pre-framing behavior was a refused open."""
+    path = str(tmp_path / "frag")
+    f = Fragment(path, "i", "f", "standard", 0).open()
+    f.set_bit(0, 1)
+    f.close()
+    with open(path, "ab") as fh:
+        fh.write(b"\x7fgarbage-not-an-op-record")
+    g = Fragment(path, "i", "f", "standard", 0).open()
+    assert g.contains(0, 1)
+    assert g.wal_truncated_bytes == 25
+    g.close()
+    # idempotent: second reopen is clean
+    h = Fragment(path, "i", "f", "standard", 0).open()
+    assert h.wal_truncated_bytes == 0
+    h.close()
+
+
+# -- snapshot integrity trailer --------------------------------------------
+
+
+def test_snapshot_carries_verified_trailer(tmp_path):
+    path = str(tmp_path / "frag")
+    f = Fragment(path, "i", "f", "standard", 0).open()
+    f.bulk_import([1, 2, 3], [10, 20, 30])  # bulk path snapshots
+    f.close()
+    raw = open(path, "rb").read()
+    assert SNAP_TRAILER_MAGIC in raw
+    g = Fragment(path, "i", "f", "standard", 0).open()
+    assert g.quarantine_path is None
+    assert g.row_columns(1).tolist() == [10]
+    # WAL appends land AFTER the trailer and replay across it
+    g.set_bit(5, 50)
+    g.close()
+    h = Fragment(path, "i", "f", "standard", 0).open()
+    assert h.contains(5, 50) and h.row_columns(2).tolist() == [20]
+    h.close()
+
+
+def test_corrupt_snapshot_quarantined_not_fatal(tmp_path):
+    path = str(tmp_path / "frag")
+    f = Fragment(path, "i", "f", "standard", 0).open()
+    f.bulk_import([1] * 64, list(range(64)))
+    f.set_bit(2, 7)  # one WAL record after the snapshot
+    f.close()
+    # bit-rot INSIDE the container payload (the section is 30 bytes:
+    # 8 header + 12 desc + 4 offset + [nruns u16 | start u16 | last u16]).
+    # Byte 27 flips the run's start value: STRUCTURALLY valid — only the
+    # digest can catch it (flipping a size-bearing byte instead trips the
+    # bounds checks first, which also quarantines)
+    with open(path, "r+b") as fh:
+        fh.seek(27)
+        byte = fh.read(1)
+        fh.seek(27)
+        fh.write(bytes([byte[0] ^ 0xFF]))
+    g = Fragment(path, "i", "f", "standard", 0).open()
+    # quarantined + reopened empty: the node came up, data awaits rebuild
+    assert g.quarantine_path and os.path.exists(g.quarantine_path)
+    assert "blake2b" in g.corruption_error
+    assert g.needs_rebuild and g.bit_count() == 0
+    # fully writable (fresh file, trailer included)
+    g.set_bit(0, 0)
+    g.close()
+    # reopen of the FRESH file is clean, and the sidecar lock was managed
+    # correctly throughout (no leak: this open would fail "locked")
+    h = Fragment(path, "i", "f", "standard", 0).open()
+    assert h.quarantine_path is None and h.contains(0, 0)
+    h.close()
+
+
+def test_trailer_length_mismatch_quarantines(tmp_path):
+    path = str(tmp_path / "frag")
+    f = Fragment(path, "i", "f", "standard", 0).open()
+    f.bulk_import([1], [5])
+    f.close()
+    raw = open(path, "rb").read()
+    idx = raw.rindex(SNAP_TRAILER_MAGIC)
+    mangled = raw[:idx + 4] + struct.pack("<Q", 12) + raw[idx + 12:]
+    with open(path, "wb") as fh:
+        fh.write(mangled)
+    g = Fragment(path, "i", "f", "standard", 0).open()
+    assert g.quarantine_path and "length mismatch" in g.corruption_error
+    g.close()
+
+
+def test_legacy_snapshot_without_trailer_still_opens(tmp_path):
+    """Pre-trailer fragment files (write_to output + legacy WAL) parse
+    unverified — upgrades must not quarantine every existing file."""
+    path = str(tmp_path / "frag")
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    b = Bitmap(np.array([3, 70000], dtype=np.uint64))
+    with open(path, "wb") as fh:
+        b.write_to(fh)
+        fh.write(legacy_op(OP_ADD, 9))
+    f = Fragment(path, "i", "f", "standard", 0).open()
+    assert f.quarantine_path is None
+    assert f.contains(0, 3) and f.contains(0, 9) and f.contains(1, 70000 % (1 << 20)) is not None
+    # first snapshot upgrades the file to the trailered format
+    f.snapshot()
+    f.close()
+    assert SNAP_TRAILER_MAGIC in open(path, "rb").read()
+    g = Fragment(path, "i", "f", "standard", 0).open()
+    assert g.contains(0, 9)
+    g.close()
+
+
+def test_failed_snapshot_keeps_old_file_serving(tmp_path):
+    """A snapshot that dies mid-write (torn tmp file) must leave the old
+    snapshot + WAL intact, re-attach the WAL, and not strand a partial
+    .snapshotting file."""
+    path = str(tmp_path / "frag")
+    f = Fragment(path, "i", "f", "standard", 0).open()
+    for col in range(8):
+        f.set_bit(1, col)
+    with failpoints.failpoint("storage.snapshot.write", "truncate-write",
+                              arg=0.5, times=1):
+        with pytest.raises(failpoints.FailpointError):
+            f.snapshot()
+    assert not os.path.exists(path + ".snapshotting")
+    # still serving, still WAL-attached: later writes are durable
+    f.set_bit(1, 100)
+    assert f.storage.op_writer is not None
+    f.close()
+    g = Fragment(path, "i", "f", "standard", 0).open()
+    assert g.row_columns(1).tolist() == list(range(8)) + [100]
+    # and a clean snapshot works afterwards
+    g.snapshot()
+    g.close()
+
+
+def test_append_ops_torn_buffer_rewound(tmp_path):
+    """append_ops (anti-entropy small-adoption durability) torn mid-buffer
+    in a surviving process: the WHOLE unacked delta is rewound — none of
+    it may survive as a partial adoption, and later appends stay safe."""
+    path = str(tmp_path / "frag")
+    f = Fragment(path, "i", "f", "standard", 0).open()
+    f.set_bit(0, 0)
+    with failpoints.failpoint("storage.wal.append", "truncate-write",
+                              arg=0.55, times=1):
+        with pytest.raises(failpoints.FailpointError):
+            f.storage.append_ops(
+                np.arange(10, 20, dtype=np.uint64),
+                np.empty(0, dtype=np.uint64))
+    f.set_bit(0, 3)  # acked after the tear: must survive
+    f.close()
+    g = Fragment(path, "i", "f", "standard", 0).open()
+    assert g.contains(0, 0) and g.contains(0, 3)
+    assert g.wal_truncated_bytes == 0
+    assert not any(g.contains(0, c) for c in range(10, 20))
+    g.close()
+
+
+def test_midlog_wal_bitrot_quarantines_not_truncates(tmp_path):
+    """Bit-rot in a MIDDLE record with valid acked records after it must
+    NOT truncate (that would silently discard the acked suffix): it
+    quarantines for replica rebuild, like snapshot corruption."""
+    path = str(tmp_path / "frag")
+    f = Fragment(path, "i", "f", "standard", 0).open()
+    for col in range(5):
+        f.set_bit(1, col)  # 5 framed, fsyncable, ACKED records
+    f.close()
+    size = os.path.getsize(path)
+    with open(path, "r+b") as fh:
+        fh.seek(size - 5 * 15 + 5)  # a value byte of the FIRST record
+        b = fh.read(1)
+        fh.seek(size - 5 * 15 + 5)
+        fh.write(bytes([b[0] ^ 0xFF]))
+    g = Fragment(path, "i", "f", "standard", 0).open()
+    assert g.quarantine_path and "mid-stream" in g.corruption_error
+    assert g.wal_truncated_bytes == 0 and g.needs_rebuild
+    g.close()
+
+
+def test_unregistered_hit_raises_when_armed():
+    with failpoints.failpoint("executor.fanout", "raise", times=0):
+        with pytest.raises(KeyError, match="unregistered"):
+            failpoints.hit("storage.wal.appendd")
+
+
+def test_crash_torn_append_ops_buffer_recovers(tmp_path):
+    """The crash shape of the same tear (process died before any rewind):
+    whole records before the tear replay, the torn one truncates."""
+    path = str(tmp_path / "frag")
+    f = Fragment(path, "i", "f", "standard", 0).open()
+    f.set_bit(0, 0)
+    f.close()
+    buf = b"".join(frame_op(OP_ADD, c) for c in range(10, 20))
+    with open(path, "ab") as fh:
+        fh.write(buf[:82])  # 5 whole records + 7 torn bytes of the 6th
+    g = Fragment(path, "i", "f", "standard", 0).open()
+    assert g.contains(0, 0)
+    assert g.wal_truncated_bytes == 7
+    survivors = [c for c in range(10, 20) if g.contains(0, c)]
+    assert survivors == list(range(10, 15))
+    g.close()
+
+
+def test_wal_fsync_env_overrides_config(tmp_path, monkeypatch):
+    # config says always -> fragment fsyncs
+    f = Fragment(str(tmp_path / "a"), "i", "f", "standard", 0,
+                 wal_fsync=True)
+    assert f.wal_fsync is True
+    # env override wins over config in BOTH directions
+    monkeypatch.setenv("PILOSA_TPU_WAL_FSYNC", "off")
+    f = Fragment(str(tmp_path / "b"), "i", "f", "standard", 0,
+                 wal_fsync=True)
+    assert f.wal_fsync is False
+    monkeypatch.setenv("PILOSA_TPU_WAL_FSYNC", "always")
+    f = Fragment(str(tmp_path / "c"), "i", "f", "standard", 0,
+                 wal_fsync=False)
+    assert f.wal_fsync is True
+    monkeypatch.delenv("PILOSA_TPU_WAL_FSYNC")
+    f = Fragment(str(tmp_path / "d"), "i", "f", "standard", 0)
+    assert f.wal_fsync is False
+
+
+def test_wal_fsync_config_plumbs_to_fragment(tmp_path):
+    from pilosa_tpu.models import Holder
+
+    h = Holder(str(tmp_path), wal_fsync=True)
+    h.open()
+    idx = h.create_index("i")
+    fld = idx.create_field("f")
+    v = fld.create_view_if_not_exists("standard")
+    frag = v.create_fragment_if_not_exists(0)
+    assert frag.wal_fsync is True and frag.storage.op_sync is True
+    h.close()
