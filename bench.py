@@ -818,6 +818,9 @@ def bench_http(tmpdir) -> dict:
 
 PROFILER_ROUNDS = int(os.environ.get("PILOSA_BENCH_PROFILER_ROUNDS", "5"))
 PROFILER_QUERIES = int(os.environ.get("PILOSA_BENCH_PROFILER_QUERIES", "60"))
+TELEMETRY_ROUNDS = int(os.environ.get("PILOSA_BENCH_TELEMETRY_ROUNDS", "5"))
+TELEMETRY_QUERIES = int(os.environ.get(
+    "PILOSA_BENCH_TELEMETRY_QUERIES", "60"))
 
 
 def bench_profiler(tmpdir) -> dict:
@@ -895,6 +898,83 @@ def bench_profiler(tmpdir) -> dict:
                     "interleaved profile_mode=off/on rounds; off = the nop "
                     "fast path (one ContextVar.get per site), on = full "
                     "QueryProfile recording incl. dispatch attribution",
+        }
+    finally:
+        srv.close()
+
+
+def bench_telemetry(tmpdir) -> dict:
+    """Telemetry sampler overhead A/B (budget: <= 1%): one server,
+    interleaved sampler-stopped/running rounds of keep-alive Count
+    queries, sampler at a punishing 10 ms interval (50-500x the
+    production default) so the measured number is a worst-case bound.
+    The sampler tick walks fragments and snapshots residency/batcher/
+    pool gauges on a background thread — the A/B answers whether that
+    walk steals latency from the serving path."""
+    import http.client
+    import statistics
+
+    from pilosa_tpu.server import Server
+
+    srv = Server(os.path.join(tmpdir, "telem"), port=0,
+                 telemetry_interval=0.01, telemetry_ring=720).open()
+    try:
+        host = srv.uri.split("//", 1)[1]
+        conn = http.client.HTTPConnection(host, timeout=60)
+
+        def post(path, body):
+            conn.request("POST", path, body=body)
+            resp = conn.getresponse()
+            out = resp.read()
+            if resp.status != 200:
+                raise RuntimeError(f"{path}: {resp.status}: {out[:200]}")
+            return json.loads(out)
+
+        post("/index/tm", b"{}")
+        post("/index/tm/field/f", b"{}")
+        rng = np.random.default_rng(29)
+        cols = rng.choice(4 * SHARD_WIDTH, size=100_000, replace=False)
+        half = len(cols) // 2
+        post("/index/tm/field/f/import", json.dumps({
+            "rowIDs": [0] * half + [1] * (len(cols) - half),
+            "columnIDs": cols.tolist()}).encode())
+        q = b"Count(Intersect(Row(f=0), Row(f=1)))"
+        for _ in range(5):
+            post("/index/tm/query", q)  # warm residency + compile
+
+        def median_ms(sampler_on: bool) -> float:
+            if sampler_on:
+                srv.telemetry.start()
+            else:
+                srv.telemetry.stop()
+            lats = []
+            for _ in range(TELEMETRY_QUERIES):
+                t0 = time.perf_counter()
+                post("/index/tm/query", q)
+                lats.append((time.perf_counter() - t0) * 1e3)
+            return statistics.median(lats)
+
+        rounds = []
+        for _ in range(TELEMETRY_ROUNDS):
+            rnd = {"ms_off": round(median_ms(False), 4),
+                   "ms_on": round(median_ms(True), 4)}
+            rnd["overhead_pct"] = round(
+                100.0 * (rnd["ms_on"] / rnd["ms_off"] - 1.0), 2) \
+                if rnd["ms_off"] else 0.0
+            rounds.append(rnd)
+        ring_len = len(srv.telemetry.ring)
+        overheads = sorted(r["overhead_pct"] for r in rounds)
+        return {
+            "metric": "telemetry_overhead_pct",
+            "value": overheads[len(overheads) // 2],
+            "unit": "% (sampler on vs off, median latency; budget <= 1%)",
+            "rounds": rounds,
+            "ring_samples": ring_len,
+            "sampler_interval_s": 0.01,
+            "vs_baseline": 0.0,
+            "path": "single-stream keep-alive Count(Intersect) loopback, "
+                    "interleaved sampler stopped/running rounds at a 10 ms "
+                    "interval (worst case; production default is 5 s)",
         }
     finally:
         srv.close()
@@ -1212,6 +1292,7 @@ def worker() -> None:
         holder.close()
         stage("http", bench_http, tmp)
         stage("profiler", bench_profiler, tmp)
+        stage("telemetry", bench_telemetry, tmp)
         stage("distributed", bench_distributed, tmp)
     finally:
         shutil.rmtree(tmp, ignore_errors=True)

@@ -12,6 +12,7 @@ from __future__ import annotations
 import csv
 import io
 import os
+import time
 from datetime import datetime, timezone
 from typing import Optional
 
@@ -120,6 +121,16 @@ class API:
         # the one-line printf as the operator surface; size is the
         # [cluster] query-history-size knob
         self.query_history = qprofile.QueryHistory(100)
+        # fleet telemetry hooks (utils/telemetry.py); set by Server.
+        # health_fn() -> the node's own health score, reported on /status
+        # so load balancers and the /cluster/stats federation share ONE
+        # health definition; node_stats_fn() -> this node's stats document
+        # (GET /internal/stats); cluster_stats_fn() -> the merged fleet
+        # document (GET /cluster/stats, coordinator-or-any-node fan-out).
+        self.health_fn = None
+        self.node_stats_fn = None
+        self.cluster_stats_fn = None
+        self.start_time = time.time()  # uptime_seconds on /status
 
     def _broadcast(self, msg: dict) -> None:
         if self.broadcast_fn is not None:
@@ -727,12 +738,24 @@ class API:
         return self.cluster.state
 
     def status(self) -> dict:
-        return {"state": self.cluster.state, "nodes": self.hosts(),
-                "localID": self.cluster.local_id,
-                # each node's coordinator claim; the probe loop converges
-                # divergent claims onto the electoral authority's (see
-                # Server._probe_peers)
-                "coordinatorID": self.cluster.coordinator_id}
+        out = {"state": self.cluster.state, "nodes": self.hosts(),
+               "localID": self.cluster.local_id,
+               # each node's coordinator claim; the probe loop converges
+               # divergent claims onto the electoral authority's (see
+               # Server._probe_peers)
+               "coordinatorID": self.cluster.coordinator_id,
+               # load-balancer surface: uptime + version + the node's own
+               # health score — the SAME health_score() the /cluster/stats
+               # federation computes, so the two can never disagree
+               "uptimeSeconds": int(time.time() - self.start_time),
+               "version": __version__}
+        if self.health_fn is not None:
+            try:
+                out["health"] = self.health_fn()
+            except Exception:  # noqa: BLE001 — a health-input failure must
+                # not take down the liveness probe surface itself
+                out["health"] = {"score": "unknown", "reasons": []}
+        return out
 
     def info(self) -> dict:
         import os

@@ -99,6 +99,12 @@ class MetricConfig:
     service: str = "expvar"  # expvar | statsd | nop
     host: str = "127.0.0.1:8125"  # statsd agent address
     poll_interval: float = 0.0
+    # fleet telemetry sampler (utils/telemetry.py): seconds between gauge
+    # snapshots into the /debug/timeseries ring (0 disables; the
+    # PILOSA_TPU_TELEMETRY=0 env var kills it regardless), and the ring's
+    # bounded sample capacity (720 x 5s = one hour of history)
+    telemetry_interval: float = 5.0
+    telemetry_ring: int = 720
 
 
 @dataclass
@@ -159,6 +165,9 @@ class Config:
     bind: str = "localhost:10101"
     max_writes_per_request: int = 5000
     log_path: str = ""
+    # "plain" (default) or "json": structured log lines carrying the
+    # active trace id as a proper `trace` field (utils/logger.py)
+    log_format: str = "plain"
     verbose: bool = False
     tls: TLSConfig = field(default_factory=TLSConfig)
     cluster: ClusterConfig = field(default_factory=ClusterConfig)
@@ -228,6 +237,7 @@ class Config:
             f'data-dir = "{self.data_dir}"',
             f'bind = "{self.bind}"',
             f"max-writes-per-request = {self.max_writes_per_request}",
+            f'log-format = "{self.log_format}"',
             f"verbose = {str(self.verbose).lower()}",
             "",
             "[tls]",
@@ -259,6 +269,8 @@ class Config:
             f'service = "{self.metric.service}"',
             f'host = "{self.metric.host}"',
             f"poll-interval = {self.metric.poll_interval}",
+            f"telemetry-interval = {self.metric.telemetry_interval}",
+            f"telemetry-ring = {self.metric.telemetry_ring}",
             "",
             "[diagnostics]",
             f'url = "{self.diagnostics.url}"',

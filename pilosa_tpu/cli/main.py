@@ -49,6 +49,10 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--mesh-devices",
                     help="device mesh: auto (all local devices when >1), "
                          "none, or an integer count")
+    sp.add_argument("--log-format", choices=["plain", "json"],
+                    help="log line format; json carries trace=<id> as a "
+                         "proper field so logs join the query-history/"
+                         "profile surfaces mechanically")
     sp.add_argument("--verbose", action="store_true")
 
     ip = sub.add_parser("import", help="bulk-import CSV (row,col or col,value)")
@@ -111,6 +115,8 @@ def cmd_server(args) -> int:
         cfg.storage.wal_fsync = args.wal_fsync
     if getattr(args, "mesh_devices", None):
         cfg.mesh.devices = args.mesh_devices
+    if getattr(args, "log_format", None):
+        cfg.log_format = args.log_format
 
     import os
     from pilosa_tpu.parallel.mesh import mesh_from_config
@@ -151,6 +157,9 @@ def cmd_server(args) -> int:
         metric_service=cfg.metric.service,
         metric_host=cfg.metric.host,
         metric_poll_interval=cfg.metric.poll_interval,
+        telemetry_interval=cfg.metric.telemetry_interval,
+        telemetry_ring=cfg.metric.telemetry_ring,
+        log_format=cfg.log_format,
         diagnostics_url=cfg.diagnostics.url,
         diagnostics_interval=cfg.diagnostics.interval,
         tls_certificate=cfg.tls.certificate,

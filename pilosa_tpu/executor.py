@@ -227,6 +227,17 @@ class Executor:
         return self._get_pool("_hedge_pool", max(4, self.fanout_pool_size),
                               "pilosa-hedge")
 
+    def fanout_pool_stats(self) -> dict:
+        """Outbound fan-out pool occupancy for telemetry — WITHOUT forcing
+        the lazy pool into existence (an idle node keeps zero threads)."""
+        pool = self._fanout_pool
+        if pool is None:
+            return {"size": max(4, self.fanout_pool_size),
+                    "threads": 0, "queued": 0}
+        return {"size": pool._max_workers,
+                "threads": len(pool._threads),
+                "queued": pool._work_queue.qsize()}
+
     def shutdown(self) -> None:
         """Stop the executor-owned pools (called from Server.close)."""
         with self._pool_lock:

@@ -325,6 +325,13 @@ class InternalClient:
         out = self._request("GET", uri, "/status", timeout=timeout)
         return json.loads(out) if out else {}
 
+    def node_stats(self, uri: str, timeout: Optional[float] = None) -> dict:
+        """One peer's fleet-telemetry document (GET /internal/stats).
+        Peers that predate the route raise ClientError(status=404) — the
+        federation degrades them to "legacy", never an error."""
+        out = self._request("GET", uri, "/internal/stats", timeout=timeout)
+        return json.loads(out) if out else {}
+
     def translate_keys(self, uri: str, index: str, field: Optional[str],
                        keys: list[str], create: bool = True) -> list:
         out = self._json("POST", uri, "/internal/translate/keys",

@@ -1,0 +1,277 @@
+"""`GET /debug/dashboard`: a self-contained live fleet dashboard.
+
+One HTML file, zero external assets — inline CSS, inline JS, inline SVG
+sparklines — so it works air-gapped from any node's port with nothing but
+the node itself (pinned by the tier-1 no-external-URLs test in
+tests/test_telemetry.py). Data comes from the same JSON surfaces
+operators script against: `/cluster/stats` (fleet table + per-node
+time-series tails, fetched once per refresh) and `/debug/timeseries`
+(the serving node's full-resolution rings, fetched incrementally with
+the `since` cursor so each sample crosses the wire once).
+"""
+
+from __future__ import annotations
+
+# Colors follow the repo-external dataviz method: status colors carry an
+# icon + text label (never color alone), series lines are the categorical
+# slot-1 blue, text wears text tokens, and the dark mode is selected
+# (its own steps), not an automatic flip.
+DASHBOARD_HTML = """<!DOCTYPE html>
+<html lang="en">
+<head>
+<meta charset="utf-8">
+<meta name="viewport" content="width=device-width, initial-scale=1">
+<title>pilosa-tpu fleet telemetry</title>
+<style>
+:root {
+  color-scheme: light;
+  --surface: #fcfcfb; --panel: #f0efec;
+  --text: #0b0b0b; --text-2: #52514e; --grid: #d8d7d2;
+  --series: #2a78d6;
+  --good: #008300; --warn: #eda100; --bad: #e34948; --muted: #52514e;
+}
+@media (prefers-color-scheme: dark) {
+  :root {
+    color-scheme: dark;
+    --surface: #1a1a19; --panel: #262625;
+    --text: #ffffff; --text-2: #c3c2b7; --grid: #3a3a38;
+    --series: #3987e5;
+    --good: #1baf7a; --warn: #c98500; --bad: #e66767; --muted: #c3c2b7;
+  }
+}
+* { box-sizing: border-box; }
+body { margin: 0; padding: 16px 20px; background: var(--surface);
+  color: var(--text);
+  font: 13px/1.45 ui-monospace, SFMono-Regular, Menlo, Consolas, monospace; }
+h1 { font-size: 16px; margin: 0 0 2px; font-weight: 600; }
+h2 { font-size: 13px; margin: 18px 0 6px; color: var(--text-2);
+  font-weight: 600; text-transform: uppercase; letter-spacing: .04em; }
+.sub { color: var(--text-2); margin-bottom: 12px; }
+table { border-collapse: collapse; width: 100%; }
+th, td { text-align: left; padding: 4px 10px 4px 0; white-space: nowrap; }
+th { color: var(--text-2); font-weight: 600; border-bottom: 1px solid
+  var(--grid); }
+tr + tr td { border-top: 1px solid var(--grid); }
+td.num, th.num { text-align: right; }
+.health { font-weight: 600; }
+.health .dot { display: inline-block; width: 9px; height: 9px;
+  border-radius: 50%; margin-right: 6px; vertical-align: baseline; }
+.health-green  { color: var(--good); } .health-green  .dot { background: var(--good); }
+.health-yellow { color: var(--warn); } .health-yellow .dot { background: var(--warn); border-radius: 2px; }
+.health-red    { color: var(--bad); }  .health-red    .dot { background: var(--bad); border-radius: 0; }
+.health-legacy, .health-unknown { color: var(--muted); }
+.health-legacy .dot, .health-unknown .dot { background: none;
+  border: 1.5px solid var(--muted); }
+.reasons { color: var(--text-2); white-space: normal; max-width: 340px; }
+svg.spark { display: block; }
+svg.spark polyline { fill: none; stroke: var(--series); stroke-width: 2;
+  stroke-linejoin: round; stroke-linecap: round; }
+svg.spark line.base { stroke: var(--grid); stroke-width: 1; }
+.tiles { display: flex; flex-wrap: wrap; gap: 12px; }
+.tile { background: var(--panel); border-radius: 6px; padding: 10px 12px;
+  min-width: 230px; }
+.tile .name { color: var(--text-2); font-size: 11px; }
+.tile .val { font-size: 18px; font-weight: 600; margin: 2px 0 6px; }
+#err { color: var(--bad); }
+a { color: var(--series); }
+</style>
+</head>
+<body>
+<h1>pilosa-tpu fleet telemetry</h1>
+<div class="sub" id="meta">loading&hellip;</div>
+<div id="err"></div>
+
+<h2>Fleet</h2>
+<table id="fleet"><thead><tr>
+  <th>health</th><th>node</th><th>state</th><th class="num">uptime</th>
+  <th>version</th><th class="num">rss</th><th class="num">HBM resident</th>
+  <th class="num">hit rate</th><th class="num">recompiles</th>
+  <th class="num">damaged</th><th>residency bytes</th><th>queue depth</th>
+  <th class="reasons">why</th>
+</tr></thead><tbody></tbody></table>
+
+<h2>This node (full-resolution rings)</h2>
+<div class="tiles" id="local"></div>
+
+<script>
+"use strict";
+// local ring accumulated incrementally: /debug/timeseries?since=<cursor>
+// transfers each sample exactly once regardless of refresh rate
+let cursor = 0;
+const localSamples = [];   // bounded client-side to the server ring size
+let localLimit = 720;
+const LOCAL_SERIES = [
+  ["residency.bytes", "HBM resident bytes", fmtBytes],
+  ["residency.hit_rate", "residency hit rate (window)", fmtRatio],
+  ["residency.evictions_per_s", "evictions / s", fmtNum],
+  ["batcher.queue_depth", "batcher queue depth", fmtNum],
+  ["batcher.avg_wait_ms", "batch wait ms (window)", fmtNum],
+  ["fanout.queued", "fan-out queued", fmtNum],
+  ["xla.compiles_per_s", "XLA compiles / s", fmtNum],
+  ["wal.bytes", "storage+WAL bytes", fmtBytes],
+  ["process.rss_bytes", "process RSS", fmtBytes],
+];
+
+function fmtBytes(v) {
+  if (v == null) return "–";
+  const u = ["B", "KiB", "MiB", "GiB", "TiB"];
+  let i = 0;
+  while (v >= 1024 && i < u.length - 1) { v /= 1024; i++; }
+  return (i ? v.toFixed(1) : v) + " " + u[i];
+}
+function fmtNum(v) {
+  if (v == null) return "–";
+  return Math.abs(v) >= 100 ? Math.round(v).toString()
+       : (Math.round(v * 100) / 100).toString();
+}
+function fmtRatio(v) { return v == null ? "–" : (100 * v).toFixed(1) + "%"; }
+function fmtUptime(s) {
+  if (s == null) return "–";
+  s = Math.floor(s);
+  const d = Math.floor(s / 86400), h = Math.floor(s % 86400 / 3600),
+        m = Math.floor(s % 3600 / 60);
+  return d ? d + "d" + h + "h" : h ? h + "h" + m + "m" : m + "m" + s % 60 + "s";
+}
+
+// inline SVG sparkline: thin 2px line, baseline rule, <title> hover text.
+// Built as markup (the HTML parser namespaces <svg> itself) so the page
+// contains no URL strings at all — the air-gap test stays trivially true.
+function esc(s) {
+  return String(s).replace(/&/g, "&amp;").replace(/</g, "&lt;");
+}
+function spark(values, w, h, fmt) {
+  const host = document.createElement("span");
+  let inner = '<line class="base" x1="0" y1="' + (h - 1) + '" x2="' + w +
+              '" y2="' + (h - 1) + '"></line>';
+  const pts = values.filter(v => v != null && isFinite(v));
+  if (pts.length > 1) {
+    const lo = Math.min(...pts), hi = Math.max(...pts);
+    const span = (hi - lo) || 1;
+    const step = w / (values.length - 1);
+    const coords = values.map((v, i) => {
+      if (v == null || !isFinite(v)) v = lo;
+      const y = h - 3 - (h - 6) * (v - lo) / span;
+      return (i * step).toFixed(1) + "," + y.toFixed(1);
+    }).join(" ");
+    inner += '<polyline points="' + coords + '"></polyline>' +
+      "<title>" + esc("min " + fmt(lo) + " · max " + fmt(hi) +
+                      " · last " + fmt(pts[pts.length - 1])) + "</title>";
+  }
+  host.innerHTML = '<svg class="spark" width="' + w + '" height="' + h +
+    '" viewBox="0 0 ' + w + " " + h + '">' + inner + "</svg>";
+  return host.firstChild;
+}
+
+function seriesOf(samples, name) {
+  return samples.map(s => {
+    const v = (s.gauges || {})[name];
+    return typeof v === "number" ? v : null;
+  });
+}
+
+function healthCell(score, reasons) {
+  const td = document.createElement("td");
+  td.className = "health health-" + score;
+  const dot = document.createElement("span");
+  dot.className = "dot";
+  td.appendChild(dot);
+  td.appendChild(document.createTextNode(score));
+  if (reasons && reasons.length) td.title = reasons.join("; ");
+  return td;
+}
+
+function td(text, num) {
+  const el = document.createElement("td");
+  if (num) el.className = "num";
+  el.textContent = text;
+  return el;
+}
+
+function renderFleet(doc) {
+  const meta = document.getElementById("meta");
+  const f = doc.fleet || {};
+  meta.textContent = "fleet " + (f.health || "?") + " · " +
+    (f.nodes || []).length + " node(s)" +
+    Object.entries(f.counts || {}).filter(([, n]) => n)
+      .map(([k, n]) => " · " + n + " " + k).join("") +
+    " · reported by " + (doc.generatedBy || "?") + " at " +
+    new Date().toLocaleTimeString();
+  const body = document.querySelector("#fleet tbody");
+  body.textContent = "";
+  for (const n of (f.nodes || [])) {
+    const tr = document.createElement("tr");
+    const h = n.health || {};
+    tr.appendChild(healthCell(h.score || "unknown", h.reasons));
+    tr.appendChild(td((n.id || "?").slice(0, 12) + "  " + (n.uri || "")));
+    tr.appendChild(td(n.state || "–"));
+    tr.appendChild(td(fmtUptime(n.uptimeSeconds), true));
+    tr.appendChild(td(n.version || "–"));
+    const g = (n.gauges || {});
+    tr.appendChild(td(fmtBytes(g["process.rss_bytes"]), true));
+    tr.appendChild(td(fmtBytes(g["residency.bytes"]), true));
+    tr.appendChild(td(fmtRatio(g["residency.hit_rate"]), true));
+    tr.appendChild(td(fmtNum(g["xla.compiles"]), true));
+    tr.appendChild(td(fmtNum(n.damagedFragments || 0), true));
+    const samples = (n.timeseries || {}).samples || [];
+    for (const name of ["residency.bytes", "batcher.queue_depth"]) {
+      const cell = document.createElement("td");
+      cell.appendChild(spark(seriesOf(samples, name), 120, 26,
+        name === "residency.bytes" ? fmtBytes : fmtNum));
+      tr.appendChild(cell);
+    }
+    const why = document.createElement("td");
+    why.className = "reasons";
+    why.textContent = (h.reasons || []).join("; ");
+    tr.appendChild(why);
+    body.appendChild(tr);
+  }
+}
+
+function renderLocal() {
+  const root = document.getElementById("local");
+  root.textContent = "";
+  for (const [name, label, fmt] of LOCAL_SERIES) {
+    const vals = seriesOf(localSamples, name);
+    if (!vals.some(v => v != null)) continue;
+    const tile = document.createElement("div");
+    tile.className = "tile";
+    const nm = document.createElement("div");
+    nm.className = "name"; nm.textContent = label;
+    const last = [...vals].reverse().find(v => v != null);
+    const val = document.createElement("div");
+    val.className = "val"; val.textContent = fmt(last);
+    tile.appendChild(nm); tile.appendChild(val);
+    tile.appendChild(spark(vals, 220, 40, fmt));
+    root.appendChild(tile);
+  }
+  if (!root.children.length) {
+    root.textContent = "no samples yet (telemetry sampler off or warming)";
+  }
+}
+
+async function refresh() {
+  const err = document.getElementById("err");
+  try {
+    const ts = await (await fetch("/debug/timeseries?since=" + cursor)).json();
+    cursor = ts.seq || cursor;
+    if (ts.ringSize) localLimit = ts.ringSize;
+    for (const s of (ts.samples || [])) localSamples.push(s);
+    while (localSamples.length > localLimit) localSamples.shift();
+    renderLocal();
+    const cs = await (await fetch("/cluster/stats")).json();
+    renderFleet(cs);
+    err.textContent = "";
+  } catch (e) {
+    err.textContent = "refresh failed: " + e;
+  }
+  setTimeout(refresh, 4000);
+}
+refresh();
+</script>
+</body>
+</html>
+"""
+
+
+def render_dashboard() -> str:
+    return DASHBOARD_HTML

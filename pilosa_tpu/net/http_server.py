@@ -41,8 +41,11 @@ ROUTES: list[tuple[str, re.Pattern, str]] = [
     ("GET", re.compile(r"^/schema$"), "get_schema"),
     ("GET", re.compile(r"^/status$"), "get_status"),
     ("GET", re.compile(r"^/version$"), "get_version"),
+    ("GET", re.compile(r"^/cluster/stats$"), "get_cluster_stats"),
     ("GET", re.compile(r"^/debug/vars$"), "get_debug_vars"),
     ("GET", re.compile(r"^/debug/query-history$"), "get_query_history"),
+    ("GET", re.compile(r"^/debug/timeseries$"), "get_debug_timeseries"),
+    ("GET", re.compile(r"^/debug/dashboard$"), "get_debug_dashboard"),
     ("GET", re.compile(r"^/metrics$"), "get_metrics"),
     ("GET", re.compile(r"^/debug/pprof(?:/(?P<profile>[^/]*))?$"), "get_debug_pprof"),
     # internal
@@ -57,6 +60,7 @@ ROUTES: list[tuple[str, re.Pattern, str]] = [
     ("DELETE", re.compile(r"^/internal/index/(?P<index>[^/]+)/field/(?P<field>[^/]+)/remote-available-shards/(?P<shard>\d+)$"), "delete_remote_available_shard"),
     ("GET", re.compile(r"^/internal/nodes$"), "get_nodes"),
     ("GET", re.compile(r"^/internal/probe$"), "get_internal_probe"),
+    ("GET", re.compile(r"^/internal/stats$"), "get_internal_stats"),
     ("POST", re.compile(r"^/internal/query-batch$"), "post_query_batch"),
     ("GET", re.compile(r"^/internal/shards/max$"), "get_shards_max"),
     ("GET", re.compile(r"^/internal/translate/data$"), "get_translate_data"),
@@ -81,6 +85,7 @@ ALLOWED_QUERY_ARGS: dict[str, frozenset] = {
     "get_fragment_nodes": frozenset({"index", "shard"}),
     "get_translate_data": frozenset({"offset"}),
     "get_debug_pprof": frozenset({"seconds"}),
+    "get_debug_timeseries": frozenset({"since", "limit"}),
 }
 
 
@@ -89,11 +94,13 @@ class Handler:
 
     def __init__(self, api: API,
                  cluster_message_fn: Optional[Callable[[dict], None]] = None,
-                 stats=None, query_timeout: float = 0.0):
+                 stats=None, query_timeout: float = 0.0, telemetry=None):
         self.api = api
         self.cluster_message_fn = cluster_message_fn
         self.stats = stats
         self.query_timeout = query_timeout  # [cluster] query-timeout default
+        self.telemetry = telemetry  # TelemetrySampler (GET /debug/timeseries)
+        self.errors_5xx = 0  # cumulative 5xx responses (health-score input)
         self.serializer = Serializer()
         self._local = threading.local()
 
@@ -162,16 +169,23 @@ class Handler:
                     from pilosa_tpu.utils import failpoints
                     failpoints.hit("http.server.dispatch")
                     dl_token = self._set_deadline(name, query, headers)
-                    return handler(match.groupdict(), query, body)
+                    resp = handler(match.groupdict(), query, body)
                 except qctx.QueryTimeoutError as e:
-                    return self._error(504, str(e))
+                    resp = self._error(504, str(e))
                 except ApiError as e:
-                    return self._error(e.status, str(e), code=e.code)
+                    resp = self._error(e.status, str(e), code=e.code)
                 except Exception as e:  # noqa: BLE001 — surface as 500
-                    return self._error(500, str(e))
+                    resp = self._error(500, str(e))
                 finally:
                     if dl_token is not None:
                         qctx.deadline.reset(dl_token)
+                if resp[0] >= 500:
+                    # server-error rate feeds the node health score (the
+                    # telemetry sampler derives errors/s from this)
+                    self.errors_5xx += 1
+                    if self.stats is not None:
+                        self.stats.count("http/serverErrors")
+                return resp
         finally:
             if token is not None:
                 tracing.current_trace_id.reset(token)
@@ -451,21 +465,125 @@ class Handler:
         long-query-time is set, so slow queries normally carry one)."""
         return self._json({"queries": self.api.query_history.snapshot()})
 
+    def get_debug_timeseries(self, params, query, body):
+        """Incremental time-series ring data (utils/telemetry.py sampler):
+        `?since=<seq>` returns only samples newer than the cursor, so a
+        poller transfers each sample once; the response's `seq` is the
+        next cursor. Memory stays bounded by the ring regardless of how
+        many pollers exist or how rarely they poll."""
+        from pilosa_tpu.utils import telemetry as _telemetry
+        try:
+            since = int(self._arg(query, "since", "0"))
+            limit = int(self._arg(query, "limit", "0"))
+        except ValueError:
+            raise ApiError("since and limit must be integers")
+        if self.telemetry is None:
+            return self._json({"seq": 0, "interval": 0.0, "ringSize": 0,
+                               "enabled": False, "samples": []})
+        out = self.telemetry.ring.since(since, limit)
+        out["interval"] = self.telemetry.interval
+        out["ringSize"] = self.telemetry.ring.size
+        out["enabled"] = _telemetry.enabled() and self.telemetry.running
+        return self._json(out)
+
+    def get_debug_dashboard(self, params, query, body):
+        """Self-contained live fleet dashboard (net/dashboard.py): one
+        HTML file, inline CSS/JS/SVG, zero external assets — works
+        air-gapped from any node's port."""
+        from pilosa_tpu.net.dashboard import render_dashboard
+        return 200, "text/html; charset=utf-8", render_dashboard().encode()
+
+    def get_internal_stats(self, params, query, body):
+        """This node's fleet-telemetry document (fanned over by a peer's
+        /cluster/stats). Nodes that predate this route 404 it, and the
+        federation marks them "legacy" — never an error."""
+        if self.api.node_stats_fn is None:
+            raise ApiError("node stats not supported", status=501)
+        return self._json(self.api.node_stats_fn())
+
+    def get_cluster_stats(self, params, query, body):
+        """The merged fleet document: every live peer's stats snapshot
+        collected over the persistent fan-out pool, with per-node health
+        scores (legacy peers degrade to "legacy"; down peers are "red")."""
+        if self.api.cluster_stats_fn is None:
+            raise ApiError("cluster stats not supported", status=501)
+        return self._json(self.api.cluster_stats_fn())
+
     def get_metrics(self, params, query, body):
         """Prometheus text exposition of the StatsClient snapshot
         (GET /metrics): counters, gauges, set cardinalities, and the log2
         timing buckets converted to cumulative `_bucket{le=...}` series
         with `_sum`/`_count` (utils/stats.py prometheus_exposition). The
-        expvar JSON at /debug/vars stays; this is the scrape surface."""
+        expvar JSON at /debug/vars stays; this is the scrape surface.
+        Gauges that previously lived only in /debug/vars — HBM residency,
+        damaged fragments, batcher queues, hedges, XLA compile counters —
+        are merged in here so scrapers can alert on them."""
         from pilosa_tpu.utils import failpoints
+        from pilosa_tpu.utils import telemetry as _telemetry
         from pilosa_tpu.utils.stats import prometheus_exposition
         snap = self.stats.snapshot() if self.stats is not None else {}
-        fired = {f"failpoints/{name}": c["fired"]
-                 for name, c in failpoints.counters().items() if c["fired"]}
-        if fired:
-            counts = dict(snap.get("counts", {}))
-            counts.update(fired)
-            snap = dict(snap, counts=counts)
+        counts = dict(snap.get("counts", {}))
+        gauges = dict(snap.get("gauges", {}))
+        counts.update({f"failpoints/{name}": c["fired"]
+                       for name, c in failpoints.counters().items()
+                       if c["fired"]})
+        ex = getattr(self.api, "executor", None)
+        res = getattr(ex, "residency", None) if ex is not None else None
+        if res is not None:
+            rs = res.snapshot()
+            gauges["residency/bytes"] = rs["bytes"]
+            gauges["residency/budget"] = float(res.budget)
+            gauges["residency/entries"] = rs["entries"]
+            # WINDOWED hit rate (the sampler's, when it runs): a lifetime
+            # ratio stays >0.9 for hours after a warm node starts
+            # thrashing, which would suppress the churn alert exactly
+            # when it matters; lifetime ratio is the cold-start fallback
+            latest = (self.telemetry.ring.latest()
+                      if self.telemetry is not None else {})
+            lookups = rs["hits"] + rs["misses"]
+            gauges["residency/hitRate"] = latest.get(
+                "residency.hit_rate",
+                rs["hits"] / lookups if lookups else 1.0)
+            counts["residency/hits"] = rs["hits"]
+            counts["residency/misses"] = rs["misses"]
+            counts["residency/evictions"] = rs["evictions"]
+        if ex is not None:
+            for attr, kind in (("batcher", "count"),
+                               ("sum_batcher", "planeSum"),
+                               ("minmax_batcher", "minMax")):
+                b = getattr(ex, attr, None)
+                if b is None:
+                    continue
+                bs = b.snapshot()
+                counts[f"batcher/{kind}/batches"] = bs["batches"]
+                counts[f"batcher/{kind}/queries"] = bs["batched_queries"]
+                gauges[f"batcher/{kind}/queueDepth"] = bs["queue_depth"]
+            counts["hedges/fired"] = getattr(ex, "hedges_fired", 0)
+            counts["hedges/won"] = getattr(ex, "hedges_won", 0)
+            counts["hedges/cancelled"] = getattr(ex, "hedges_cancelled", 0)
+        holder = getattr(self.api, "holder", None)
+        if holder is not None:
+            damaged = holder.damaged_fragments()
+            gauges["damagedFragments"] = len(damaged)
+            gauges["damagedFragmentsNeedingRebuild"] = sum(
+                1 for d in damaged if d["needsRebuild"])
+            gauges["walPoisonedFragments"] = sum(
+                1 for *_, frag in holder.walk_fragments()
+                if getattr(getattr(frag, "storage", None),
+                           "wal_poisoned", False))
+        xs = _telemetry.xla.snapshot()
+        for fam, f in xs["families"].items():
+            counts[f"xlaCompiles/{fam}"] = f["compiles"]
+            counts[f"xlaCachedDispatches/{fam}"] = f["cached"]
+        counts["xlaRecompileStorms"] = xs["storms"]
+        if self.api.health_fn is not None:
+            try:
+                score = self.api.health_fn()["score"]
+                gauges["nodeHealth"] = {"green": 0.0, "yellow": 1.0,
+                                        "red": 2.0}.get(score, 1.0)
+            except Exception:  # noqa: BLE001
+                pass  # scrape must never 500 on a health-input failure
+        snap = dict(snap, counts=counts, gauges=gauges)
         body_out = prometheus_exposition(snap)
         return (200, "text/plain; version=0.0.4; charset=utf-8",
                 body_out.encode())

@@ -20,14 +20,13 @@ broadcast over leading axes, so the same code path serves one row, a stacked
 
 from __future__ import annotations
 
-import functools
-
 import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
 from pilosa_tpu.constants import SHARD_WIDTH, WORD_BITS
+from pilosa_tpu.utils.telemetry import counted_jit
 
 # ---------------------------------------------------------------------------
 # Bitwise algebra (reference semantics: roaring/roaring.go:378-750 Intersect/
@@ -35,31 +34,31 @@ from pilosa_tpu.constants import SHARD_WIDTH, WORD_BITS
 # ---------------------------------------------------------------------------
 
 
-@jax.jit
+@counted_jit("bitwise")
 def band(a: jax.Array, b: jax.Array) -> jax.Array:
     """Intersection: a & b."""
     return jnp.bitwise_and(a, b)
 
 
-@jax.jit
+@counted_jit("bitwise")
 def bor(a: jax.Array, b: jax.Array) -> jax.Array:
     """Union: a | b."""
     return jnp.bitwise_or(a, b)
 
 
-@jax.jit
+@counted_jit("bitwise")
 def bxor(a: jax.Array, b: jax.Array) -> jax.Array:
     """Symmetric difference: a ^ b."""
     return jnp.bitwise_xor(a, b)
 
 
-@jax.jit
+@counted_jit("bitwise")
 def bandnot(a: jax.Array, b: jax.Array) -> jax.Array:
     """Difference: a &~ b."""
     return jnp.bitwise_and(a, jnp.bitwise_not(b))
 
 
-@jax.jit
+@counted_jit("bitwise")
 def bnot(a: jax.Array) -> jax.Array:
     """Complement over the full shard width (caller intersects with an
     existence row for Not() semantics, reference executor.go:1478-1520)."""
@@ -77,34 +76,34 @@ def bnot(a: jax.Array) -> jax.Array:
 # ---------------------------------------------------------------------------
 
 
-@jax.jit
+@counted_jit("count")
 def popcount(x: jax.Array) -> jax.Array:
     """Number of set bits, reduced over the last (word) axis -> int32."""
     return jnp.sum(lax.population_count(x).astype(jnp.int32), axis=-1)
 
 
-@jax.jit
+@counted_jit("count")
 def intersect_count(a: jax.Array, b: jax.Array) -> jax.Array:
     """popcount(a & b) without materializing a & b in HBM (XLA fuses)."""
     return popcount(jnp.bitwise_and(a, b))
 
 
-@jax.jit
+@counted_jit("count")
 def union_count(a: jax.Array, b: jax.Array) -> jax.Array:
     return popcount(jnp.bitwise_or(a, b))
 
 
-@jax.jit
+@counted_jit("count")
 def difference_count(a: jax.Array, b: jax.Array) -> jax.Array:
     return popcount(jnp.bitwise_and(a, jnp.bitwise_not(b)))
 
 
-@jax.jit
+@counted_jit("count")
 def xor_count(a: jax.Array, b: jax.Array) -> jax.Array:
     return popcount(jnp.bitwise_xor(a, b))
 
 
-@jax.jit
+@counted_jit("count")
 def row_popcounts(rows: jax.Array) -> jax.Array:
     """Per-row set-bit counts for a stacked [..., rows, words] slab -> int32.
 
@@ -128,7 +127,7 @@ def row_popcounts(rows: jax.Array) -> jax.Array:
 # ---------------------------------------------------------------------------
 
 
-@jax.jit
+@counted_jit("groupby")
 def cross_count_matrix(prefix: jax.Array, axis: jax.Array) -> jax.Array:
     """counts[P, R]: intersection popcounts of every (prefix, axis-row) pair.
 
@@ -157,7 +156,7 @@ def mask_prefix_rows(cmat: jax.Array, n_valid: jax.Array) -> jax.Array:
     return jnp.where(rows < n_valid, cmat, 0)
 
 
-@functools.partial(jax.jit, static_argnames=("bound",))
+@counted_jit("groupby", static_argnames=("bound",))
 def live_from_matrix(cmat: jax.Array, bound: int):
     """On-device zero-count pruning: (n_live, flat_idx[bound], counts[bound]).
 
@@ -187,7 +186,7 @@ def chunk_count_matrix(axis_slabs, idx, axis, n_valid,
                             n_valid)
 
 
-@functools.partial(jax.jit, static_argnames=("bound", "cross_fn"))
+@counted_jit("groupby", static_argnames=("bound", "cross_fn"))
 def groupby_chunk_live(axis_slabs: tuple, idx: tuple, axis: jax.Array,
                        n_valid: jax.Array, bound: int, cross_fn=None):
     """One pipelined GroupBy level chunk, fully on device: the chunk
@@ -197,7 +196,7 @@ def groupby_chunk_live(axis_slabs: tuple, idx: tuple, axis: jax.Array,
     return live_from_matrix(cmat, bound)
 
 
-@functools.partial(jax.jit, static_argnames=("cross_fn",))
+@counted_jit("groupby", static_argnames=("cross_fn",))
 def groupby_chunk_matrix(axis_slabs: tuple, idx: tuple, axis: jax.Array,
                          n_valid: jax.Array, cross_fn=None) -> jax.Array:
     """Dense [chunk, R] count matrix for one chunk — the overflow fallback
@@ -220,7 +219,7 @@ def _bit_positions(n_words: int) -> jax.Array:
     return w * WORD_BITS + b
 
 
-@functools.partial(jax.jit, static_argnames=("n_words",))
+@counted_jit("bitwise", static_argnames=("n_words",))
 def range_mask(start: jax.Array, end: jax.Array, n_words: int) -> jax.Array:
     """uint32[n_words] with bits [start, end) set."""
     pos = _bit_positions(n_words)
@@ -231,17 +230,17 @@ def range_mask(start: jax.Array, end: jax.Array, n_words: int) -> jax.Array:
     return jnp.sum(bits, axis=-1).astype(jnp.uint32)
 
 
-@jax.jit
+@counted_jit("bitwise")
 def set_range(x: jax.Array, mask: jax.Array) -> jax.Array:
     return jnp.bitwise_or(x, mask)
 
 
-@jax.jit
+@counted_jit("bitwise")
 def zero_range(x: jax.Array, mask: jax.Array) -> jax.Array:
     return jnp.bitwise_and(x, jnp.bitwise_not(mask))
 
 
-@jax.jit
+@counted_jit("bitwise")
 def xor_range(x: jax.Array, mask: jax.Array) -> jax.Array:
     return jnp.bitwise_xor(x, mask)
 

@@ -29,6 +29,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from pilosa_tpu.ops.bitvector import popcount
+from pilosa_tpu.utils.telemetry import counted_jit
 
 # Comparison op codes (reference: pql/ast.go:451 Condition ops).
 LT, LTE, GT, GTE, EQ, NEQ = "lt", "lte", "gt", "gte", "eq", "neq"
@@ -39,7 +40,7 @@ def _ones_mask(bit: jax.Array) -> jax.Array:
     return (jnp.uint32(0) - bit.astype(jnp.uint32))[..., None]
 
 
-@jax.jit
+@counted_jit("bsi")
 def plane_counts(planes: jax.Array, filter_row: jax.Array) -> jax.Array:
     """popcount(plane_i & filter) for every plane -> int32[depth, ...].
 
@@ -49,7 +50,7 @@ def plane_counts(planes: jax.Array, filter_row: jax.Array) -> jax.Array:
     return popcount(jnp.bitwise_and(planes, filter_row[None]))
 
 
-@jax.jit
+@counted_jit("bsi")
 def sum_counts(planes: jax.Array, filter_row: jax.Array) -> jax.Array:
     """plane_counts with the filter's own popcount appended as the last row
     -> int32[depth + 1, ...]: everything Sum needs in ONE dispatch and ONE
@@ -102,11 +103,11 @@ def bsi_max(planes: jax.Array, candidate: jax.Array):
     return jnp.stack(bits), popcount(candidate)
 
 
-bsi_min = jax.jit(bsi_min)
-bsi_max = jax.jit(bsi_max)
+bsi_min = counted_jit("bsi")(bsi_min)
+bsi_max = counted_jit("bsi")(bsi_max)
 
 
-@jax.jit
+@counted_jit("bsi")
 def bsi_min_packed(planes: jax.Array, candidate: jax.Array) -> jax.Array:
     """bsi_min with bits and count packed into one int32[depth + 1, ...] —
     single dispatch + single fetch (row depth = attaining-row count)."""
@@ -114,7 +115,7 @@ def bsi_min_packed(planes: jax.Array, candidate: jax.Array) -> jax.Array:
     return jnp.concatenate([bits, cnt[None]], axis=0)
 
 
-@jax.jit
+@counted_jit("bsi")
 def bsi_max_packed(planes: jax.Array, candidate: jax.Array) -> jax.Array:
     bits, cnt = bsi_max(planes, candidate)
     return jnp.concatenate([bits, cnt[None]], axis=0)

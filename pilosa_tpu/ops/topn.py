@@ -23,9 +23,10 @@ import jax.numpy as jnp
 from jax import lax
 
 from pilosa_tpu.ops.bitvector import popcount
+from pilosa_tpu.utils.telemetry import counted_jit
 
 
-@functools.partial(jax.jit, static_argnames=("k",))
+@counted_jit("topn", static_argnames=("k",))
 def top_rows(rows: jax.Array, k: int):
     """(counts, indices) of the k highest-popcount rows of a [R, W] slab.
 
@@ -37,7 +38,7 @@ def top_rows(rows: jax.Array, k: int):
     return lax.top_k(counts, k)
 
 
-@functools.partial(jax.jit, static_argnames=("k",))
+@counted_jit("topn", static_argnames=("k",))
 def top_rows_intersect(rows: jax.Array, src: jax.Array, k: int):
     """Top-k rows ranked by |row ∩ src| (TopN with a Src bitmap argument,
     fragment.go:1063-1080)."""
@@ -46,7 +47,7 @@ def top_rows_intersect(rows: jax.Array, src: jax.Array, k: int):
     return lax.top_k(counts, k)
 
 
-@jax.jit
+@counted_jit("topn")
 def tanimoto_counts(rows: jax.Array, src: jax.Array):
     """Fused per-row (intersection, row, src) counts for Tanimoto filtering.
 
@@ -60,7 +61,7 @@ def tanimoto_counts(rows: jax.Array, src: jax.Array):
     return inter, rcounts, scount
 
 
-@jax.jit
+@counted_jit("topn")
 def tanimoto_mask(inter: jax.Array, rcounts: jax.Array, scount: jax.Array,
                   threshold: jax.Array) -> jax.Array:
     """Boolean keep-mask: 100·inter > threshold·(rcounts + scount − inter).

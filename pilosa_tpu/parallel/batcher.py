@@ -54,6 +54,7 @@ import numpy as np
 
 from pilosa_tpu.ops.bitvector import popcount
 from pilosa_tpu.utils import profile as qprofile
+from pilosa_tpu.utils.telemetry import counted_jit
 
 MAX_BATCH = 512
 _LEGACY = object()  # _dispatch sentinel: subclass only implements _compute
@@ -92,10 +93,11 @@ def _pow2(n: int) -> int:
 
 class _Req:
     __slots__ = ("payload", "event", "result", "exc", "promoted", "done",
-                 "server", "profile")
+                 "server", "profile", "t_submit")
 
     def __init__(self, payload):
         self.payload = payload
+        self.t_submit = time.perf_counter()  # queue-wait telemetry anchor
         self.event = threading.Event()
         self.result = None
         self.exc: Optional[BaseException] = None
@@ -123,10 +125,14 @@ class ContinuousBatcher:
         self._pending: dict[tuple, list[_Req]] = defaultdict(list)
         self._leaders: set[tuple] = set()
         self._leader_threads: dict[tuple, threading.Thread] = {}
-        # observability (surfaced via /debug/vars through executor stats)
+        # observability (surfaced via /debug/vars through executor stats;
+        # the telemetry sampler derives per-window queue depth and wait
+        # rates from the cumulative wait totals)
         self.batches = 0
         self.batched_queries = 0
         self.max_batch_seen = 0
+        self.wait_ms_total = 0.0  # submit -> result delivery, cumulative
+        self.waited = 0  # requests the wait total covers
 
     def submit(self, key: tuple, payload):
         """Enqueue one query under compatibility `key`; blocks until a
@@ -266,10 +272,14 @@ class ContinuousBatcher:
                 raise RuntimeError(
                     f"batcher _compute returned {len(results)} results "
                     f"for {len(batch)} payloads (key={key[:1]})")
+            t_done = time.perf_counter()
             with self._lock:
                 self.batches += 1
                 self.batched_queries += len(batch)
                 self.max_batch_seen = max(self.max_batch_seen, len(batch))
+                self.wait_ms_total += sum(
+                    (t_done - r.t_submit) * 1e3 for r in batch)
+                self.waited += len(batch)
                 seq = self.batches
             if t_cut is not None and any(r.profile is not None
                                          for r in batch):
@@ -317,17 +327,30 @@ class ContinuousBatcher:
     def _compute(self, key: tuple, payloads: list) -> list:
         raise NotImplementedError
 
+    def queue_depth(self) -> int:
+        """Requests currently queued (pre-cut) across every compatibility
+        key — the telemetry sampler's saturation gauge."""
+        with self._lock:
+            return sum(len(q) for q in self._pending.values())
+
     def snapshot(self) -> dict:
         with self._lock:
+            depth = sum(len(q) for q in self._pending.values())
             return {"batches": self.batches,
                     "batched_queries": self.batched_queries,
-                    "max_batch_seen": self.max_batch_seen}
+                    "max_batch_seen": self.max_batch_seen,
+                    "queue_depth": depth,
+                    "wait_ms_total": round(self.wait_ms_total, 3),
+                    "waited": self.waited,
+                    "avg_wait_ms": round(
+                        self.wait_ms_total / self.waited, 3)
+                    if self.waited else 0.0}
 
 
 # ------------------------------------------------------------------ counts
 
 
-@functools.partial(jax.jit, static_argnames=("op",))
+@counted_jit("batcher", static_argnames=("op",))
 def _batched_counts(leaves: tuple, ii: jax.Array, jj: jax.Array,
                     op: str) -> jax.Array:
     """Shard-chunk count partials int32[K, C] for K queries
@@ -481,7 +504,7 @@ def _dedup_masks(payloads: list) -> tuple[list, list[int]]:
     return masks + [masks[0]] * (kp - len(masks)), idx
 
 
-@jax.jit
+@counted_jit("batcher")
 def _batched_plane_sums(planes: jax.Array, masks: tuple) -> jax.Array:
     """Per-query per-plane filtered popcounts with the mask's own count
     appended -> int32[K, depth + 1, C] shard-chunk partials (one dispatch,
@@ -498,7 +521,7 @@ def _batched_plane_sums(planes: jax.Array, masks: tuple) -> jax.Array:
     return both.reshape(k, d1, -1, _SUM_SHARD_CHUNK).sum(axis=-1)
 
 
-@functools.partial(jax.jit, static_argnames=("is_min",))
+@counted_jit("batcher", static_argnames=("is_min",))
 def _batched_min_max(planes: jax.Array, masks: tuple,
                      is_min: bool) -> jax.Array:
     """vmapped packed greedy bit descent: int32[K, depth + 1, S'] (bits

@@ -32,6 +32,7 @@ from pilosa_tpu.ops.bitvector import (
     live_from_matrix,
     popcount,
 )
+from pilosa_tpu.utils.telemetry import counted_jit, record_dispatch
 
 SHARD_AXIS = "shard"
 REPLICA_AXIS = "replica"
@@ -204,20 +205,20 @@ def _eval(leaves: jax.Array, program) -> jax.Array:
     return acc
 
 
-@functools.partial(jax.jit, static_argnames=("program",))
+@counted_jit("program", static_argnames=("program",))
 def eval_row(leaves: jax.Array, program) -> jax.Array:
     """[L, S, W] -> [S, W] dense result rows."""
     return _eval(leaves, program)
 
 
-@functools.partial(jax.jit, static_argnames=("program",))
+@counted_jit("program", static_argnames=("program",))
 def eval_count_total(leaves: jax.Array, program) -> jax.Array:
     """[L, S, W] -> scalar total count. Under a sharded input GSPMD lowers the
     sum to an ICI all-reduce — the Count() reduce (executor.go:1521,2209)."""
     return jnp.sum(popcount(_eval(leaves, program)))
 
 
-@jax.jit
+@counted_jit("stream")
 def count_pair_stream(rows: jax.Array, ii: jax.Array, jj: jax.Array,
                       carry: jax.Array) -> jax.Array:
     """Serve a stream of K Count(Intersect(Row(i), Row(j))) queries against a
@@ -272,11 +273,24 @@ def pair_stream_counts(mesh: Mesh, rows: jax.Array, ii: np.ndarray,
     gather+and+popcount; the only collective is a psum over "shard" (ICI)
     for each query's global count. Returns host int64[K].
     """
-    from jax.experimental.shard_map import shard_map
-
     # on a 1-D ('shard',) mesh there is no replica axis: every device scans
     # the full stream (replicated), sharded only over the data
     ii_d, jj_d, k, rep_spec = scatter_queries(mesh, ii, jj)
+    record_dispatch("stream_mesh", mesh, rows, ii_d, jj_d)
+    out = np.asarray(_pair_stream_fn(mesh)(rows, ii_d, jj_d)).astype(np.int64)
+    return out[:k]
+
+
+@functools.lru_cache(maxsize=None)
+def _pair_stream_fn(mesh: Mesh):
+    """Per-mesh cached shard_map program for pair_stream_counts: a closure
+    rebuilt per call would miss jax.jit's cache (keyed on the function
+    object) and silently recompile EVERY call — which would also make the
+    telemetry dispatch counter report the site as cached while it
+    recompiles (the exact failure the storm detector exists to catch)."""
+    from jax.experimental.shard_map import shard_map
+
+    rep_spec = P(REPLICA_AXIS) if REPLICA_AXIS in mesh.shape else P()
 
     @jax.jit
     @functools.partial(
@@ -294,8 +308,7 @@ def pair_stream_counts(mesh: Mesh, rows: jax.Array, ii: np.ndarray,
         _, counts = jax.lax.scan(body, 0, (ii_blk, jj_blk))
         return counts
 
-    out = np.asarray(run(rows, ii_d, jj_d)).astype(np.int64)
-    return out[:k]
+    return run
 
 
 # -- GroupBy cross-count mesh form -------------------------------------------
@@ -340,6 +353,8 @@ def groupby_chunk_live_mesh(mesh: Mesh, axis_slabs: tuple, idx: tuple,
                             use_pallas: bool = False):
     """Sharded groupby_chunk_live: per-device partial [P, R] counts, one
     ICI psum, on-device prune. Returns device arrays — no host sync."""
+    record_dispatch("groupby_mesh", mesh, len(idx), use_pallas,
+                    tuple(axis_slabs), tuple(idx), axis)
     cmat = _groupby_cmat_mesh_fn(mesh, len(idx), use_pallas)(
         tuple(axis_slabs), tuple(idx), axis, n_valid)
     return live_from_matrix(cmat, bound)
@@ -349,6 +364,8 @@ def groupby_chunk_matrix_mesh(mesh: Mesh, axis_slabs: tuple, idx: tuple,
                               axis: jax.Array, n_valid,
                               use_pallas: bool = False) -> jax.Array:
     """Dense mesh count matrix — the overflow fallback's sharded form."""
+    record_dispatch("groupby_mesh", mesh, len(idx), use_pallas,
+                    tuple(axis_slabs), tuple(idx), axis)
     return _groupby_cmat_mesh_fn(mesh, len(idx), use_pallas)(
         tuple(axis_slabs), tuple(idx), axis, n_valid)
 

@@ -75,7 +75,10 @@ class Server:
                  fanout_coalesce_max_batch: int = 64,
                  hedge_delay: float = 0.0,
                  profile_mode: str = "auto",
-                 query_history_size: int = 100):
+                 query_history_size: int = 100,
+                 telemetry_interval: float = 5.0,
+                 telemetry_ring: int = 720,
+                 log_format: str = "plain"):
         self.data_dir = data_dir
         # [storage] wal-fsync, plumbed down the model tree to every
         # Fragment (PILOSA_TPU_WAL_FSYNC env overrides per fragment —
@@ -108,7 +111,9 @@ class Server:
         self.tracer = Tracer(exporter=exporter,
                              sampler_type=tracing_sampler_type,
                              sampler_param=tracing_sampler_param)
-        self.logger = Logger()
+        # --log-format=json emits structured lines carrying trace=<id> as
+        # a proper field (utils/logger.py); Logger validates the mode
+        self.logger = Logger(fmt=log_format)
         from pilosa_tpu.utils.diagnostics import (
             DiagnosticsCollector,
             RuntimeMonitor,
@@ -150,8 +155,27 @@ class Server:
         self.api.profile_mode = profile_mode
         from pilosa_tpu.utils.profile import QueryHistory
         self.api.query_history = QueryHistory(query_history_size)
+        # fleet telemetry (utils/telemetry.py): background sampler ->
+        # bounded ring served at GET /debug/timeseries; [metric]
+        # telemetry-interval / telemetry-ring knobs, PILOSA_TPU_TELEMETRY=0
+        # kill switch. The federation + /status share node_health().
+        from pilosa_tpu.utils.telemetry import TelemetrySampler
+        if telemetry_ring < 1:
+            raise ValueError(
+                f"invalid [metric] telemetry-ring {telemetry_ring!r} "
+                "(expected >= 1)")
+        self.telemetry = TelemetrySampler(interval=telemetry_interval,
+                                          ring_size=telemetry_ring,
+                                          source=self.sample_gauges,
+                                          logger=self.logger)
+        self._telemetry_prev: tuple = (None, 0.0)
+        self._last_hit_rate = 1.0  # carried through zero-lookup windows
+        self.api.health_fn = self.node_health
+        self.api.node_stats_fn = self.node_stats
+        self.api.cluster_stats_fn = self.cluster_stats
         self.handler = Handler(self.api, cluster_message_fn=self.receive_message,
-                               stats=self.stats, query_timeout=query_timeout)
+                               stats=self.stats, query_timeout=query_timeout,
+                               telemetry=self.telemetry)
         self.http = HTTPServer(self.handler, host=host, port=port,
                                tls_certificate=tls_certificate, tls_key=tls_key)
         self._bind_host = host
@@ -328,6 +352,13 @@ class Server:
         self._bcast_thread.start()
         self.runtime_monitor.start()
         self.diagnostics.start()
+        # route recompile-storm warnings into the server log (process-
+        # global counters: the first server's logger wins, later in-process
+        # servers — a test pattern — keep it)
+        from pilosa_tpu.utils import telemetry as _telemetry
+        if _telemetry.xla.log_fn is None:
+            _telemetry.xla.log_fn = self.logger.printf
+        self.telemetry.start()
         return self
 
     def _schedule_membership_refresh(self) -> None:
@@ -727,6 +758,7 @@ class Server:
             self._member_timer.cancel()
         if self._resize_watchdog is not None:
             self._resize_watchdog.cancel()
+        self.telemetry.close()
         self.executor.shutdown()  # persistent fan-out / batch-exec pools
         self.runtime_monitor.close()
         self.diagnostics.close()
@@ -1316,6 +1348,275 @@ class Server:
                             view.delete_fragment(shard)
                             dropped += 1
         return dropped
+
+    # -- fleet telemetry (utils/telemetry.py; docs/operations.md) -----------
+
+    # time-series tail shipped inside the node stats document — enough for
+    # the dashboard's fleet sparklines without re-fetching every ring
+    STATS_TAIL_SAMPLES = 60
+
+    def sample_gauges(self) -> dict:
+        """One telemetry tick (the sampler's source): instantaneous gauges
+        plus window rates derived from cumulative counters since the
+        previous tick. Keys are dotted series names; the ring stores the
+        returned dict verbatim."""
+        from pilosa_tpu.utils import telemetry as _telemetry
+        from pilosa_tpu.utils.diagnostics import process_rss
+
+        now = time.monotonic()
+        g: dict = {}
+        raw: dict = {}
+        ex = self.executor
+        res = getattr(ex, "residency", None)
+        if res is not None:
+            snap = res.snapshot()
+            g["residency.bytes"] = float(snap["bytes"])
+            g["residency.budget"] = float(res.budget)
+            g["residency.entries"] = float(snap["entries"])
+            raw["residency.hits"] = snap["hits"]
+            raw["residency.lookups"] = snap["hits"] + snap["misses"]
+            raw["residency.evictions"] = snap["evictions"]
+        depth = 0
+        for attr in ("batcher", "sum_batcher", "minmax_batcher"):
+            b = getattr(ex, attr, None)
+            if b is None:
+                continue
+            bs = b.snapshot()
+            depth += bs["queue_depth"]
+            raw["batcher.wait_ms_total"] = raw.get(
+                "batcher.wait_ms_total", 0.0) + bs["wait_ms_total"]
+            raw["batcher.waited"] = raw.get(
+                "batcher.waited", 0) + bs["waited"]
+            raw["batcher.batches"] = raw.get(
+                "batcher.batches", 0) + bs["batches"]
+        g["batcher.queue_depth"] = float(depth)
+        ps = ex.fanout_pool_stats()
+        g["fanout.pool_size"] = float(ps["size"])
+        g["fanout.threads"] = float(ps["threads"])
+        g["fanout.queued"] = float(ps["queued"])
+        # occupancy approximation: threads are created on demand and
+        # queued work means every thread is busy
+        g["fanout.utilization"] = min(
+            1.0, ps["threads"] / max(1, ps["size"])) if not ps["queued"] \
+            else 1.0
+        raw["hedges.fired"] = getattr(ex, "hedges_fired", 0)
+        raw["hedges.won"] = getattr(ex, "hedges_won", 0)
+        wal_bytes = 0
+        wal_ops = 0
+        poisoned = 0
+        for _i, _f, _v, _s, frag in self.holder.walk_fragments():
+            try:
+                wal_bytes += os.path.getsize(frag.path)
+            except (OSError, TypeError):
+                pass
+            wal_ops += int(getattr(frag.storage, "op_n", 0) or 0)
+            if getattr(frag.storage, "wal_poisoned", False):
+                poisoned += 1
+        damaged = self.holder.damaged_fragments()
+        g["wal.bytes"] = float(wal_bytes)
+        g["wal.ops"] = float(wal_ops)
+        g["wal.poisoned_fragments"] = float(poisoned)
+        g["wal.damaged_fragments"] = float(len(damaged))
+        g["wal.needs_rebuild"] = float(
+            sum(1 for d in damaged if d["needsRebuild"]))
+        g["process.rss_bytes"] = float(process_rss())
+        g["process.threads"] = float(threading.active_count())
+        raw["http.errors"] = float(self.handler.errors_5xx)
+        xs = _telemetry.xla.snapshot()
+        g["xla.compiles"] = float(xs["compiles"])
+        g["xla.cached_dispatches"] = float(xs["cachedDispatches"])
+        g["xla.storms"] = float(xs["storms"])
+        raw["xla.compiles"] = xs["compiles"]
+        for dev in _telemetry.device_memory_stats():
+            ms = dev["memoryStats"]
+            if ms and "bytes_in_use" in ms:
+                # first device with a reporting backend (TPU HBM);
+                # CPU backends return null stats and are skipped
+                g["device.bytes_in_use"] = float(ms["bytes_in_use"])
+                break
+
+        prev, prev_t = self._telemetry_prev
+        dt = max(1e-9, now - prev_t)
+
+        def rate(name: str) -> float:
+            if prev is None or name not in prev or name not in raw:
+                return 0.0
+            return max(0.0, (raw[name] - prev[name]) / dt)
+
+        if res is not None:
+            if prev is not None:
+                dlook = raw["residency.lookups"] - prev.get(
+                    "residency.lookups", 0)
+                dhits = raw["residency.hits"] - prev.get("residency.hits", 0)
+                if dlook > 0:
+                    self._last_hit_rate = max(0.0, dhits) / dlook
+            g["residency.hit_rate"] = self._last_hit_rate
+            g["residency.evictions_per_s"] = rate("residency.evictions")
+        if prev is not None:
+            dwaited = raw.get("batcher.waited", 0) - prev.get(
+                "batcher.waited", 0)
+            dwait = raw.get("batcher.wait_ms_total", 0.0) - prev.get(
+                "batcher.wait_ms_total", 0.0)
+            g["batcher.avg_wait_ms"] = (max(0.0, dwait) / dwaited
+                                        if dwaited > 0 else 0.0)
+        g["batcher.batches_per_s"] = rate("batcher.batches")
+        g["hedges.fired_per_s"] = rate("hedges.fired")
+        g["http.errors_per_s"] = rate("http.errors")
+        g["xla.compiles_per_s"] = rate("xla.compiles")
+        self._telemetry_prev = (raw, now)
+        return g
+
+    def _health_inputs(self) -> dict:
+        """Cheap live reads feeding telemetry.health_score — shared by
+        /status (via api.health_fn) and the node stats document. /status
+        is the load-balancer AND peer-probe hot path, so the O(fragments)
+        storage walk is read from the sampler's last tick when one exists
+        (staleness <= telemetry-interval); the direct walk is only the
+        sampler-disabled fallback."""
+        from pilosa_tpu.utils import telemetry as _telemetry
+
+        latest = self.telemetry.ring.latest()
+        if latest:
+            poisoned = latest.get("wal.poisoned_fragments", 0.0) > 0
+            needs_rebuild = int(latest.get("wal.needs_rebuild", 0.0))
+            n_damaged = int(latest.get("wal.damaged_fragments", 0.0))
+        else:
+            damaged = self.holder.damaged_fragments()
+            poisoned = any(
+                getattr(frag.storage, "wal_poisoned", False)
+                for _i, _f, _v, _s, frag in self.holder.walk_fragments())
+            needs_rebuild = sum(1 for d in damaged if d["needsRebuild"])
+            n_damaged = len(damaged)
+        ps = self.executor.fanout_pool_stats()
+        return {
+            "walPoisoned": poisoned,
+            "needsRebuild": needs_rebuild,
+            "damagedFragments": n_damaged,
+            "errorRate": latest.get("http.errors_per_s", 0.0),
+            "queueSaturation": ps["queued"] / max(1, ps["size"]),
+            "recompileStormActive": _telemetry.xla.storm_active(),
+        }
+
+    def node_health(self) -> dict:
+        from pilosa_tpu.utils.telemetry import health_score
+        return health_score(self._health_inputs())
+
+    def node_stats(self) -> dict:
+        """This node's fleet-telemetry document (GET /internal/stats):
+        identity, health + its inputs, the latest sampled gauges, XLA
+        counters, device memory, and a bounded time-series tail for the
+        fleet dashboard's sparklines."""
+        from pilosa_tpu import __version__
+        from pilosa_tpu.utils import telemetry as _telemetry
+
+        inputs = self._health_inputs()
+        ring = self.telemetry.ring
+        tail = ring.since(0, limit=self.STATS_TAIL_SAMPLES)
+        return {
+            "id": self.node_id,
+            "uri": self.http.uri,
+            "state": self.cluster.state,
+            "version": __version__,
+            "uptimeSeconds": int(time.time() - self.api.start_time),
+            "health": _telemetry.health_score(inputs),
+            "healthInputs": inputs,
+            "damagedFragments": inputs["damagedFragments"],
+            "gauges": ring.latest(),
+            "counters": {
+                "http5xx": self.handler.errors_5xx,
+                "hedgesFired": getattr(self.executor, "hedges_fired", 0),
+                "sampleErrors": self.telemetry.sample_errors,
+            },
+            "xla": _telemetry.xla.snapshot(),
+            "deviceMemory": _telemetry.device_memory_stats(),
+            "timeseries": tail,
+        }
+
+    def cluster_stats(self) -> dict:
+        """The merged fleet document (GET /cluster/stats): every live
+        peer's node stats collected CONCURRENTLY over the persistent
+        fan-out pool, scored per node. Peers that 404 the route degrade
+        to "legacy" (mixed-version clusters stay green); down peers are
+        red without burning an RPC on them; a transient fetch failure of
+        a live peer is yellow, never an error."""
+        local = self.node_stats()
+        entries: dict[str, dict] = {self.node_id: local}
+        order: list[str] = []
+        timeout = max(2.0, self.probe_timeout)
+        # dedicated short-lived threads, NOT the query fan-out pool: under
+        # heavy query load that pool's queue is deep (the very condition
+        # queueSaturation flags), and stats fetches queued behind query
+        # RPCs would time out and paint healthy peers yellow exactly when
+        # the operator looks at the fleet (same pattern as _probe_peers)
+        fetchers: list[tuple] = []
+        for n in list(self.cluster.nodes):
+            order.append(n.id)
+            if n.id == self.node_id:
+                continue
+            if self.cluster.is_down(n.id):
+                entries[n.id] = {
+                    "id": n.id, "uri": n.uri, "state": "down",
+                    "health": {"score": "red", "reasons": [
+                        "node marked down (liveness)"]}}
+                continue
+            if not n.uri:
+                entries[n.id] = {
+                    "id": n.id, "uri": "", "state": "unknown",
+                    "health": {"score": "yellow",
+                               "reasons": ["no known URI"]}}
+                continue
+
+            def fetch(node=n):
+                try:
+                    doc = self.client.node_stats(node.uri, timeout)
+                    doc.setdefault("id", node.id)
+                    doc.setdefault("uri", node.uri)
+                    entries[node.id] = doc
+                except ClientError as e:
+                    if e.status == 404:
+                        entries[node.id] = {
+                            "id": node.id, "uri": node.uri, "state": "up",
+                            "health": {"score": "legacy", "reasons": [
+                                "peer predates /internal/stats "
+                                "(legacy protocol)"]}}
+                    else:
+                        entries[node.id] = {
+                            "id": node.id, "uri": node.uri, "state": "up",
+                            "health": {"score": "yellow", "reasons": [
+                                f"stats fetch failed: {e}"]}}
+                except Exception as e:  # noqa: BLE001 — never fail whole
+                    entries[node.id] = {
+                        "id": node.id, "uri": node.uri, "state": "up",
+                        "health": {"score": "yellow", "reasons": [
+                            f"stats fetch failed: "
+                            f"{type(e).__name__}: {e}"]}}
+
+            t = threading.Thread(target=fetch, daemon=True)
+            t.start()
+            fetchers.append((n, t))
+        for n, t in fetchers:
+            t.join(timeout + 1.0)
+            if n.id not in entries:
+                entries[n.id] = {
+                    "id": n.id, "uri": n.uri, "state": "up",
+                    "health": {"score": "yellow", "reasons": [
+                        f"stats fetch timed out after {timeout:.1f}s"]}}
+        nodes = [entries[i] for i in order]
+        counts: dict[str, int] = {}
+        worst = "green"
+        sev = {"green": 0, "yellow": 1, "red": 2}
+        for nd in nodes:
+            score = (nd.get("health") or {}).get("score", "unknown")
+            counts[score] = counts.get(score, 0) + 1
+            # legacy/unknown never degrade the fleet: a peer speaking the
+            # old protocol is healthy by every signal it CAN emit
+            if score in sev and sev[score] > sev[worst]:
+                worst = score
+        return {
+            "fleet": {"health": worst, "counts": counts, "nodes": nodes},
+            "generatedBy": self.node_id,
+            "asOf": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        }
 
     # -- anti-entropy scrubber (server.go:430-483; fragment.go:2170) --------
 

@@ -1,21 +1,52 @@
-"""Logger: standard / verbose / nop (reference: logger/logger.go)."""
+"""Logger: standard / verbose / json / nop (reference: logger/logger.go).
+
+`fmt="json"` (--log-format=json) emits one JSON object per line with the
+active trace id as a proper `trace` field — so log lines join the
+query-history / profile surfaces mechanically instead of via the
+`trace=<id>` suffix convention grep'd out of plain lines.
+"""
 
 from __future__ import annotations
 
+import json
 import sys
 import time
 from typing import Optional, TextIO
 
+FORMATS = ("plain", "json")
+
 
 class Logger:
-    def __init__(self, verbose: bool = False, out: Optional[TextIO] = None):
+    def __init__(self, verbose: bool = False, out: Optional[TextIO] = None,
+                 fmt: str = "plain"):
+        if fmt not in FORMATS:
+            raise ValueError(f"invalid log format {fmt!r} "
+                             f"(expected {' | '.join(FORMATS)})")
         self.verbose = verbose
+        self.fmt = fmt
         self.out = out or sys.stderr
+
+    def _trace_id(self) -> Optional[str]:
+        # imported lazily: the logger must stay importable from anything
+        # (tracing itself logs through it)
+        try:
+            from pilosa_tpu.utils import tracing
+            return tracing.current_trace_id.get()
+        except Exception:  # noqa: BLE001 — logging must never raise
+            return None
 
     def _emit(self, level: str, fmt: str, *args) -> None:
         ts = time.strftime("%Y-%m-%dT%H:%M:%S")
         msg = fmt % args if args else fmt
-        self.out.write(f"{ts} {level} {msg}\n")
+        if self.fmt == "json":
+            rec = {"ts": ts, "level": level, "msg": msg}
+            trace = self._trace_id()
+            if trace:
+                rec["trace"] = trace
+            line = json.dumps(rec, ensure_ascii=False)
+        else:
+            line = f"{ts} {level} {msg}"
+        self.out.write(line + "\n")
         self.out.flush()
 
     def printf(self, fmt: str, *args) -> None:
@@ -31,6 +62,7 @@ class NopLogger:
     def debugf(self, fmt, *args): pass
 
 
-def file_logger(path: str, verbose: bool = False) -> Logger:
+def file_logger(path: str, verbose: bool = False,
+                fmt: str = "plain") -> Logger:
     """log-path config (server/config.go:49-52)."""
-    return Logger(verbose=verbose, out=open(path, "a"))
+    return Logger(verbose=verbose, out=open(path, "a"), fmt=fmt)

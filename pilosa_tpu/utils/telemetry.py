@@ -1,0 +1,427 @@
+"""Fleet telemetry: time-series rings, device/XLA counters, health scores.
+
+The reference ships statsd/expvar plumbing (stats/stats.go) because a
+distributed bitmap index lives or dies on aggregate cluster behavior; the
+TPU re-host adds device-side failure modes with no reference analog —
+silent XLA recompiles and HBM eviction churn. Three pieces live here:
+
+* `Ring` + `TelemetrySampler`: a background sampler that snapshots key
+  gauges (HBM residency, batcher queues, fan-out pool, WAL, RSS) into a
+  bounded in-memory ring, served incrementally at `GET /debug/timeseries`
+  with a `since` cursor. `PILOSA_TPU_TELEMETRY=0` is the kill switch.
+* `XLACounters` + `counted_jit`: compiles vs cached dispatches per kernel
+  family, tracked host-side by dispatch signature (shape/dtype/static-arg
+  key — the same key jax.jit caches on), with a recompile-storm warning.
+* `health_score`: ONE green/yellow/red definition shared by `GET /status`
+  and the `/cluster/stats` federation, so load balancers and the fleet
+  view can never disagree about what "unhealthy" means.
+"""
+
+from __future__ import annotations
+
+import collections
+import os
+import threading
+import time
+from typing import Callable, Optional
+
+
+def enabled() -> bool:
+    """PILOSA_TPU_TELEMETRY=0 kills sampling AND dispatch counting (read
+    per call: tests and operators flip it at runtime)."""
+    return os.environ.get("PILOSA_TPU_TELEMETRY", "1") != "0"
+
+
+# ---------------------------------------------------------------------------
+# Time-series ring
+# ---------------------------------------------------------------------------
+
+
+class Ring:
+    """Bounded in-memory time series: (seq, ts, {gauge: value}) samples.
+
+    seq ascends forever; the deque bounds memory. `since(cursor)` returns
+    only samples newer than the cursor, so pollers (the dashboard, the
+    federation) transfer each sample once regardless of poll rate."""
+
+    def __init__(self, size: int = 720):
+        self.size = max(1, int(size))
+        self._buf: collections.deque = collections.deque(maxlen=self.size)
+        self._seq = 0
+        self._lock = threading.Lock()
+
+    def append(self, gauges: dict, ts: Optional[float] = None) -> int:
+        if ts is None:
+            ts = time.time()
+        with self._lock:
+            self._seq += 1
+            self._buf.append((self._seq, ts, dict(gauges)))
+            return self._seq
+
+    def since(self, cursor: int = 0, limit: int = 0) -> dict:
+        """Samples with seq > cursor (oldest first), newest `limit` when
+        set. The returned `seq` is the next poll's cursor even when no
+        samples qualified."""
+        with self._lock:
+            out = [s for s in self._buf if s[0] > cursor]
+            seq = self._seq
+        if limit > 0:
+            out = out[-limit:]
+        return {"seq": seq, "samples": [
+            {"seq": s, "ts": round(ts, 3), "gauges": g}
+            for s, ts, g in out]}
+
+    def latest(self) -> dict:
+        """The newest sample's gauges ({} when never sampled)."""
+        with self._lock:
+            return dict(self._buf[-1][2]) if self._buf else {}
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._buf)
+
+
+class TelemetrySampler:
+    """Background gauge sampler -> Ring (the node's local TSDB-of-last-
+    resort). `source()` returns one flat {gauge: float} dict per tick;
+    rate/ratio derivation from cumulative counters is the source's job
+    (it owns the previous-tick state). Interval <= 0 or the env kill
+    switch disables the thread; sample_once() still works for tests."""
+
+    def __init__(self, interval: float = 5.0, ring_size: int = 720,
+                 source: Optional[Callable[[], dict]] = None,
+                 logger=None):
+        self.interval = interval
+        self.ring = Ring(ring_size)
+        self.source = source
+        self.logger = logger
+        self._timer: Optional[threading.Timer] = None
+        self._lock = threading.Lock()
+        # generation token: stop()/start() bump it, and a timer chain
+        # only survives while its generation is current — otherwise a
+        # stop()+start() racing an in-flight tick would leave the old
+        # tick's finally-reschedule running as a SECOND chain forever
+        # (sampling at 2x and burning ring history)
+        self._gen = 0
+        self.closed = False
+        self.running = False
+        self.sample_errors = 0
+
+    def sample_once(self) -> Optional[int]:
+        if self.source is None:
+            return None
+        try:
+            gauges = self.source()
+        except Exception as e:  # noqa: BLE001 — a failing gauge must
+            # never kill the sampler loop (it outlives schema churn,
+            # closing executors, chaos tests)
+            self.sample_errors += 1
+            if self.logger is not None:
+                self.logger.printf("telemetry: sample failed: %s", e)
+            return None
+        return self.ring.append(gauges)
+
+    def start(self) -> None:
+        if self.interval <= 0 or not enabled() or self.source is None:
+            return
+        with self._lock:
+            if self.running or self.closed:
+                return
+            self.running = True
+            self._gen += 1
+            gen = self._gen
+        self._schedule(gen)
+
+    def stop(self) -> None:
+        """Pause sampling (restartable — the bench A/B toggles this)."""
+        with self._lock:
+            self.running = False
+            self._gen += 1  # orphan any tick already in flight
+            if self._timer is not None:
+                self._timer.cancel()
+                self._timer = None
+
+    def close(self) -> None:
+        self.closed = True
+        self.stop()
+
+    def _schedule(self, gen: int) -> None:
+        with self._lock:
+            if not self.running or self.closed or gen != self._gen:
+                return
+            self._timer = threading.Timer(self.interval, self._tick,
+                                          args=(gen,))
+            self._timer.daemon = True
+            self._timer.start()
+
+    def _tick(self, gen: int) -> None:
+        with self._lock:
+            if not self.running or self.closed or gen != self._gen:
+                return  # stale chain: die without sampling or rescheduling
+        try:
+            self.sample_once()
+        finally:
+            self._schedule(gen)
+
+
+# ---------------------------------------------------------------------------
+# Device / XLA dispatch counters
+# ---------------------------------------------------------------------------
+
+# a "storm" = this many NEW compilations of one kernel family inside the
+# window — the signature of a shape-churning workload silently recompiling
+# per query instead of hitting the jit cache (the roaring cost model only
+# holds when dispatches hit compiled kernels)
+STORM_N = int(os.environ.get("PILOSA_TPU_RECOMPILE_STORM_N", "8"))
+STORM_WINDOW_S = float(os.environ.get(
+    "PILOSA_TPU_RECOMPILE_STORM_WINDOW_S", "60"))
+
+
+class XLACounters:
+    """Compiles vs cached dispatches per kernel family.
+
+    A dispatch whose (treedef, shapes/dtypes, static args) signature was
+    never seen is a compile — the same key jax.jit caches on, tracked
+    host-side so it works on every backend and costs no device round
+    trip. Storm detection warns when one family compiles STORM_N new
+    signatures inside STORM_WINDOW_S."""
+
+    def __init__(self, storm_n: int = STORM_N,
+                 storm_window_s: float = STORM_WINDOW_S):
+        self.storm_n = storm_n
+        self.storm_window_s = storm_window_s
+        self.log_fn = None  # printf-style sink; warnings.warn fallback
+        self._lock = threading.Lock()
+        self._families: dict[str, dict] = {}
+        self.storms = 0
+
+    def _family(self, family: str) -> dict:
+        f = self._families.get(family)
+        if f is None:
+            f = self._families[family] = {
+                "compiles": 0, "cached": 0, "storms": 0,
+                "keys": set(), "recent": collections.deque(),
+                "last_storm": 0.0}
+        return f
+
+    def record(self, family: str, key) -> bool:
+        """Count one dispatch; returns True when it was a (re)compile."""
+        now = time.monotonic()
+        storm_msg = None
+        with self._lock:
+            f = self._family(family)
+            if key in f["keys"]:
+                f["cached"] += 1
+                return False
+            f["keys"].add(key)
+            f["compiles"] += 1
+            rec = f["recent"]
+            rec.append(now)
+            while rec and now - rec[0] > self.storm_window_s:
+                rec.popleft()
+            if (len(rec) >= self.storm_n
+                    and now - f["last_storm"] > self.storm_window_s):
+                f["last_storm"] = now
+                f["storms"] += 1
+                self.storms += 1
+                storm_msg = (
+                    f"telemetry: XLA recompile storm: kernel family "
+                    f"{family!r} compiled {len(rec)} new program shapes in "
+                    f"{self.storm_window_s:.0f}s ({f['compiles']} total) — "
+                    f"shape churn is defeating the jit cache; expect "
+                    f"latency cliffs until shapes stabilize")
+        if storm_msg is not None:
+            self._warn(storm_msg)
+        return True
+
+    def _warn(self, msg: str) -> None:
+        if self.log_fn is not None:
+            try:
+                self.log_fn("%s", msg)
+                return
+            except Exception:  # noqa: BLE001 — fall through to warnings
+                pass
+        import warnings
+
+        warnings.warn(msg, RuntimeWarning, stacklevel=3)
+
+    def storm_active(self, now: Optional[float] = None) -> bool:
+        """True when any family stormed within the current window (a
+        health-score input: the node is up but recompiling itself sick)."""
+        if now is None:
+            now = time.monotonic()
+        with self._lock:
+            return any(f["storms"] and now - f["last_storm"]
+                       <= self.storm_window_s
+                       for f in self._families.values())
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            fams = {name: {"compiles": f["compiles"], "cached": f["cached"],
+                           "storms": f["storms"]}
+                    for name, f in sorted(self._families.items())}
+        return {
+            "families": fams,
+            "compiles": sum(f["compiles"] for f in fams.values()),
+            "cachedDispatches": sum(f["cached"] for f in fams.values()),
+            "storms": self.storms,
+        }
+
+    def reset(self) -> None:
+        with self._lock:
+            self._families.clear()
+            self.storms = 0
+
+
+# process-global: kernel modules register their dispatch sites against this
+xla = XLACounters()
+
+
+def _sig_of(leaf):
+    """Hashable signature of one pytree leaf: arrays by (shape, dtype) —
+    the part of the jit cache key that changes under shape churn — other
+    leaves by value when hashable (static args), else by type."""
+    shape = getattr(leaf, "shape", None)
+    if shape is not None:
+        return ("arr", tuple(shape), str(getattr(leaf, "dtype", "?")))
+    try:
+        hash(leaf)
+    except TypeError:
+        return ("type", type(leaf).__name__)
+    return leaf
+
+
+def dispatch_key(args: tuple, kwargs: Optional[dict] = None):
+    """(treedef, per-leaf signatures) for a call — tracks jax.jit's own
+    cache key closely enough that a new key here is a new compilation."""
+    import jax
+
+    leaves, treedef = jax.tree_util.tree_flatten((args, kwargs or {}))
+    return treedef, tuple(_sig_of(l) for l in leaves)
+
+
+def record_dispatch(family: str, *args) -> None:
+    """Manual counting hook for dispatch sites that build their jitted
+    callables dynamically (the mesh shard_map paths)."""
+    if not enabled():
+        return
+    try:
+        xla.record(family, dispatch_key(args))
+    except Exception:  # noqa: BLE001 — counting must never break dispatch
+        pass
+
+
+def counted_jit(family: str, **jit_kwargs):
+    """jax.jit + per-call compile/cached accounting under `family`.
+
+    Drop-in at the decorator site: the wrapper forwards to the jitted
+    callable and skips accounting inside a trace (a wrapped kernel called
+    from another jitted function inlines; counting tracer calls would
+    double-book one outer compile as N inner dispatches) and when the
+    telemetry kill switch is off."""
+    import functools
+
+    import jax
+
+    def wrap(fn):
+        jitted = jax.jit(fn, **jit_kwargs)
+
+        @functools.wraps(fn)
+        def call(*args, **kwargs):
+            if enabled():
+                try:
+                    leaves, treedef = jax.tree_util.tree_flatten(
+                        (args, kwargs))
+                    if not any(isinstance(l, jax.core.Tracer)
+                               for l in leaves):
+                        xla.record(family, (treedef,
+                                            tuple(_sig_of(l)
+                                                  for l in leaves)))
+                except Exception:  # noqa: BLE001 — never break dispatch
+                    pass
+            return jitted(*args, **kwargs)
+
+        # AOT surface passthrough (callers may .lower()/.clear_cache())
+        call._jitted = jitted
+        for attr in ("lower", "clear_cache", "trace", "eval_shape"):
+            if hasattr(jitted, attr):
+                setattr(call, attr, getattr(jitted, attr))
+        return call
+
+    return wrap
+
+
+def device_memory_stats() -> list[dict]:
+    """Per-device memory_stats() where the backend provides it (TPU HBM
+    live bytes etc.); memoryStats is a graceful null on CPU backends."""
+    import jax
+
+    out: list[dict] = []
+    try:
+        devices = jax.devices()
+    except Exception:  # noqa: BLE001 — no backend at all
+        return out
+    for d in devices:
+        stats = None
+        try:
+            fn = getattr(d, "memory_stats", None)
+            stats = fn() if callable(fn) else None
+        except Exception:  # noqa: BLE001 — backend without the API
+            stats = None
+        out.append({"device": str(d),
+                    "platform": getattr(d, "platform", "?"),
+                    "memoryStats": stats})
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Node health score
+# ---------------------------------------------------------------------------
+
+# error-rate thresholds (5xx responses/second over the sampler window)
+ERROR_RATE_YELLOW = 0.1
+ERROR_RATE_RED = 2.0
+# outbound fan-out work queued beyond the pool, as a multiple of pool size
+QUEUE_SATURATION_YELLOW = 2.0
+
+_SEVERITY = {"green": 0, "yellow": 1, "red": 2}
+
+
+def health_score(inputs: dict) -> dict:
+    """{"score": green|yellow|red, "reasons": [...]} from a node's health
+    inputs. The ONE shared definition: `GET /status` reports it for load
+    balancers and the `/cluster/stats` federation reuses it per node, so
+    the two surfaces can never disagree. Inputs (all optional, absent =
+    healthy): walPoisoned, needsRebuild, damagedFragments, errorRate
+    (5xx/s), queueSaturation (queued / pool size), recompileStormActive.
+    Liveness is the federation layer's job (a down node never answers)."""
+    score = "green"
+    reasons: list[str] = []
+
+    def worsen(level: str, why: str) -> None:
+        nonlocal score
+        if _SEVERITY[level] > _SEVERITY[score]:
+            score = level
+        reasons.append(why)
+
+    if inputs.get("walPoisoned"):
+        worsen("red", "WAL poisoned: writes refused until snapshot")
+    n = int(inputs.get("needsRebuild") or 0)
+    if n:
+        worsen("yellow", f"{n} quarantined fragment(s) awaiting replica "
+                         "rebuild")
+    d = int(inputs.get("damagedFragments") or 0)
+    if d and not n:
+        worsen("yellow", f"{d} fragment(s) recovered from damage "
+                         "(quarantine/torn WAL)")
+    err = float(inputs.get("errorRate") or 0.0)
+    if err >= ERROR_RATE_RED:
+        worsen("red", f"HTTP 5xx rate {err:.2f}/s")
+    elif err >= ERROR_RATE_YELLOW:
+        worsen("yellow", f"HTTP 5xx rate {err:.2f}/s")
+    sat = float(inputs.get("queueSaturation") or 0.0)
+    if sat >= QUEUE_SATURATION_YELLOW:
+        worsen("yellow", f"fan-out queue saturated ({sat:.1f}x pool size)")
+    if inputs.get("recompileStormActive"):
+        worsen("yellow", "XLA recompile storm in progress")
+    return {"score": score, "reasons": reasons}

@@ -217,6 +217,42 @@ def test_live_metrics_scrape_conforms(pair):
         assert count >= 1
 
 
+def test_live_metrics_fleet_telemetry_series(pair):
+    """PR 5 satellite: series that previously lived only in /debug/vars
+    (HBM residency, damaged fragments, batcher queues, hedges, XLA
+    compile counters, the node health score) are now scrapeable — and
+    conform like everything else."""
+    servers, uris = pair
+    with urllib.request.urlopen(uris[0] + "/metrics", timeout=10) as r:
+        text = r.read().decode()
+    types, samples = check_conformance(text)
+    names = {n for n, _, _ in samples}
+    # residency gauges keyed under one family
+    assert types["pilosa_residency"] == "gauge"
+    keys = {l.get("key") for n, l, _ in samples if n == "pilosa_residency"}
+    assert {"bytes", "budget", "hitRate", "entries"} <= keys
+    # cumulative residency counters (hits/misses/evictions)
+    ckeys = {l.get("key") for n, l, _ in samples
+             if n == "pilosa_residency_total"}
+    assert {"hits", "misses", "evictions"} <= ckeys
+    assert "pilosa_damagedFragments" in names
+    assert "pilosa_walPoisonedFragments" in names
+    assert types["pilosa_hedges_total"] == "counter"
+    assert types["pilosa_batcher_total"] == "counter"
+    assert "pilosa_xlaRecompileStorms_total" in names
+    # the node's health score as a numeric gauge (0 green / 1 yellow /
+    # 2 red) so the PromQL alert in docs/operations.md works. Asserted
+    # against the node's OWN score, not a literal: the XLA counters are
+    # process-global, and an earlier test's shape churn can legitimately
+    # leave a recompile-storm window active (score yellow) here.
+    health = next(v for n, _, v in samples if n == "pilosa_nodeHealth")
+    expected = {"green": 0.0, "yellow": 1.0,
+                "red": 2.0}[servers[0].node_health()["score"]]
+    assert health == expected
+    # traffic ran through the count batcher: XLA families show up
+    assert any(n == "pilosa_xlaCompiles_total" for n, _, _ in samples)
+
+
 def test_metrics_endpoint_without_stats_client(pair):
     """A handler with no stats wired still answers 200 with an empty
     (legal) exposition."""

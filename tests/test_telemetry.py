@@ -1,0 +1,354 @@
+"""Fleet telemetry (utils/telemetry.py + the HTTP surfaces it feeds):
+ring-buffer bounds and `since` cursors, the sampler kill switch, XLA
+compile-vs-cached counters with induced-recompile storm warnings, the
+shared health-score definition, structured JSON logging, mixed-version
+`/cluster/stats` federation (legacy 404 peers degrade, never error), and
+the air-gap guarantee of the self-contained dashboard."""
+
+import json
+import re
+import time
+import urllib.request
+
+import jax.numpy as jnp
+import pytest
+
+from pilosa_tpu.utils import telemetry as T
+
+
+# --------------------------------------------------------------------- ring
+
+
+def test_ring_bounded_and_since_cursor():
+    r = T.Ring(4)
+    for i in range(10):
+        r.append({"v": float(i)})
+    assert len(r) == 4  # bounded memory regardless of appends
+    out = r.since(0)
+    assert out["seq"] == 10
+    assert [s["gauges"]["v"] for s in out["samples"]] == [6.0, 7.0, 8.0, 9.0]
+    # cursor: nothing new -> empty, but seq still advances the poller
+    again = r.since(out["seq"])
+    assert again["samples"] == [] and again["seq"] == 10
+    r.append({"v": 10.0})
+    assert [s["gauges"]["v"]
+            for s in r.since(out["seq"])["samples"]] == [10.0]
+    assert len(r.since(0, limit=2)["samples"]) == 2
+
+
+def test_sampler_lifecycle_and_kill_switch(monkeypatch):
+    s = T.TelemetrySampler(interval=0.01, ring_size=8,
+                           source=lambda: {"x": 1.0})
+    monkeypatch.setenv("PILOSA_TPU_TELEMETRY", "0")
+    s.start()
+    assert not s.running  # kill switch wins over start()
+    monkeypatch.delenv("PILOSA_TPU_TELEMETRY")
+    s.start()
+    assert s.running
+    deadline = time.monotonic() + 2.0
+    while len(s.ring) == 0 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    s.stop()  # restartable pause (the bench A/B uses this)
+    assert len(s.ring) >= 1
+    s.close()
+    assert not s.running
+
+
+def test_sampler_survives_source_errors():
+    def bad():
+        raise RuntimeError("boom")
+
+    s = T.TelemetrySampler(interval=0, source=bad)
+    assert s.sample_once() is None
+    assert s.sample_errors == 1
+
+
+# ------------------------------------------------------------- XLA counters
+
+
+def test_xla_counters_compile_cached_and_storm():
+    c = T.XLACounters(storm_n=3, storm_window_s=60)
+    msgs = []
+    c.log_fn = lambda fmt, *a: msgs.append(fmt % a)
+    assert c.record("fam", ("k1",)) is True  # new signature = compile
+    assert c.record("fam", ("k1",)) is False  # repeat = cached dispatch
+    c.record("fam", ("k2",))
+    assert not msgs
+    c.record("fam", ("k3",))  # 3rd new key in window -> storm
+    snap = c.snapshot()
+    assert snap["families"]["fam"] == {"compiles": 3, "cached": 1,
+                                       "storms": 1}
+    assert c.storms == 1 and c.storm_active()
+    assert len(msgs) == 1 and "recompile storm" in msgs[0]
+    # a second storm inside the same window does not re-warn (rate limit)
+    c.record("fam", ("k4",))
+    assert len(msgs) == 1
+
+
+def test_induced_recompile_trips_counter_and_storm(monkeypatch):
+    """A real jit dispatch-site test: shape churn on a wrapped kernel
+    bumps the compile counter and fires the storm warning."""
+    fresh = T.XLACounters(storm_n=3, storm_window_s=60)
+    msgs = []
+    fresh.log_fn = lambda fmt, *a: msgs.append(fmt % a)
+    monkeypatch.setattr(T, "xla", fresh)
+    from pilosa_tpu.ops import bitvector as bv
+
+    for n in (33, 65, 129):  # three distinct shapes = three compiles
+        bv.popcount(jnp.zeros((n,), jnp.uint32))
+    snap = fresh.snapshot()
+    assert snap["families"]["count"]["compiles"] == 3
+    assert fresh.storms == 1
+    assert any("recompile storm" in m for m in msgs)
+    bv.popcount(jnp.zeros((33,), jnp.uint32))  # repeat shape: cached
+    assert fresh.snapshot()["families"]["count"]["cached"] == 1
+
+
+def test_kill_switch_disables_dispatch_counting(monkeypatch):
+    fresh = T.XLACounters()
+    monkeypatch.setattr(T, "xla", fresh)
+    monkeypatch.setenv("PILOSA_TPU_TELEMETRY", "0")
+    from pilosa_tpu.ops import bitvector as bv
+
+    bv.popcount(jnp.zeros((47,), jnp.uint32))
+    assert fresh.snapshot()["compiles"] == 0
+
+
+def test_device_memory_stats_graceful_on_cpu():
+    out = T.device_memory_stats()
+    assert out, "device list should not be empty"
+    for d in out:
+        assert "memoryStats" in d  # None on CPU is the graceful null
+        assert d["platform"] == "cpu"
+
+
+# -------------------------------------------------------------- health score
+
+
+def test_health_score_levels():
+    assert T.health_score({}) == {"score": "green", "reasons": []}
+    assert T.health_score({"walPoisoned": True})["score"] == "red"
+    assert T.health_score({"needsRebuild": 2})["score"] == "yellow"
+    assert T.health_score({"damagedFragments": 1})["score"] == "yellow"
+    assert T.health_score({"errorRate": 0.5})["score"] == "yellow"
+    assert T.health_score({"errorRate": 5.0})["score"] == "red"
+    assert T.health_score({"queueSaturation": 3.0})["score"] == "yellow"
+    assert T.health_score({"recompileStormActive": True})["score"] == "yellow"
+    # worst input wins; every reason is reported
+    both = T.health_score({"walPoisoned": True, "needsRebuild": 1})
+    assert both["score"] == "red" and len(both["reasons"]) == 2
+
+
+# ---------------------------------------------------------------- json logs
+
+
+def test_json_log_format_carries_trace_field():
+    import io
+
+    from pilosa_tpu.utils import tracing
+    from pilosa_tpu.utils.logger import Logger
+
+    buf = io.StringIO()
+    log = Logger(out=buf, fmt="json")
+    tok = tracing.current_trace_id.set("abc123")
+    try:
+        log.printf("%.3fs SLOW QUERY %s", 1.5, "Count(Row(f=0))")
+    finally:
+        tracing.current_trace_id.reset(tok)
+    log.printf("plain message")
+    lines = [json.loads(l) for l in buf.getvalue().splitlines()]
+    assert lines[0]["level"] == "INFO"
+    assert lines[0]["msg"].endswith("Count(Row(f=0))")
+    assert lines[0]["trace"] == "abc123"  # a FIELD, not a suffix
+    assert "trace" not in lines[1]
+    with pytest.raises(ValueError):
+        Logger(fmt="xml")
+
+
+# ------------------------------------------------------------- live cluster
+
+
+def _get(uri, path, timeout=15):
+    with urllib.request.urlopen(uri + path, timeout=timeout) as r:
+        return r.status, r.headers.get("Content-Type", ""), r.read()
+
+
+def _post(uri, path, payload=None, raw=None):
+    body = raw if raw is not None else json.dumps(payload or {}).encode()
+    req = urllib.request.Request(uri + path, data=body, method="POST")
+    with urllib.request.urlopen(req, timeout=30) as r:
+        return json.loads(r.read())
+
+
+@pytest.fixture(scope="module")
+def trio(tmp_path_factory):
+    """3-node cluster, one node speaking the legacy protocol (its
+    /internal/stats route 404s like a build that predates it)."""
+    from pilosa_tpu.server import Server
+
+    tmp = tmp_path_factory.mktemp("telemetry")
+    servers = [Server(str(tmp / f"n{i}"), port=0,
+                      node_id=chr(ord("a") + i),
+                      telemetry_interval=0.05).open() for i in range(3)]
+    uris = [s.uri for s in servers]
+    for s in servers:
+        s.cluster_hosts = uris
+        s.refresh_membership()
+
+    def _legacy_404(params, query, body):
+        return 404, "application/json", b'{"error": "not found"}'
+
+    servers[2].handler.get_internal_stats = _legacy_404
+
+    _post(uris[0], "/index/t", {})
+    _post(uris[0], "/index/t/field/f", {})
+    cols = list(range(0, 3 * 2 ** 20, 4099))
+    _post(uris[0], "/index/t/field/f/import",
+          {"rowIDs": [0] * len(cols), "columnIDs": cols})
+    for _ in range(2):
+        _post(uris[0], "/index/t/query", raw=b"Count(Row(f=0))")
+    # the XLA counters are process-global and earlier test files churn
+    # shapes by design — drop any active storm window so the "fleet is
+    # green" assertions below are deterministic under full-suite order
+    T.xla.reset()
+    yield servers, uris
+    for s in servers:
+        s.close()
+
+
+def test_status_gains_uptime_version_health(trio):
+    servers, uris = trio
+    _, _, body = _get(uris[0], "/status")
+    st = json.loads(body)
+    assert st["uptimeSeconds"] >= 0
+    from pilosa_tpu import __version__
+    assert st["version"] == __version__
+    assert st["health"]["score"] == "green"
+    # one health definition: /status agrees with the node's own score
+    assert st["health"] == servers[0].node_health()
+
+
+def test_timeseries_incremental_cursor(trio):
+    servers, uris = trio
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline:
+        _, _, body = _get(uris[0], "/debug/timeseries")
+        first = json.loads(body)
+        if len(first["samples"]) >= 2:
+            break
+        time.sleep(0.05)
+    assert len(first["samples"]) >= 2
+    assert first["enabled"] and first["ringSize"] == 720
+    g = first["samples"][-1]["gauges"]
+    for key in ("residency.bytes", "batcher.queue_depth", "fanout.queued",
+                "wal.bytes", "process.rss_bytes", "xla.compiles",
+                "residency.hit_rate"):
+        assert key in g, sorted(g)
+    # incremental: polling with the returned cursor transfers each
+    # sample exactly once
+    cur = first["seq"]
+    _, _, body = _get(uris[0], f"/debug/timeseries?since={cur}")
+    nxt = json.loads(body)
+    assert all(s["seq"] > cur for s in nxt["samples"])
+    _, _, body = _get(uris[0], f"/debug/timeseries?since={10**9}")
+    assert json.loads(body)["samples"] == []
+    # unknown query args still 400 (validation spec)
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _get(uris[0], "/debug/timeseries?cursor=1")
+    assert e.value.code == 400
+
+
+def test_timeseries_ring_stays_bounded(tmp_path):
+    from pilosa_tpu.server import Server
+
+    srv = Server(str(tmp_path / "ringy"), port=0, telemetry_interval=0.01,
+                 telemetry_ring=5).open()
+    try:
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            _, _, body = _get(srv.uri, "/debug/timeseries")
+            out = json.loads(body)
+            if out["seq"] > 8:
+                break
+            time.sleep(0.02)
+        assert out["seq"] > 8  # many samples taken...
+        assert len(out["samples"]) <= 5  # ...bounded by the ring
+    finally:
+        srv.close()
+
+
+def test_cluster_stats_mixed_version_federation(trio):
+    servers, uris = trio
+    _, _, body = _get(uris[0], "/cluster/stats")
+    doc = json.loads(body)
+    fleet = doc["fleet"]
+    assert len(fleet["nodes"]) == 3
+    by_id = {n["id"]: n for n in fleet["nodes"]}
+    assert by_id["a"]["health"]["score"] == "green"
+    assert by_id["b"]["health"]["score"] == "green"
+    # the legacy peer 404s /internal/stats -> marked legacy, NOT an error
+    assert by_id["c"]["health"]["score"] == "legacy"
+    # ...and the fleet stays green
+    assert fleet["health"] == "green"
+    assert fleet["counts"] == {"green": 2, "legacy": 1}
+    assert doc["generatedBy"] == "a"
+    # live peers carry real documents: gauges + a sparkline tail
+    assert "residency.bytes" in by_id["b"]["gauges"]
+    assert by_id["b"]["timeseries"]["samples"]
+
+
+def test_cluster_stats_down_peer_is_red(trio):
+    servers, uris = trio
+    servers[0].cluster.mark_down("b")
+    try:
+        _, _, body = _get(uris[0], "/cluster/stats")
+        fleet = json.loads(body)["fleet"]
+        by_id = {n["id"]: n for n in fleet["nodes"]}
+        assert by_id["b"]["health"]["score"] == "red"
+        assert fleet["health"] == "red"
+    finally:
+        servers[0].cluster.mark_up("b")
+
+
+def test_internal_stats_document(trio):
+    servers, uris = trio
+    _, _, body = _get(uris[1], "/internal/stats")
+    doc = json.loads(body)
+    assert doc["id"] == "b" and doc["uri"] == uris[1]
+    assert doc["health"]["score"] == "green"
+    assert "healthInputs" in doc and "gauges" in doc
+    assert doc["xla"]["compiles"] >= 0
+    for dev in doc["deviceMemory"]:
+        assert "memoryStats" in dev  # null on CPU, stats dict on TPU
+
+
+# tier-1 air-gap guarantee: the dashboard must reference NOTHING external
+_EXTERNAL_REF = re.compile(
+    r"https?://|href\s*=|src\s*=|url\s*\(|@import|<link|<iframe|"
+    r"integrity=|crossorigin", re.IGNORECASE)
+
+
+def test_dashboard_is_self_contained(trio):
+    servers, uris = trio
+    status, ctype, body = _get(uris[0], "/debug/dashboard")
+    html = body.decode()
+    assert status == 200 and ctype.startswith("text/html")
+    assert "<svg" in html or "spark" in html  # inline sparkline machinery
+    hits = _EXTERNAL_REF.findall(html)
+    assert not hits, f"dashboard references external assets: {hits}"
+    # the same guarantee at the source level (catches routes the handler
+    # might add around the template)
+    from pilosa_tpu.net.dashboard import render_dashboard
+    assert not _EXTERNAL_REF.findall(render_dashboard())
+
+
+def test_debug_vars_and_metrics_still_work(trio):
+    """The new series ride the existing surfaces without breaking them."""
+    servers, uris = trio
+    _, _, body = _get(uris[0], "/debug/vars")
+    json.loads(body)
+    _, ctype, body = _get(uris[0], "/metrics")
+    assert ctype.startswith("text/plain")
+    text = body.decode()
+    assert "pilosa_residency" in text
+    assert "pilosa_nodeHealth" in text
