@@ -71,6 +71,20 @@ class ClusterConfig:
 
 
 @dataclass
+class QueryConfig:
+    """[query] — cost-based planner + cross-query plan cache
+    (pilosa_tpu/planner.py; docs/operations.md "Query planning").
+    plan: "on" (default) reorders commutative chains cheapest-first,
+    short-circuits provably-empty branches and marks Count/TopN
+    pushdowns; "off" evaluates written order. plan-cache-bytes bounds the
+    generation-keyed device-resident subexpression cache (0 disables).
+    The PILOSA_TPU_PLANNER=0 / PILOSA_TPU_PLAN_CACHE=0 env kill switches
+    override both to off (emergency toggles needing no config rollout)."""
+    plan: str = "on"
+    plan_cache_bytes: int = 256 * 1024 * 1024
+
+
+@dataclass
 class StorageConfig:
     """[storage] — durability knobs (docs/operations.md "Failure modes and
     recovery"). wal-fsync: "off" (default; matches the reference, which
@@ -170,6 +184,7 @@ class Config:
     log_format: str = "plain"
     verbose: bool = False
     tls: TLSConfig = field(default_factory=TLSConfig)
+    query: QueryConfig = field(default_factory=QueryConfig)
     cluster: ClusterConfig = field(default_factory=ClusterConfig)
     storage: StorageConfig = field(default_factory=StorageConfig)
     anti_entropy: AntiEntropyConfig = field(default_factory=AntiEntropyConfig)
@@ -198,7 +213,7 @@ class Config:
     def _apply_dict(self, data: dict) -> None:
         for key, value in data.items():
             attr = key.replace("-", "_")
-            if attr in ("tls", "cluster", "storage", "anti_entropy", "metric", "diagnostics", "tracing", "mesh", "gossip") and isinstance(value, dict):
+            if attr in ("tls", "query", "cluster", "storage", "anti_entropy", "metric", "diagnostics", "tracing", "mesh", "gossip") and isinstance(value, dict):
                 sub = getattr(self, attr)
                 for k, v in value.items():
                     sk = k.replace("-", "_")
@@ -220,7 +235,7 @@ class Config:
 
     def _set_path(self, parts: list[str], raw: str) -> None:
         # try sub-config first (cluster_replicas -> cluster.replicas)
-        for sub_name in ("tls", "cluster", "storage", "anti_entropy", "metric", "diagnostics", "tracing", "mesh", "gossip"):
+        for sub_name in ("tls", "query", "cluster", "storage", "anti_entropy", "metric", "diagnostics", "tracing", "mesh", "gossip"):
             sub_parts = sub_name.split("_")
             if parts[: len(sub_parts)] == sub_parts and len(parts) > len(sub_parts):
                 sub = getattr(self, sub_name)
@@ -255,6 +270,10 @@ class Config:
             f"hedge-delay = {self.cluster.hedge_delay}",
             f'profile = "{self.cluster.profile}"',
             f"query-history-size = {self.cluster.query_history_size}",
+            "",
+            "[query]",
+            f'plan = "{self.query.plan}"',
+            f"plan-cache-bytes = {self.query.plan_cache_bytes}",
             "",
             "[storage]",
             f'wal-fsync = "{self.storage.wal_fsync}"',

@@ -153,6 +153,8 @@ def cmd_server(args) -> int:
         hedge_delay=cfg.cluster.hedge_delay,
         profile_mode=cfg.cluster.profile,
         query_history_size=cfg.cluster.query_history_size,
+        plan=cfg.query.plan,
+        plan_cache_bytes=cfg.query.plan_cache_bytes,
         max_writes_per_request=cfg.max_writes_per_request,
         metric_service=cfg.metric.service,
         metric_host=cfg.metric.host,

@@ -187,6 +187,23 @@ class Executor:
                 "PILOSA_TPU_NET_COALESCE", "1") != "0":
             from pilosa_tpu.net.coalesce import NodeCoalescer
             self.coalescer = NodeCoalescer(client)
+        # cost-based query planner (pilosa_tpu/planner.py): cardinality
+        # reorders, empty-branch short-circuits, Count/TopN pushdown
+        # marking; PILOSA_TPU_PLANNER=0 / [query] plan=off fall back to
+        # written-order evaluation
+        self.planner = None
+        if os.environ.get("PILOSA_TPU_PLANNER", "1") != "0":
+            from pilosa_tpu.planner import QueryPlanner
+            self.planner = QueryPlanner(self)
+        # generation-keyed cross-query subexpression cache
+        # (parallel/residency.py PlanCache): evaluated bitmap subtrees stay
+        # device-resident keyed by (canonical PQL, shards, row gens) — a
+        # write bumps a generation, changing the key, so invalidation is
+        # free. PILOSA_TPU_PLAN_CACHE=0 / [query] plan-cache-bytes=0 off.
+        self.plan_cache = None
+        if os.environ.get("PILOSA_TPU_PLAN_CACHE", "1") != "0":
+            from pilosa_tpu.parallel.residency import PlanCache
+            self.plan_cache = PlanCache()
 
     # ------------------------------------------------------ fan-out pools
 
@@ -255,6 +272,8 @@ class Executor:
         self._row_cache_epoch += 1
         self._row_cache.clear()
         self.residency.clear()
+        if self.plan_cache is not None:
+            self.plan_cache.clear()
 
     # ------------------------------------------------------------------ API
 
@@ -313,6 +332,26 @@ class Executor:
         # Options() wrapper (executor.go:317)
         if call.name == "Options":
             return self._execute_options(index, call, shards)
+        from pilosa_tpu import planner as _planner
+        plan_tok = None
+        if self.planner is not None and call.name in _planner.PLANNED_CALLS:
+            # the planning pass between parse and execution: reorder /
+            # short-circuit / pushdown-mark, then install the plan node so
+            # plan-cache events recorded during evaluation join it. The
+            # profiler serializes it as the call's `plan` entry.
+            call, plan_info = self.planner.plan_call(
+                index, call, self._query_shards(index, shards))
+            plan_tok = _planner.current_plan.set(plan_info)
+            prof = qprofile.current_profile.get()
+            if prof is not None:
+                prof.record_plan(plan_info)
+        try:
+            return self._dispatch_call(index, call, shards)
+        finally:
+            if plan_tok is not None:
+                _planner.current_plan.reset(plan_tok)
+
+    def _dispatch_call(self, index: Index, call: Call, shards):
         handler = {
             "Count": self._execute_count,
             "TopN": self._execute_topn,
@@ -457,11 +496,13 @@ class Executor:
                 return (op, *[walk(ch) for ch in c.children])
             if c.name == "Intersect":
                 if not c.children:
-                    raise ExecutionError("empty Intersect query is currently not supported")
+                    from pilosa_tpu.planner import empty_operand_error
+                    raise empty_operand_error(c)
                 return ("and", *[walk(ch) for ch in c.children])
             if c.name == "Difference":
                 if not c.children:  # executor.go:835
-                    raise ExecutionError("empty Difference query is currently not supported")
+                    from pilosa_tpu.planner import empty_operand_error
+                    raise empty_operand_error(c)
                 return ("andnot", *[walk(ch) for ch in c.children])
             if c.name == "Not":
                 if len(c.children) != 1:
@@ -478,15 +519,50 @@ class Executor:
                 lambda: np.zeros((len(shards), WORDS), dtype=np.uint32)))
         return program, leaves
 
-    def _execute_bitmap_call(self, index: Index, call: Call, shards) -> Row:
-        shards = self._query_shards(index, shards)
+    def _composed_row_dev(self, index: Index, call: Call, shards):
+        """Device [S', W] result of a bitmap call tree, through the
+        generation-keyed plan cache: overlapping queries (many dashboard
+        users sharing a filter subtree) reuse the HBM-resident evaluated
+        result instead of recomputing it. On a miss the composed result is
+        inserted under the planner's canonical key; a write under the
+        subtree changes the key on the next lookup (free invalidation)."""
+        from pilosa_tpu import planner as _planner
+        key = None
+        pc = self.plan_cache
+        if (pc is not None and pc.enabled
+                and call.name in _planner.BITMAP_CALLS
+                and not _planner.is_empty_call(call)):
+            key = _planner.subtree_cache_key(self, index, call, shards)
+        epoch = 0
+        if key is not None:
+            epoch = pc.epoch
+            hit = pc.get(key)
+            _planner.record_cache_event(call, hit is not None)
+            if hit is not None:
+                return hit
         program, leaves = self._compile(index, call, shards)
-        dense = self.runner.row_leaves(leaves, program, len(shards))
+        dev = self.runner.row_leaves_dev(leaves, program)
+        if key is not None:
+            pc.put(key, dev, dev.nbytes, epoch=epoch)
+        return dev
+
+    def _execute_bitmap_call(self, index: Index, call: Call, shards) -> Row:
+        from pilosa_tpu import planner as _planner
+        shards = self._query_shards(index, shards)
+        if _planner.is_empty_call(call):
+            # planner short-circuit: provably empty — no leaf
+            # materialization, no device dispatch
+            return Row()
+        dense = np.asarray(
+            self._composed_row_dev(index, call, shards))[:len(shards)]
         out = Row()
+        n_cols = 0
         for i, shard in enumerate(shards):
             cols = columns_from_dense(dense[i])
             if cols.size:
+                n_cols += cols.size
                 out.segments[shard] = cols.astype(np.uint64) + np.uint64(shard * SHARD_WIDTH)
+        self._record_actual(n_cols)
         # top-level Row() results carry the row's attrs (executeBitmapCall
         # attaches them from the row attr store, executor.go:1173-1208)
         if call.name == "Row":
@@ -508,8 +584,45 @@ class Executor:
     def _execute_count(self, index: Index, call: Call, shards) -> int:
         if len(call.children) != 1:
             raise ExecutionError("Count() takes exactly one argument")
+        from pilosa_tpu import planner as _planner
+        from pilosa_tpu.parallel.residency import PlanCache
+        child = call.children[0]
+        if _planner.is_empty_call(child):
+            # planner short-circuit: zero leaves uploaded, zero dispatches
+            return 0
         shards = self._query_shards(index, shards)
-        program, leaves = self._compile(index, call.children[0], shards)
+        key = None
+        epoch = 0
+        pc = self.plan_cache
+        if (pc is not None and pc.enabled
+                and child.name in _planner.BITMAP_CALLS):
+            key = _planner.subtree_cache_key(self, index, child, shards)
+            if key is not None:
+                key = ("count",) + key  # scalar value, distinct from the
+                # dense row result of the same subtree
+                epoch = pc.epoch
+                cached = pc.get(key)
+                _planner.record_cache_event(child, cached is not None)
+                if cached is not None:
+                    self._record_actual(cached)
+                    return cached
+        n = self._count_device(index, child, shards)
+        if key is not None:
+            pc.put(key, int(n), PlanCache.SCALAR_COST, epoch=epoch)
+        self._record_actual(n)
+        return n
+
+    @staticmethod
+    def _record_actual(count) -> None:
+        """Actual result cardinality into the executing call's plan node —
+        the profiler's estimated-vs-actual comparison (?profile=true)."""
+        from pilosa_tpu import planner as _planner
+        plan = _planner.current_plan.get()
+        if plan is not None:
+            plan["actualCardinality"] = int(count)
+
+    def _count_device(self, index: Index, child: Call, shards) -> int:
+        program, leaves = self._compile(index, child, shards)
         if self.batcher is not None:
             # concurrent Counts coalesce into one device dispatch
             # (continuous batching — parallel/batcher.py)
@@ -522,6 +635,17 @@ class Executor:
                     and program[2] == ("leaf", 1)
                     and leaves[0].shape == leaves[1].shape):
                 return self.batcher.count(program[0], leaves[0], leaves[1])
+        if (isinstance(program, tuple) and len(program) > 3
+                and program[0] == "and"
+                and all(p == ("leaf", i) for i, p in enumerate(program[1:]))
+                and not self.runner.use_pallas
+                and len({l.shape for l in leaves}) == 1):
+            # the planner's Count(Intersect(...)) pushdown on 3+-way
+            # chains: one fused AND+popcount dispatch keyed on chain
+            # arity, so cardinality-reordered chains of the same width
+            # share a compilation (ops/bitvector.py)
+            from pilosa_tpu.ops.bitvector import intersect_chain_count_total
+            return int(intersect_chain_count_total(tuple(leaves)))
         return self.runner.count_total_leaves(leaves, program)
 
     # ------------------------------------------------- leaf materialization
@@ -678,11 +802,11 @@ class Executor:
 
     def _bsi_filter(self, index: Index, call: Call, shards):
         """Optional filter child for Sum/Min/Max — a device array [S', W]
-        composed in HBM (no host round trip)."""
+        composed in HBM (no host round trip), via the plan cache so
+        dashboards sharing one filter subtree compose it once."""
         if not call.children:
             return None
-        program, leaves = self._compile(index, call.children[0], shards)
-        return self.runner.row_leaves_dev(leaves, program)
+        return self._composed_row_dev(index, call.children[0], shards)
 
     def _execute_sum(self, index: Index, call: Call, shards) -> ValCount:
         import jax.numpy as jnp
@@ -766,8 +890,10 @@ class Executor:
 
         src_dense = None
         if call.children:
-            program, leaves = self._compile(index, call.children[0], shards)
-            src_dense = self.runner.row_leaves_dev(leaves, program)  # [S', W] in HBM
+            # [S', W] in HBM, plan-cached: the ranking phases fetch int32
+            # count vectors only — the src bitmap never lands on host
+            src_dense = self._composed_row_dev(index, call.children[0],
+                                               shards)
 
         ids_arg = call.uint_slice_arg("ids")
         threshold = call.uint_arg("threshold") or 0
@@ -1164,8 +1290,8 @@ class Executor:
             raise ExecutionError("GroupBy supports at most one filter call")
         filter_dev = None
         if filt_calls:
-            program, leaves = self._compile(index, filt_calls[0], shards)
-            filter_dev = self.runner.row_leaves_dev(leaves, program)  # [S', W]
+            filter_dev = self._composed_row_dev(index, filt_calls[0],
+                                                shards)  # [S', W]
 
         # per Rows call: (field, [row_ids], device slab [R, S', W])
         axes = []

@@ -420,6 +420,16 @@ class Handler:
             coal = getattr(ex, "coalescer", None)
             if coal is not None:
                 snap["netCoalesce"] = coal.snapshot()
+            # cost-based planner + generation-keyed plan cache
+            # (pilosa_tpu/planner.py, parallel/residency.py PlanCache):
+            # reorder/pushdown/short-circuit decision counts and the
+            # cross-query subexpression cache's occupancy/hit economics
+            pl = getattr(ex, "planner", None)
+            if pl is not None:
+                snap["planner"] = pl.snapshot()
+            pc = getattr(ex, "plan_cache", None)
+            if pc is not None:
+                snap["planCache"] = pc.snapshot()
             snap["hedges"] = {
                 "hedgesFired": getattr(ex, "hedges_fired", 0),
                 "hedgesWon": getattr(ex, "hedges_won", 0),
@@ -561,6 +571,25 @@ class Handler:
             counts["hedges/fired"] = getattr(ex, "hedges_fired", 0)
             counts["hedges/won"] = getattr(ex, "hedges_won", 0)
             counts["hedges/cancelled"] = getattr(ex, "hedges_cancelled", 0)
+            # query planner + plan cache: emitted unconditionally (zeros
+            # included) so scrapers can alert on "planner stopped
+            # reordering" / "cache hit rate collapsed" without a
+            # first-event race in the family's existence
+            pl = getattr(ex, "planner", None)
+            if pl is not None:
+                ps = pl.snapshot()
+                counts["planner/plans"] = ps["plans"]
+                counts["planner/reorders"] = ps["reorders"]
+                counts["planner/pushdowns"] = ps["pushdowns"]
+                counts["planner/shortCircuits"] = ps["shortCircuits"]
+            pc = getattr(ex, "plan_cache", None)
+            if pc is not None:
+                cs = pc.snapshot()
+                counts["planCache/hits"] = cs["hits"]
+                counts["planCache/misses"] = cs["misses"]
+                counts["planCache/evictions"] = cs["evictions"]
+                gauges["planCache/bytes"] = cs["bytes"]
+                gauges["planCache/entries"] = cs["entries"]
         holder = getattr(self.api, "holder", None)
         if holder is not None:
             damaged = holder.damaged_fragments()

@@ -104,6 +104,23 @@ def xor_count(a: jax.Array, b: jax.Array) -> jax.Array:
 
 
 @counted_jit("count")
+def intersect_chain_count_total(leaves: tuple) -> jax.Array:
+    """Total popcount of an N-way intersection in ONE fused dispatch — the
+    planner's Count(Intersect(...)) pushdown kernel (pilosa_tpu/planner.py).
+
+    The AND chain and the popcount reduction fuse in XLA, so no [S, W]
+    intermediate of the chain ever lands in HBM and no row bitmap is
+    materialized on host: only the final int32 scalar crosses the link.
+    Compiles once per chain *arity* (the leaves tuple's pytree shape)
+    rather than once per nested program tree, so cardinality-reordered
+    chains of the same width share a compilation."""
+    acc = leaves[0]
+    for x in leaves[1:]:
+        acc = jnp.bitwise_and(acc, x)
+    return jnp.sum(popcount(acc))
+
+
+@counted_jit("count")
 def row_popcounts(rows: jax.Array) -> jax.Array:
     """Per-row set-bit counts for a stacked [..., rows, words] slab -> int32.
 

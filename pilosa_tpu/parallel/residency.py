@@ -116,3 +116,87 @@ class DeviceResidency:
             return {"entries": len(self._lru), "bytes": self.bytes,
                     "hits": self.hits, "misses": self.misses,
                     "evictions": self.evictions, "by_kind": by_kind}
+
+
+class PlanCache:
+    """Generation-keyed cross-query subexpression result cache.
+
+    Where DeviceResidency caches query *leaves* (one row / mask per entry),
+    this caches *evaluated subexpressions*: the dense device result of a
+    whole bitmap call tree, or the scalar of a Count over one. Keys come
+    from the planner (pilosa_tpu/planner.py): (index, canonical PQL of the
+    planned subtree, shard set, per-leaf fragment row generations) — the
+    same keying discipline as the residency leaves, so invalidation is
+    free: any write bumps a generation, changes the key, and the stale
+    entry ages out by LRU. Overlapping dashboard queries from many users
+    therefore hit device-resident results instead of recomputing the
+    shared subtree per query.
+
+    Values are either jax.Arrays (dense [S', W] row results, charged at
+    their real HBM bytes) or plain ints (Count results, charged at a
+    nominal SCALAR_COST so a flood of distinct Counts still evicts).
+    `enabled` flips at runtime (bench A/B, [query] plan knob) without
+    tearing down the executor."""
+
+    SCALAR_COST = 256  # nominal bytes per cached scalar entry
+
+    DEFAULT_BUDGET_BYTES = 256 << 20
+
+    def __init__(self, budget_bytes: int = DEFAULT_BUDGET_BYTES):
+        self.budget = budget_bytes
+        self.enabled = True
+        self._lru: OrderedDict = OrderedDict()  # key -> (value, nbytes)
+        self._lock = threading.Lock()
+        self.bytes = 0
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+        self.epoch = 0  # bumped by clear(); fences in-flight computes
+
+    def get(self, key: tuple):
+        """Cached value for `key`, or None (a miss; None is never a
+        cached value — scalar zero counts are cached as int 0)."""
+        if not self.enabled:
+            return None
+        with self._lock:
+            entry = self._lru.get(key)
+            if entry is None:
+                self.misses += 1
+                return None
+            self._lru.move_to_end(key)
+            self.hits += 1
+            return entry[0]
+
+    def put(self, key: tuple, value, nbytes: int, epoch: int = None) -> None:
+        """Insert `value` (device array or int). `epoch`, when given, is
+        the epoch the caller read before computing: a clear() that landed
+        mid-compute (index/field deletion) means the value may describe
+        deleted schema whose recreation could reach identical generation
+        tuples — serve-don't-cache, the DeviceResidency fence."""
+        if not self.enabled:
+            return
+        with self._lock:
+            if epoch is not None and epoch != self.epoch:
+                return
+            displaced = self._lru.pop(key, None)
+            if displaced is not None:
+                self.bytes -= displaced[1]
+            self._lru[key] = (value, nbytes)
+            self.bytes += nbytes
+            while self.bytes > self.budget and len(self._lru) > 1:
+                _, (_, old_bytes) = self._lru.popitem(last=False)
+                self.bytes -= old_bytes
+                self.evictions += 1
+
+    def clear(self) -> None:
+        with self._lock:
+            self._lru.clear()
+            self.bytes = 0
+            self.epoch += 1
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {"entries": len(self._lru), "bytes": self.bytes,
+                    "budget": self.budget, "enabled": self.enabled,
+                    "hits": self.hits, "misses": self.misses,
+                    "evictions": self.evictions}

@@ -34,13 +34,19 @@ class Condition:
 
 
 class Call:
-    __slots__ = ("name", "args", "children")
+    __slots__ = ("name", "args", "children", "pos")
 
     def __init__(self, name: str, args: Optional[dict] = None,
-                 children: Optional[list["Call"]] = None):
+                 children: Optional[list["Call"]] = None,
+                 pos: Optional[int] = None):
         self.name = name
         self.args = args or {}
         self.children = children or []
+        # character offset of the call name in the source PQL (set by the
+        # parser; None for programmatically-built calls). Diagnostic only:
+        # excluded from __eq__ so rewritten/planned trees still compare
+        # equal to hand-built expectations.
+        self.pos = pos
 
     # -- typed arg getters (pql/ast.go:269-360) -----------------------------
 

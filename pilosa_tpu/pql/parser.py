@@ -107,10 +107,17 @@ class _Parser:
 
     def call(self) -> Call:
         self._depth = getattr(self, "_depth", 0) + 1
+        start = self.pos
         try:
             if self._depth > self.MAX_DEPTH:
                 self.error(f"query nested deeper than {self.MAX_DEPTH}")
-            return self._call_inner()
+            out = self._call_inner()
+            # source offset of the call name: executor errors about a
+            # specific call (e.g. a zero-arg Intersect()) can point at the
+            # offending fragment's position in the submitted PQL
+            if out.pos is None:
+                out.pos = start
+            return out
         finally:
             self._depth -= 1
 

@@ -78,7 +78,9 @@ class Server:
                  query_history_size: int = 100,
                  telemetry_interval: float = 5.0,
                  telemetry_ring: int = 720,
-                 log_format: str = "plain"):
+                 log_format: str = "plain",
+                 plan: str = "on",
+                 plan_cache_bytes: int = 256 << 20):
         self.data_dir = data_dir
         # [storage] wal-fsync, plumbed down the model tree to every
         # Fragment (PILOSA_TPU_WAL_FSYNC env overrides per fragment —
@@ -87,6 +89,10 @@ class Server:
             raise ValueError(
                 f"invalid [storage] wal-fsync {wal_fsync!r} "
                 "(expected off | always)")
+        if plan not in ("on", "off"):
+            # a typo'd mode must fail the boot, not silently act as "on"
+            raise ValueError(
+                f"invalid [query] plan {plan!r} (expected on | off)")
         self.wal_fsync = wal_fsync
         self.holder = Holder(data_dir, wal_fsync=(wal_fsync == "always"))
         self.node_id = node_id or self._load_or_create_id()
@@ -137,6 +143,16 @@ class Server:
         # envelope cap, hedged-read delay (0 disables hedging)
         self.executor.fanout_pool_size = fanout_pool_size
         self.executor.hedge_delay = hedge_delay
+        # [query] planner + plan-cache knobs (docs/operations.md "Query
+        # planning"). The env kill switches (PILOSA_TPU_PLANNER=0 /
+        # PILOSA_TPU_PLAN_CACHE=0, read at Executor construction) win over
+        # config — the emergency toggles need no config rollout.
+        if plan == "off":
+            self.executor.planner = None
+        if plan_cache_bytes <= 0:
+            self.executor.plan_cache = None
+        elif self.executor.plan_cache is not None:
+            self.executor.plan_cache.budget = plan_cache_bytes
         if self.executor.coalescer is not None:
             self.executor.coalescer.admission_s = fanout_coalesce_window
             self.executor.coalescer.max_batch = max(
@@ -170,6 +186,7 @@ class Server:
                                           logger=self.logger)
         self._telemetry_prev: tuple = (None, 0.0)
         self._last_hit_rate = 1.0  # carried through zero-lookup windows
+        self._last_plan_hit_rate = 0.0  # plan cache starts cold
         self.api.health_fn = self.node_health
         self.api.node_stats_fn = self.node_stats
         self.api.cluster_stats_fn = self.cluster_stats
@@ -1376,6 +1393,20 @@ class Server:
             raw["residency.hits"] = snap["hits"]
             raw["residency.lookups"] = snap["hits"] + snap["misses"]
             raw["residency.evictions"] = snap["evictions"]
+        pc = getattr(ex, "plan_cache", None)
+        if pc is not None:
+            cs = pc.snapshot()
+            g["plancache.bytes"] = float(cs["bytes"])
+            g["plancache.entries"] = float(cs["entries"])
+            raw["plancache.hits"] = cs["hits"]
+            raw["plancache.lookups"] = cs["hits"] + cs["misses"]
+            raw["plancache.evictions"] = cs["evictions"]
+        pl = getattr(ex, "planner", None)
+        if pl is not None:
+            ps = pl.snapshot()
+            raw["planner.reorders"] = ps["reorders"]
+            raw["planner.pushdowns"] = ps["pushdowns"]
+            raw["planner.short_circuits"] = ps["shortCircuits"]
         depth = 0
         for attr in ("batcher", "sum_batcher", "minmax_batcher"):
             b = getattr(ex, attr, None)
@@ -1452,6 +1483,23 @@ class Server:
                     self._last_hit_rate = max(0.0, dhits) / dlook
             g["residency.hit_rate"] = self._last_hit_rate
             g["residency.evictions_per_s"] = rate("residency.evictions")
+        if pc is not None:
+            # WINDOWED plan-cache hit rate, same rationale as residency's:
+            # a lifetime ratio hides a cache that just started thrashing
+            if prev is not None:
+                dlook = raw["plancache.lookups"] - prev.get(
+                    "plancache.lookups", 0)
+                dhits = raw["plancache.hits"] - prev.get(
+                    "plancache.hits", 0)
+                if dlook > 0:
+                    self._last_plan_hit_rate = max(0.0, dhits) / dlook
+            g["plancache.hit_rate"] = self._last_plan_hit_rate
+            g["plancache.evictions_per_s"] = rate("plancache.evictions")
+        if pl is not None:
+            g["planner.reorders_per_s"] = rate("planner.reorders")
+            g["planner.pushdowns_per_s"] = rate("planner.pushdowns")
+            g["planner.short_circuits_per_s"] = rate(
+                "planner.short_circuits")
         if prev is not None:
             dwaited = raw.get("batcher.waited", 0) - prev.get(
                 "batcher.waited", 0)

@@ -626,6 +626,16 @@ class Fragment:
                         out[x] = c
         return out
 
+    def row_cardinality(self, row_id: int) -> int:
+        """Exact set-bit count of one row — the planner's per-operand
+        statistic (pilosa_tpu/planner.py). Rides the row_counts cache
+        (container-cardinality sums + per-row mutation overlay), so a
+        planning pass over a many-operand query costs dict probes, not
+        container walks; exactness per the current generation is what
+        makes zero-cardinality short-circuits sound rather than
+        heuristic."""
+        return int(self.row_counts([row_id])[0])
+
     def max_row_id(self) -> int:
         m = self.storage.max()
         return 0 if m is None else m // SHARD_WIDTH

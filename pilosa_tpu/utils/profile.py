@@ -66,7 +66,7 @@ class QueryProfile:
     __slots__ = ("trace_id", "node_id", "index", "pql", "start",
                  "start_wall", "elapsed_ms", "calls", "fanout", "dispatches",
                  "residency_hits", "residency_misses", "h2d_bytes",
-                 "remotes", "_lock", "_sealed", "_cached_dict")
+                 "remotes", "plans", "_lock", "_sealed", "_cached_dict")
 
     def __init__(self, trace_id: str = "", node_id: str = "",
                  index: str = "", pql: str = ""):
@@ -88,6 +88,7 @@ class QueryProfile:
         self.residency_misses = 0
         self.h2d_bytes = 0                 # host->device upload bytes
         self.remotes: list[dict] = []      # [{node, profile}] child trees
+        self.plans: list[dict] = []        # planner decisions per call
         self._lock = threading.Lock()
 
     # -- recording hooks (each guarded by a current() is-None check at the
@@ -146,6 +147,18 @@ class QueryProfile:
                 "batchSize": batch_size, "wallMs": round(wall_ms, 3),
                 "shareMs": round(wall_ms / max(1, batch_size), 3)})
 
+    def record_plan(self, plan: dict) -> None:
+        """One planner decision node (pilosa_tpu/planner.py plan_call):
+        chosen operand order, estimated cardinalities, reorder /
+        short-circuit / pushdown flags. The dict is appended by REFERENCE
+        at plan time — the executor fills cache hit/miss events and the
+        actual cardinality into it while the call runs, and to_dict()
+        serializes whatever has accumulated (the tree seals afterwards)."""
+        with self._lock:
+            if self._sealed:
+                return
+            self.plans.append(plan)
+
     def record_residency(self, hit: bool, nbytes: int = 0) -> None:
         with self._lock:
             if self._sealed:
@@ -193,6 +206,7 @@ class QueryProfile:
                 "residency": {"hits": self.residency_hits,
                               "misses": self.residency_misses,
                               "hostToDeviceBytes": self.h2d_bytes},
+                "plan": [dict(p) for p in self.plans],
                 "remoteProfiles": list(self.remotes),
             }
             if self._sealed:

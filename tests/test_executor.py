@@ -345,7 +345,10 @@ def test_topn_attr_filter(ex):
 def test_residency_cache_hits_and_invalidation(ex):
     """Repeat queries hit HBM-resident leaves; a write bumps the fragment row
     generation and forces re-upload (the rowCache invalidation analog,
-    fragment.go:435-440)."""
+    fragment.go:435-440). Plan cache off: it would answer the repeat from
+    the cached scalar before the residency layer is ever consulted — this
+    test targets the layer underneath."""
+    ex.plan_cache.enabled = False
     idx = ex.holder.create_index("i")
     f = idx.create_field("f")
     f.import_bits([1] * 3, [1, 2, 3])
@@ -498,6 +501,9 @@ def test_residency_eviction_pressure(tmp_path):
     h = Holder(str(tmp_path / "data")).open()
     try:
         e = Executor(h, runner=DeviceRunner(None))
+        # plan cache off: repeat sweeps would be answered from cached
+        # scalars without ever touching the residency LRU under test
+        e.plan_cache.enabled = False
         idx = h.create_index("ev", track_existence=False)
         f = idx.create_field("f")
         n_rows, per_row = 24, 300

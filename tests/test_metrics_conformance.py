@@ -253,6 +253,37 @@ def test_live_metrics_fleet_telemetry_series(pair):
     assert any(n == "pilosa_xlaCompiles_total" for n, _, _ in samples)
 
 
+def test_live_metrics_planner_and_plan_cache_series(pair):
+    """Planner PR satellite: the cost-based planner's decision counters
+    and the generation-keyed plan cache's hit economics are scrapeable —
+    emitted unconditionally (zeros included) so the families always exist,
+    and conforming like everything else."""
+    servers, uris = pair
+    # the fixture already ran Count queries (planned); run one more with a
+    # reorderable shape so the counters are visibly live
+    req = urllib.request.Request(
+        uris[0] + "/index/m/query",
+        data=b"Count(Intersect(Row(f=0), Row(f=0)))", method="POST")
+    urllib.request.urlopen(req, timeout=30).read()
+    with urllib.request.urlopen(uris[0] + "/metrics", timeout=10) as r:
+        text = r.read().decode()
+    types, samples = check_conformance(text)
+    assert types["pilosa_planner_total"] == "counter"
+    pkeys = {l.get("key") for n, l, _ in samples
+             if n == "pilosa_planner_total"}
+    assert {"plans", "reorders", "pushdowns", "shortCircuits"} <= pkeys
+    plans = next(v for n, l, v in samples
+                 if n == "pilosa_planner_total" and l.get("key") == "plans")
+    assert plans >= 1  # real traffic was planned
+    assert types["pilosa_planCache_total"] == "counter"
+    ckeys = {l.get("key") for n, l, _ in samples
+             if n == "pilosa_planCache_total"}
+    assert {"hits", "misses", "evictions"} <= ckeys
+    gkeys = {l.get("key") for n, l, _ in samples
+             if n == "pilosa_planCache"}
+    assert {"bytes", "entries"} <= gkeys
+
+
 def test_metrics_endpoint_without_stats_client(pair):
     """A handler with no stats wired still answers 200 with an empty
     (legal) exposition."""

@@ -1,0 +1,399 @@
+"""Cost-based planner + generation-keyed plan cache (pilosa_tpu/planner.py).
+
+Covers: cardinality-ordered reordering (and its canonicalization effect —
+permuted operand orders share one plan-cache key), exact-zero
+short-circuits (and that they never swallow validation errors), cache
+invalidation by write generation, the profiler's `plan` node (chosen
+order, estimated vs actual, cache events, pushdown with zero host row
+bitmap bytes), the env kill switches, the clean zero-operand Intersect()
+error end-to-end through the HTTP API, and the /debug/vars + /metrics
+counter surfaces."""
+
+import json
+import urllib.request
+
+import pytest
+
+from pilosa_tpu.constants import SHARD_WIDTH
+from pilosa_tpu.executor import ExecutionError, Executor
+from pilosa_tpu.models.holder import Holder
+from pilosa_tpu.planner import is_empty_call, subtree_cache_key
+
+
+@pytest.fixture
+def ex(tmp_path):
+    h = Holder(str(tmp_path / "data")).open()
+    e = Executor(h)
+    yield e
+    h.close()
+
+
+@pytest.fixture
+def populated(ex):
+    idx = ex.holder.create_index("i")
+    f = idx.create_field("f")
+    # skewed cardinalities over 2 shards: row0 big, row1 medium, row2 tiny
+    f.import_bits([0] * 3000, list(range(2000))
+                  + [SHARD_WIDTH + c for c in range(1000)])
+    f.import_bits([1] * 50, list(range(50)))
+    f.import_bits([2] * 3, [5, 7, SHARD_WIDTH + 9])
+    for c in list(range(2000)) + [SHARD_WIDTH + c for c in range(1000)]:
+        idx.mark_exists(c)
+    return ex
+
+
+# ------------------------------------------------------------- reordering
+
+
+def test_reorder_cheapest_first(populated):
+    ex = populated
+    idx = ex.holder.index("i")
+    q = "Count(Intersect(Row(f=0), Row(f=2), Row(f=1)))"
+    from pilosa_tpu.pql import parse_string
+    call = parse_string(q).calls[0]
+    shards = idx.available_shards_list()
+    planned, info = ex.planner.plan_call(idx, call, shards)
+    # child of Count reordered ascending by exact cardinality: 2 (3 bits),
+    # 1 (50 bits), 0 (3000 bits)
+    rows = [c.args["f"] for c in planned.children[0].children]
+    assert rows == [2, 1, 0]
+    assert info["reorders"] == 1
+    assert info["order"][0].startswith("Row(f=2)")
+    # estimates are exact for plain rows
+    by_expr = {e["expr"]: e for e in info["estimates"]}
+    assert by_expr["Row(f=2)"]["est"] == 3 and by_expr["Row(f=2)"]["exact"]
+    assert by_expr["Row(f=0)"]["est"] == 3000
+    # the original parsed AST was not mutated (shared via parse cache)
+    assert [c.args["f"] for c in call.children[0].children] == [0, 2, 1]
+
+
+def test_reorder_does_not_change_results(populated):
+    ex = populated
+    for q in ("Count(Intersect(Row(f=0), Row(f=1)))",
+              "Count(Union(Row(f=2), Row(f=0), Row(f=1)))",
+              "Count(Xor(Row(f=1), Row(f=2)))",
+              "Intersect(Row(f=0), Row(f=1))"):
+        (planned,) = ex.execute("i", q)
+        ex2 = Executor(ex.holder)
+        ex2.planner = None
+        ex2.plan_cache = None
+        (unplanned,) = ex2.execute("i", q)
+        if hasattr(planned, "segments"):
+            assert {s: list(c) for s, c in planned.segments.items()} == \
+                   {s: list(c) for s, c in unplanned.segments.items()}
+        else:
+            assert planned == unplanned
+
+
+def test_permuted_operands_share_cache_entry(populated):
+    ex = populated
+    assert ex.execute("i", "Count(Intersect(Row(f=0), Row(f=1)))")[0] == 50
+    s0 = ex.plan_cache.snapshot()
+    assert ex.execute("i", "Count(Intersect(Row(f=1), Row(f=0)))")[0] == 50
+    s1 = ex.plan_cache.snapshot()
+    assert s1["hits"] == s0["hits"] + 1  # canonical order shared the key
+    assert s1["entries"] == s0["entries"]
+
+
+# ---------------------------------------------------------- short-circuit
+
+
+def test_short_circuit_empty_intersect(populated):
+    ex = populated
+    idx = ex.holder.index("i")
+    res0 = ex.residency.snapshot()
+    # row 9 holds no bits: Intersect is provably empty — no leaves
+    # uploaded, no dispatch
+    assert ex.execute("i", "Count(Intersect(Row(f=0), Row(f=9)))")[0] == 0
+    assert ex.planner.snapshot()["shortCircuits"] >= 1
+    assert ex.residency.snapshot() == res0  # nothing materialized
+    row = ex.execute("i", "Intersect(Row(f=0), Row(f=9))")[0]
+    assert not row.segments
+
+
+def test_union_drops_empty_children(populated):
+    ex = populated
+    idx = ex.holder.index("i")
+    from pilosa_tpu.pql import parse_string
+    call = parse_string("Union(Row(f=9), Row(f=2), Row(f=9))").calls[0]
+    planned, info = ex.planner.plan_call(
+        idx, call, idx.available_shards_list())
+    assert info["shortCircuits"] == 2
+    assert len(planned.children) == 1
+    assert planned.children[0].args["f"] == 2
+    # all-empty union collapses to the canonical empty call
+    call2 = parse_string("Union(Row(f=9), Row(f=8))").calls[0]
+    planned2, _ = ex.planner.plan_call(
+        idx, call2, idx.available_shards_list())
+    assert is_empty_call(planned2)
+
+
+def test_difference_first_empty_short_circuits(populated):
+    ex = populated
+    assert ex.execute("i", "Count(Difference(Row(f=9), Row(f=0)))")[0] == 0
+    # a &~ empty = a: the empty subtrahend drops out
+    assert ex.execute("i", "Count(Difference(Row(f=1), Row(f=9)))")[0] == 50
+
+
+def test_short_circuit_never_swallows_validation_errors(populated):
+    ex = populated
+    # nofield does not exist: the planned query must still raise, even
+    # though Row(f=9) is provably empty
+    with pytest.raises(ExecutionError, match="field not found"):
+        ex.execute("i", "Count(Intersect(Row(f=9), Row(nofield=1)))")
+
+
+def test_empty_intersect_clean_error(populated):
+    ex = populated
+    with pytest.raises(ExecutionError) as ei:
+        ex.execute("i", "Count(Intersect())")
+    msg = str(ei.value)
+    assert "Intersect()" in msg
+    assert "offset 6" in msg  # position of Intersect inside Count(...)
+    with pytest.raises(ExecutionError, match="Difference"):
+        ex.execute("i", "Count(Difference())")
+
+
+# ----------------------------------------------------------- plan cache
+
+
+def test_cache_hit_and_generation_invalidation(populated):
+    ex = populated
+    q = "Count(Intersect(Row(f=0), Row(f=1)))"
+    assert ex.execute("i", q)[0] == 50
+    s0 = ex.plan_cache.snapshot()
+    assert ex.execute("i", q)[0] == 50
+    s1 = ex.plan_cache.snapshot()
+    assert s1["hits"] == s0["hits"] + 1
+    # a write bumps the row generation -> new key -> recompute, fresh data
+    f = ex.holder.index("i").field("f")
+    f.set_bit(1, 100)  # row 1 gains a column inside row 0's range
+    assert ex.execute("i", q)[0] == 51
+    s2 = ex.plan_cache.snapshot()
+    assert s2["misses"] > s1["misses"]
+
+
+def test_cached_row_results_are_dense_device_arrays(populated):
+    ex = populated
+    q = "Intersect(Row(f=0), Row(f=2))"
+    r1 = ex.execute("i", q)[0]
+    s0 = ex.plan_cache.snapshot()
+    r2 = ex.execute("i", q)[0]
+    assert ex.plan_cache.snapshot()["hits"] == s0["hits"] + 1
+    assert {s: list(c) for s, c in r1.segments.items()} == \
+           {s: list(c) for s, c in r2.segments.items()}
+    assert s0["bytes"] > 0
+
+
+def test_cache_budget_evicts_lru(populated):
+    ex = populated
+    ex.plan_cache.budget = 2 * (SHARD_WIDTH // 8) * 2  # ~2 dense rows
+    for rid in (0, 1, 2):
+        ex.execute("i", f"Intersect(Row(f={rid}), Row(f={rid}))")
+    snap = ex.plan_cache.snapshot()
+    assert snap["evictions"] >= 1
+    assert snap["bytes"] <= ex.plan_cache.budget
+
+
+def test_subtree_cache_key_stable_and_generation_sensitive(populated):
+    ex = populated
+    idx = ex.holder.index("i")
+    from pilosa_tpu.pql import parse_string
+    call = parse_string("Intersect(Row(f=1), Row(f=2))").calls[0]
+    shards = idx.available_shards_list()
+    k1 = subtree_cache_key(ex, idx, call, shards)
+    k2 = subtree_cache_key(ex, idx, call, shards)
+    assert k1 == k2
+    idx.field("f").set_bit(1, 500)  # a NEW bit (col 500 not in row 1)
+    assert subtree_cache_key(ex, idx, call, shards) != k1
+    # setting an already-set bit is a no-op: no generation bump, same key
+    k3 = subtree_cache_key(ex, idx, call, shards)
+    idx.field("f").set_bit(1, 500)
+    assert subtree_cache_key(ex, idx, call, shards) == k3
+
+
+def test_clear_caches_drops_plan_cache(populated):
+    ex = populated
+    ex.execute("i", "Count(Row(f=0))")
+    assert ex.plan_cache.snapshot()["entries"] >= 1
+    ex.clear_caches()
+    assert ex.plan_cache.snapshot()["entries"] == 0
+
+
+# ---------------------------------------------------------- kill switches
+
+
+def test_env_kill_switches(tmp_path, monkeypatch):
+    h = Holder(str(tmp_path / "kd")).open()
+    try:
+        monkeypatch.setenv("PILOSA_TPU_PLANNER", "0")
+        monkeypatch.setenv("PILOSA_TPU_PLAN_CACHE", "0")
+        off = Executor(h)
+        assert off.planner is None and off.plan_cache is None
+        idx = h.create_index("k")
+        f = idx.create_field("f")
+        f.import_bits([0, 0, 1], [1, 2, 2])
+        # written-order execution still correct, nothing cached
+        assert off.execute("k", "Count(Intersect(Row(f=0), Row(f=1)))")[0] \
+            == 1
+        monkeypatch.setenv("PILOSA_TPU_PLANNER", "1")
+        monkeypatch.setenv("PILOSA_TPU_PLAN_CACHE", "1")
+        on = Executor(h)
+        assert on.planner is not None and on.plan_cache is not None
+        assert on.execute("k", "Count(Intersect(Row(f=0), Row(f=1)))")[0] \
+            == 1
+    finally:
+        h.close()
+
+
+def test_server_config_knobs(tmp_path):
+    from pilosa_tpu.server import Server
+    srv = Server(str(tmp_path / "s"), port=0, plan="off",
+                 plan_cache_bytes=0).open()
+    try:
+        assert srv.executor.planner is None
+        assert srv.executor.plan_cache is None
+    finally:
+        srv.close()
+    with pytest.raises(ValueError, match="plan"):
+        Server(str(tmp_path / "s2"), port=0, plan="maybe")
+
+
+# ------------------------------------------------------- profiler surface
+
+
+def test_profile_plan_node_pushdown_and_cache_events(tmp_path):
+    from pilosa_tpu.server import Server
+    srv = Server(str(tmp_path / "p"), port=0).open()
+    try:
+        uri = srv.uri
+
+        def jpost(path, payload=None, raw=None):
+            body = raw if raw is not None else json.dumps(
+                payload or {}).encode()
+            req = urllib.request.Request(uri + path, data=body,
+                                         method="POST")
+            with urllib.request.urlopen(req, timeout=30) as r:
+                return json.loads(r.read())
+
+        jpost("/index/p", {})
+        jpost("/index/p/field/f", {})
+        jpost("/index/p/field/f/import",
+              {"rowIDs": [0] * 100 + [1] * 10,
+               "columnIDs": list(range(100)) + list(range(10))})
+        q = b"Count(Intersect(Row(f=1), Row(f=0)))"
+        out = jpost("/index/p/query?profile=true", raw=q)
+        plan = out["profile"]["plan"]
+        assert plan, out["profile"]
+        node = plan[0]
+        assert node["call"] == "Count"
+        assert node["pushdown"] is True
+        assert node["hostRowBitmapBytes"] == 0  # no host materialization
+        assert node["order"][0].startswith("Row(f=1)")  # cheapest first
+        assert node["actualCardinality"] == 10
+        ests = {e["expr"]: e["est"] for e in node["estimates"]}
+        assert ests["Row(f=1)"] == 10 and ests["Row(f=0)"] == 100
+        assert node["cache"] and node["cache"][0]["hit"] is False
+        # repeat: the cache event records a hit this time
+        out2 = jpost("/index/p/query?profile=true", raw=q)
+        node2 = out2["profile"]["plan"][0]
+        assert node2["cache"][0]["hit"] is True
+        assert node2["actualCardinality"] == 10
+        # slow-query history carries the plan node (long_query_time=0
+        # records nothing, so arm it and re-run)
+        srv.api.long_query_time = 1e-9
+        jpost("/index/p/query?profile=true", raw=q)
+        with urllib.request.urlopen(uri + "/debug/query-history",
+                                    timeout=10) as r:
+            hist = json.loads(r.read())["queries"]
+        assert hist and hist[0]["profile"]["plan"][0]["call"] == "Count"
+    finally:
+        srv.close()
+
+
+def test_zero_arg_intersect_http_e2e(tmp_path):
+    from pilosa_tpu.server import Server
+    srv = Server(str(tmp_path / "z"), port=0).open()
+    try:
+        uri = srv.uri
+        req = urllib.request.Request(uri + "/index/z", data=b"{}",
+                                     method="POST")
+        urllib.request.urlopen(req, timeout=10).read()
+        req = urllib.request.Request(uri + "/index/z/query",
+                                     data=b"Count(Intersect())",
+                                     method="POST")
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=10)
+        assert ei.value.code == 400
+        body = ei.value.read().decode()
+        assert "Intersect()" in body
+        assert "offset 6" in body  # position inside Count(Intersect())
+        assert "not supported" not in body  # the old bare error is gone
+    finally:
+        srv.close()
+
+
+# ----------------------------------------------------- counter surfaces
+
+
+def test_debug_vars_and_metrics_counters(tmp_path):
+    from pilosa_tpu.server import Server
+    srv = Server(str(tmp_path / "m"), port=0).open()
+    try:
+        uri = srv.uri
+
+        def jpost(path, payload=None, raw=None):
+            body = raw if raw is not None else json.dumps(
+                payload or {}).encode()
+            req = urllib.request.Request(uri + path, data=body,
+                                         method="POST")
+            with urllib.request.urlopen(req, timeout=30) as r:
+                return json.loads(r.read())
+
+        jpost("/index/m", {})
+        jpost("/index/m/field/f", {})
+        jpost("/index/m/field/f/import",
+              {"rowIDs": [0, 0, 1], "columnIDs": [1, 2, 2]})
+        q = b"Count(Intersect(Row(f=0), Row(f=1)))"  # written order is
+        # NOT cheapest-first (row 0 has 2 bits, row 1 has 1): reorders
+        jpost("/index/m/query", raw=q)
+        jpost("/index/m/query", raw=q)
+        with urllib.request.urlopen(uri + "/debug/vars", timeout=10) as r:
+            d = json.loads(r.read())
+        assert d["planner"]["plans"] >= 2
+        assert d["planner"]["reorders"] >= 1
+        assert d["planner"]["pushdowns"] >= 2
+        assert d["planCache"]["hits"] >= 1
+        assert d["planCache"]["entries"] >= 1
+        with urllib.request.urlopen(uri + "/metrics", timeout=10) as r:
+            text = r.read().decode()
+        for needle in ('pilosa_planner_total{key="reorders"}',
+                       'pilosa_planner_total{key="pushdowns"}',
+                       'pilosa_planner_total{key="shortCircuits"}',
+                       'pilosa_planCache_total{key="hits"}',
+                       'pilosa_planCache_total{key="misses"}',
+                       'pilosa_planCache_total{key="evictions"}',
+                       'pilosa_planCache{key="bytes"}',
+                       'pilosa_planCache{key="entries"}'):
+            assert needle in text, needle
+        # telemetry ring series (sample_gauges): planner/plancache gauges
+        g = srv.sample_gauges()
+        assert "plancache.bytes" in g and "plancache.hit_rate" in g
+        g2 = srv.sample_gauges()  # second tick: windowed rates computed
+        assert "planner.reorders_per_s" in g2
+    finally:
+        srv.close()
+
+
+def test_planner_defensive_on_estimation_surprise(populated):
+    """A planner that trips over an exotic call shape degrades to written
+    order, never a new error."""
+    ex = populated
+    idx = ex.holder.index("i")
+    from pilosa_tpu.pql import Call
+    weird = Call("Count", {}, [Call("Intersect", {}, [
+        Call("Row", {"f": 0}), Call("Bogus", {})])])
+    # planner leaves it alone (Bogus is unknown): the executor raises its
+    # own error, same as unplanned
+    with pytest.raises(ExecutionError, match="expected bitmap call"):
+        ex._execute_call(idx, weird, None)

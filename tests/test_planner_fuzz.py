@@ -1,0 +1,157 @@
+"""Planner/plan-cache parity fuzz: randomized PQL call trees, with writes
+interleaved to churn generations, asserting planned+cached execution is
+bit-identical to written-order evaluation.
+
+Two executors share one holder: `planned` runs with the planner and the
+cross-query plan cache on (the cache is deliberately left WARM across
+rounds — the interleaved writes are exactly what must invalidate it via
+generation keys), `plain` runs with both kill switches thrown. Any
+divergence — results, or error-vs-result behavior — is a planner bug.
+"""
+
+import numpy as np
+import pytest
+
+from pilosa_tpu.constants import SHARD_WIDTH
+from pilosa_tpu.executor import ExecutionError, Executor, Pairs
+from pilosa_tpu.models.holder import Holder
+
+FIELDS = ("f", "g", "h")
+N_ROWS = 6  # rows 4/5 stay sparse-or-empty so short-circuits exercise
+SHARDS = 2
+
+
+@pytest.fixture(scope="module")
+def setup(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("planfuzz")
+    h = Holder(str(tmp / "data")).open()
+    rng = np.random.default_rng(7)
+    idx = h.create_index("z")
+    for fname in FIELDS:
+        f = idx.create_field(fname)
+        for rid in range(N_ROWS - 2):
+            n = int(rng.integers(1, 400) * (4 ** (rid % 3)))
+            cols = rng.choice(SHARDS * SHARD_WIDTH, size=min(n, 5000),
+                              replace=False)
+            f.import_bits([rid] * len(cols), cols.tolist())
+            for c in cols[:64]:
+                idx.mark_exists(int(c))
+    planned = Executor(h)
+    assert planned.planner is not None and planned.plan_cache is not None
+    import os
+    os.environ["PILOSA_TPU_PLANNER"] = "0"
+    os.environ["PILOSA_TPU_PLAN_CACHE"] = "0"
+    try:
+        plain = Executor(h)
+    finally:
+        del os.environ["PILOSA_TPU_PLANNER"]
+        del os.environ["PILOSA_TPU_PLAN_CACHE"]
+    assert plain.planner is None and plain.plan_cache is None
+    yield h, planned, plain, rng
+    h.close()
+
+
+def _rand_bitmap(rng, depth: int) -> str:
+    if depth <= 0 or rng.random() < 0.35:
+        fname = FIELDS[int(rng.integers(len(FIELDS)))]
+        rid = int(rng.integers(N_ROWS))
+        return f"Row({fname}={rid})"
+    op = ("Intersect", "Union", "Difference", "Xor",
+          "Not")[int(rng.integers(5))]
+    if op == "Not":
+        return f"Not({_rand_bitmap(rng, depth - 1)})"
+    n = int(rng.integers(2, 4))
+    kids = ", ".join(_rand_bitmap(rng, depth - 1) for _ in range(n))
+    return f"{op}({kids})"
+
+
+def _rand_query(rng) -> str:
+    inner = _rand_bitmap(rng, int(rng.integers(1, 4)))
+    shape = rng.random()
+    if shape < 0.45:
+        return f"Count({inner})"
+    if shape < 0.6:
+        fname = FIELDS[int(rng.integers(len(FIELDS)))]
+        return f"TopN({fname}, {inner}, n=4)"
+    return inner
+
+
+def _canon(result):
+    if isinstance(result, Pairs):
+        return ("pairs", list(result))
+    if hasattr(result, "segments"):
+        return ("row", {int(s): [int(c) for c in cols]
+                        for s, cols in result.segments.items()})
+    return ("val", result)
+
+
+def _run(ex, pql):
+    try:
+        return _canon(ex.execute("z", pql)[0])
+    except (ExecutionError, ValueError):
+        return ("error",)  # both sides must error; messages may differ
+        # (reordering legitimately changes which operand errors first)
+
+
+def test_parity_randomized_trees_with_interleaved_writes(setup):
+    h, planned, plain, rng = setup
+    idx = h.index("z")
+    mismatches = []
+    for round_no in range(60):
+        for _ in range(4):
+            pql = _rand_query(rng)
+            a = _run(planned, pql)
+            b = _run(plain, pql)
+            if a != b:
+                mismatches.append((round_no, pql, a, b))
+        # interleave writes to churn generations: the warm cache must
+        # never serve a pre-write result
+        fname = FIELDS[int(rng.integers(len(FIELDS)))]
+        rid = int(rng.integers(N_ROWS))
+        col = int(rng.integers(SHARDS * SHARD_WIDTH))
+        f = idx.field(fname)
+        if rng.random() < 0.75:
+            f.set_bit(rid, col)
+            idx.mark_exists(col)
+        else:
+            f.clear_bit(rid, col)
+    assert not mismatches, mismatches[:5]
+    # the fuzz actually exercised the machinery
+    psnap = planned.planner.snapshot()
+    csnap = planned.plan_cache.snapshot()
+    assert psnap["plans"] > 100
+    assert csnap["misses"] > 0
+
+
+def test_parity_groupby_and_aggregates(setup):
+    """GroupBy filters and Sum/Min/Max filter subtrees ride the plan
+    cache; results must match written-order evaluation exactly."""
+    h, planned, plain, rng = setup
+    idx = h.index("z")
+    from pilosa_tpu.models.field import FieldOptions, FieldType
+    fi = idx.create_field("v", FieldOptions(type=FieldType.INT,
+                                            min=0, max=1000))
+    cols = rng.choice(SHARDS * SHARD_WIDTH, size=300, replace=False)
+    for c in cols:
+        fi.set_value(int(c), int(rng.integers(0, 1000)))
+    queries = [
+        "GroupBy(Rows(f), filter=Intersect(Row(g=0), Row(g=1)))",
+        "GroupBy(Rows(f), Rows(g), limit=10)",
+        "Sum(Intersect(Row(f=0), Row(f=1)), field=v)",
+        "Min(Union(Row(f=0), Row(g=0)), field=v)",
+        "Max(Intersect(Row(f=0), Row(f=0)), field=v)",
+    ]
+    for q in queries * 2:  # second pass: warm plan cache
+        a = _run(planned, q)
+        b = _run(plain, q)
+        assert a == b, q
+
+
+def test_kill_switch_parity(setup):
+    """PILOSA_TPU_PLANNER=0 / PILOSA_TPU_PLAN_CACHE=0 executors produce
+    identical results to the planned one on the same live data (the
+    kill-switch escape hatch must always be safe to throw)."""
+    h, planned, plain, rng = setup
+    for _ in range(20):
+        pql = _rand_query(rng)
+        assert _run(planned, pql) == _run(plain, pql), pql

@@ -206,11 +206,21 @@ def test_distributed_profile_tree_acceptance_shape(cluster):
     dispatch record with batch_size >= 1 + residency counts, and remote
     fragments assembled from the QueryResponse.Profile protobuf field."""
     servers, uris = cluster
-    # run twice: the second profile sees warm residency (hits) while the
-    # assertions stay valid for both
-    jpost(uris[0], "/index/i/query?profile=true", raw=b"Count(Row(f=0))")
-    out = jpost(uris[0], "/index/i/query?profile=true",
-                raw=b"Count(Row(f=0))")
+    # plan cache off for this test: a warm repeat would be served from the
+    # cached Count scalar with (correctly) zero dispatches and zero
+    # residency lookups — this test asserts the attribution plumbing
+    # underneath the cache
+    for s in servers:
+        s.executor.plan_cache.enabled = False
+    try:
+        # run twice: the second profile sees warm residency (hits) while
+        # the assertions stay valid for both
+        jpost(uris[0], "/index/i/query?profile=true", raw=b"Count(Row(f=0))")
+        out = jpost(uris[0], "/index/i/query?profile=true",
+                    raw=b"Count(Row(f=0))")
+    finally:
+        for s in servers:
+            s.executor.plan_cache.enabled = True
     prof = out["profile"]
     assert prof["traceId"] and prof["node"] == "a"
     assert prof["calls"] and prof["calls"][0]["call"] == "Count"
