@@ -980,6 +980,119 @@ def bench_telemetry(tmpdir) -> dict:
         srv.close()
 
 
+ACCOUNTING_CLIENTS = int(os.environ.get(
+    "PILOSA_BENCH_ACCOUNTING_CLIENTS", "256"))
+ACCOUNTING_ROUNDS = int(os.environ.get(
+    "PILOSA_BENCH_ACCOUNTING_ROUNDS", "3"))
+ACCOUNTING_QPC = int(os.environ.get("PILOSA_BENCH_ACCOUNTING_QPC", "4"))
+
+
+def bench_accounting(tmpdir) -> dict:
+    """Per-principal accounting overhead A/B (budget: <= 1%, the PR 5
+    telemetry methodology): one server, ACCOUNTING_CLIENTS keep-alive
+    clients each carrying its own DISTINCT X-API-Key (the worst case for
+    the ledger — every request resolves a principal, charges several
+    sites, and the key space saturates the tracked-principal bound so the
+    spill path also runs), interleaved ledger-disabled/enabled rounds.
+    The headline is the median-latency delta of enabling accounting."""
+    import http.client
+    import statistics
+    import threading
+
+    from pilosa_tpu.server import Server
+
+    srv = Server(os.path.join(tmpdir, "acct"), port=0).open()
+    try:
+        hostport = srv.uri.split("//", 1)[1]
+        _local = threading.local()
+
+        def post(path, body, key):
+            conn = getattr(_local, "conn", None)
+            if conn is None:
+                conn = _local.conn = http.client.HTTPConnection(
+                    hostport, timeout=60)
+            headers = {"X-API-Key": key}
+            try:
+                conn.request("POST", path, body=body, headers=headers)
+                resp = conn.getresponse()
+                out = resp.read()
+            except (http.client.HTTPException, OSError):
+                conn.close()
+                conn = _local.conn = http.client.HTTPConnection(
+                    hostport, timeout=60)
+                conn.request("POST", path, body=body, headers=headers)
+                resp = conn.getresponse()
+                out = resp.read()
+            if resp.status != 200:
+                raise RuntimeError(f"{path}: {resp.status}: {out[:200]}")
+            return out
+
+        post("/index/ac", b"{}", "setup")
+        post("/index/ac/field/f", b"{}", "setup")
+        rng = np.random.default_rng(31)
+        cols = rng.choice(4 * SHARD_WIDTH, size=100_000, replace=False)
+        half = len(cols) // 2
+        post("/index/ac/field/f/import", json.dumps({
+            "rowIDs": [0] * half + [1] * (len(cols) - half),
+            "columnIDs": cols.tolist()}).encode(), "setup")
+        q = b"Count(Intersect(Row(f=0), Row(f=1)))"
+        for _ in range(5):
+            post("/index/ac/query", q, "warm")  # warm residency + compile
+
+        def run_round(accounting_on: bool) -> float:
+            srv.usage.enabled = accounting_on
+            lats: list[float] = []
+            lat_lock = threading.Lock()
+            barrier = threading.Barrier(ACCOUNTING_CLIENTS)
+
+            def client(i):
+                mine = []
+                barrier.wait()
+                for _ in range(ACCOUNTING_QPC):
+                    t0 = time.perf_counter()
+                    post("/index/ac/query", q, f"bench-key-{i}")
+                    mine.append((time.perf_counter() - t0) * 1e3)
+                with lat_lock:
+                    lats.extend(mine)
+
+            threads = [threading.Thread(target=client, args=(i,))
+                       for i in range(ACCOUNTING_CLIENTS)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            return statistics.median(lats)
+
+        rounds = []
+        for _ in range(ACCOUNTING_ROUNDS):
+            rnd = {"ms_off": round(run_round(False), 4),
+                   "ms_on": round(run_round(True), 4)}
+            rnd["overhead_pct"] = round(
+                100.0 * (rnd["ms_on"] / rnd["ms_off"] - 1.0), 2) \
+                if rnd["ms_off"] else 0.0
+            rounds.append(rnd)
+        srv.usage.enabled = True
+        snap = srv.usage.snapshot()
+        overheads = sorted(r["overhead_pct"] for r in rounds)
+        return {
+            "metric": "accounting_overhead_pct",
+            "value": overheads[len(overheads) // 2],
+            "unit": "% (ledger on vs off, median latency at "
+                    f"{ACCOUNTING_CLIENTS} keyed clients; budget <= 1%)",
+            "rounds": rounds,
+            "tracked_principals": snap["trackedPrincipals"],
+            "spilled_principals": snap["spilledPrincipals"],
+            "total_queries_accounted": snap["totals"]["queries"],
+            "vs_baseline": 0.0,
+            "path": f"{ACCOUNTING_CLIENTS} keep-alive clients x "
+                    f"{ACCOUNTING_QPC} Count(Intersect) each, one distinct "
+                    "X-API-Key per client (ledger bound + spill exercised), "
+                    "interleaved usage.enabled=False/True rounds",
+        }
+    finally:
+        srv.close()
+
+
 PLANNER_SHARDS = 8
 PLANNER_CLIENTS = int(os.environ.get("PILOSA_BENCH_PLANNER_CLIENTS", "256"))
 PLANNER_ROUNDS = int(os.environ.get("PILOSA_BENCH_PLANNER_ROUNDS", "3"))
@@ -1489,6 +1602,7 @@ def worker() -> None:
         stage("http", bench_http, tmp)
         stage("profiler", bench_profiler, tmp)
         stage("telemetry", bench_telemetry, tmp)
+        stage("accounting", bench_accounting, tmp)
         stage("planner", bench_planner, tmp)
         stage("distributed", bench_distributed, tmp)
     finally:

@@ -36,6 +36,7 @@ from pilosa_tpu.parallel.cluster import (
     STATE_STARTING,
     Cluster,
 )
+from pilosa_tpu.utils import accounting
 from pilosa_tpu.utils import profile as qprofile
 from pilosa_tpu.utils import tracing
 from pilosa_tpu.utils.translate import TranslateStore
@@ -131,6 +132,24 @@ class API:
         self.node_stats_fn = None
         self.cluster_stats_fn = None
         self.start_time = time.time()  # uptime_seconds on /status
+        # per-principal resource accounting (utils/accounting.py): the
+        # HTTP layer installs an Account per request against this ledger;
+        # every charge site in the stack (batchers, residency, plan
+        # cache, RPC client) attributes through the contextvar. A bare
+        # API gets the default-bounded ledger; Server re-sizes it from
+        # the [metric] usage-* knobs.
+        self.usage_ledger = accounting.UsageLedger()
+        # [slo] objectives evaluated with multi-window burn rates; the
+        # default availability objective keeps the slo/* families alive
+        # on every deployment (Server replaces with the configured set)
+        self.slo = accounting.SLOTracker(
+            [accounting.Objective("availability", None, None, 0.999)])
+        # external trace egress ([metric] trace-export; utils/tracing.py
+        # TraceExporter): finished cross-node profile trees ship as
+        # Jaeger/OTLP-JSON span batches. None = export off.
+        self.trace_exporter = None
+        # federation hook for GET /cluster/usage (Server.cluster_usage)
+        self.cluster_usage_fn = None
 
     def _broadcast(self, msg: dict) -> None:
         if self.broadcast_fn is not None:
@@ -216,6 +235,7 @@ class API:
                 pql=qprofile.truncate_pql(pql))
             prof_tok = qprofile.current_profile.set(prof)
         start = _time.perf_counter()
+        ok = False
         try:
             results = self.executor.execute(index_name, query, shards=shards,
                                             remote=remote)
@@ -229,6 +249,7 @@ class API:
                             r.segments = {}
                         if exclude_row_attrs:
                             r.attrs = {}
+            ok = True
             return results
         except (ExecutionError, ValueError) as e:
             raise ApiError(str(e))
@@ -239,6 +260,24 @@ class API:
             if prof is not None:
                 prof.finish()
             qprofile.last_profile.set(prof)
+            # per-principal query/error counts (the device/HBM/RPC
+            # charges landed at their own sites while the query ran)
+            acct = accounting.current_account.get()
+            if acct is not None:
+                acct.charge(queries=1, errors=0 if ok else 1)
+            # SLO observation by query class; coordinator-side only —
+            # remote sub-requests are an implementation detail of the
+            # same user-visible query and must not dilute the objective
+            if self.slo is not None and not remote:
+                self.slo.observe(accounting.classify_query(query),
+                                 elapsed, ok)
+            if (prof is not None and not remote
+                    and self.trace_exporter is not None):
+                # coordinator-only export: the finished tree already
+                # contains the remote fragments, so one export carries
+                # every node's spans under one trace id (a remote
+                # exporting its fragment too would duplicate spans)
+                self.trace_exporter.export_profile(prof.to_dict())
             if slow_armed and elapsed > self.long_query_time:
                 trace_id = tracing.current_trace_id.get() or "-"
                 short_pql = qprofile.truncate_pql(pql)
@@ -305,6 +344,7 @@ class API:
         def one(e: dict) -> tuple:
             dl_token = None
             tr_token = None
+            acct_token = None
             try:
                 timeout = e.get("timeout")
                 if timeout is not None:
@@ -323,6 +363,18 @@ class API:
                     # each coalesced caller's spans must join the caller's
                     # own trace, not the leader's
                     tr_token = tracing.current_trace_id.set(str(trace_id))
+                principal = e.get("principal")
+                if principal and self.usage_ledger is not None \
+                        and self.usage_ledger.enabled \
+                        and accounting.enabled():
+                    # per-entry principal (the trace id's twin again):
+                    # the envelope arrived under the LEADER's inherited
+                    # header, but this entry's device/HBM charges belong
+                    # to the caller whose query rode it
+                    acct_token = accounting.current_account.set(
+                        accounting.Account(self.usage_ledger,
+                                           accounting._sanitize(
+                                               str(principal))))
                 pql = e.get("query", "")
                 query = parse_string_cached(pql)
                 for c in query.calls:
@@ -352,6 +404,8 @@ class API:
                     qctx.deadline.reset(dl_token)
                 if tr_token is not None:
                     tracing.current_trace_id.reset(tr_token)
+                if acct_token is not None:
+                    accounting.current_account.reset(acct_token)
 
         if len(entries) <= 1:
             return [one(e) for e in entries]

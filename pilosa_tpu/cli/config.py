@@ -119,6 +119,48 @@ class MetricConfig:
     # bounded sample capacity (720 x 5s = one hour of history)
     telemetry_interval: float = 5.0
     telemetry_ring: int = 720
+    # per-principal usage ledger bounds (utils/accounting.py; GET
+    # /debug/usage): tracked-principal cap with lowest-spender spill and
+    # the since-cursor delta ring's capacity. PILOSA_TPU_ACCOUNTING=0 is
+    # the env kill switch.
+    usage_max_principals: int = 256
+    usage_ring: int = 360
+    # external trace export (utils/tracing.py TraceExporter): "off"
+    # (default), "file" (append Jaeger/OTLP-JSON batches to
+    # trace-export-path, default <data-dir>/trace-spool.jsonl), or
+    # "http" (POST batches to trace-export-endpoint). trace-export-sample
+    # is the deterministic per-trace sampling fraction;
+    # PILOSA_TPU_TRACE_EXPORT=0 is the env kill switch.
+    trace_export: str = "off"
+    trace_export_path: str = ""
+    trace_export_endpoint: str = ""
+    trace_export_format: str = "jaeger"  # jaeger | otlp
+    trace_export_sample: float = 1.0
+
+
+@dataclass
+class SLOConfig:
+    """[slo] — service-level objectives per query class, evaluated with
+    multi-window (short/long) burn-rate math in the telemetry sampler
+    (utils/accounting.py SLOTracker) and surfaced as slo/* gauges plus a
+    red/yellow contribution to the shared health score.
+
+    <class>-latency-ms (read / count / topn / groupby): a query of that
+    class slower than the bound counts against the error budget; 0
+    disables that objective. latency-target is the good fraction for
+    every latency objective; availability-target covers all queries
+    (errors only; 0 disables). An objective trips yellow/red when BOTH
+    windows burn the budget faster than burn-yellow / burn-red."""
+    read_latency_ms: float = 0.0
+    count_latency_ms: float = 0.0
+    topn_latency_ms: float = 0.0
+    groupby_latency_ms: float = 0.0
+    latency_target: float = 0.99
+    availability_target: float = 0.999
+    burn_yellow: float = 6.0
+    burn_red: float = 14.4
+    window_short: float = 300.0
+    window_long: float = 3600.0
 
 
 @dataclass
@@ -185,6 +227,7 @@ class Config:
     verbose: bool = False
     tls: TLSConfig = field(default_factory=TLSConfig)
     query: QueryConfig = field(default_factory=QueryConfig)
+    slo: SLOConfig = field(default_factory=SLOConfig)
     cluster: ClusterConfig = field(default_factory=ClusterConfig)
     storage: StorageConfig = field(default_factory=StorageConfig)
     anti_entropy: AntiEntropyConfig = field(default_factory=AntiEntropyConfig)
@@ -213,7 +256,7 @@ class Config:
     def _apply_dict(self, data: dict) -> None:
         for key, value in data.items():
             attr = key.replace("-", "_")
-            if attr in ("tls", "query", "cluster", "storage", "anti_entropy", "metric", "diagnostics", "tracing", "mesh", "gossip") and isinstance(value, dict):
+            if attr in ("tls", "query", "slo", "cluster", "storage", "anti_entropy", "metric", "diagnostics", "tracing", "mesh", "gossip") and isinstance(value, dict):
                 sub = getattr(self, attr)
                 for k, v in value.items():
                     sk = k.replace("-", "_")
@@ -235,7 +278,7 @@ class Config:
 
     def _set_path(self, parts: list[str], raw: str) -> None:
         # try sub-config first (cluster_replicas -> cluster.replicas)
-        for sub_name in ("tls", "query", "cluster", "storage", "anti_entropy", "metric", "diagnostics", "tracing", "mesh", "gossip"):
+        for sub_name in ("tls", "query", "slo", "cluster", "storage", "anti_entropy", "metric", "diagnostics", "tracing", "mesh", "gossip"):
             sub_parts = sub_name.split("_")
             if parts[: len(sub_parts)] == sub_parts and len(parts) > len(sub_parts):
                 sub = getattr(self, sub_name)
@@ -275,6 +318,18 @@ class Config:
             f'plan = "{self.query.plan}"',
             f"plan-cache-bytes = {self.query.plan_cache_bytes}",
             "",
+            "[slo]",
+            f"read-latency-ms = {self.slo.read_latency_ms}",
+            f"count-latency-ms = {self.slo.count_latency_ms}",
+            f"topn-latency-ms = {self.slo.topn_latency_ms}",
+            f"groupby-latency-ms = {self.slo.groupby_latency_ms}",
+            f"latency-target = {self.slo.latency_target}",
+            f"availability-target = {self.slo.availability_target}",
+            f"burn-yellow = {self.slo.burn_yellow}",
+            f"burn-red = {self.slo.burn_red}",
+            f"window-short = {self.slo.window_short}",
+            f"window-long = {self.slo.window_long}",
+            "",
             "[storage]",
             f'wal-fsync = "{self.storage.wal_fsync}"',
             "",
@@ -290,6 +345,13 @@ class Config:
             f"poll-interval = {self.metric.poll_interval}",
             f"telemetry-interval = {self.metric.telemetry_interval}",
             f"telemetry-ring = {self.metric.telemetry_ring}",
+            f"usage-max-principals = {self.metric.usage_max_principals}",
+            f"usage-ring = {self.metric.usage_ring}",
+            f'trace-export = "{self.metric.trace_export}"',
+            f'trace-export-path = "{self.metric.trace_export_path}"',
+            f'trace-export-endpoint = "{self.metric.trace_export_endpoint}"',
+            f'trace-export-format = "{self.metric.trace_export_format}"',
+            f"trace-export-sample = {self.metric.trace_export_sample}",
             "",
             "[diagnostics]",
             f'url = "{self.diagnostics.url}"',

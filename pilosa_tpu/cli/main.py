@@ -161,6 +161,23 @@ def cmd_server(args) -> int:
         metric_poll_interval=cfg.metric.poll_interval,
         telemetry_interval=cfg.metric.telemetry_interval,
         telemetry_ring=cfg.metric.telemetry_ring,
+        usage_max_principals=cfg.metric.usage_max_principals,
+        usage_ring=cfg.metric.usage_ring,
+        trace_export=cfg.metric.trace_export,
+        trace_export_path=cfg.metric.trace_export_path,
+        trace_export_endpoint=cfg.metric.trace_export_endpoint,
+        trace_export_format=cfg.metric.trace_export_format,
+        trace_export_sample=cfg.metric.trace_export_sample,
+        slo_read_latency_ms=cfg.slo.read_latency_ms,
+        slo_count_latency_ms=cfg.slo.count_latency_ms,
+        slo_topn_latency_ms=cfg.slo.topn_latency_ms,
+        slo_groupby_latency_ms=cfg.slo.groupby_latency_ms,
+        slo_latency_target=cfg.slo.latency_target,
+        slo_availability_target=cfg.slo.availability_target,
+        slo_burn_yellow=cfg.slo.burn_yellow,
+        slo_burn_red=cfg.slo.burn_red,
+        slo_window_short=cfg.slo.window_short,
+        slo_window_long=cfg.slo.window_long,
         log_format=cfg.log_format,
         diagnostics_url=cfg.diagnostics.url,
         diagnostics_interval=cfg.diagnostics.interval,

@@ -526,7 +526,10 @@ class Executor:
         result instead of recomputing it. On a miss the composed result is
         inserted under the planner's canonical key; a write under the
         subtree changes the key on the next lookup (free invalidation)."""
+        import time as _time
+
         from pilosa_tpu import planner as _planner
+        from pilosa_tpu.utils import accounting
         key = None
         pc = self.plan_cache
         if (pc is not None and pc.enabled
@@ -540,8 +543,15 @@ class Executor:
             _planner.record_cache_event(call, hit is not None)
             if hit is not None:
                 return hit
+        acct = accounting.current_account.get()
+        t0 = _time.perf_counter() if acct is not None else 0.0
         program, leaves = self._compile(index, call, shards)
         dev = self.runner.row_leaves_dev(leaves, program)
+        if acct is not None:
+            # the composed-subtree evaluation is per-query device work the
+            # batchers never see — charged as wall time of the compile +
+            # dispatch (the attribution available without a device sync)
+            acct.charge(device_ms=(_time.perf_counter() - t0) * 1e3)
         if key is not None:
             pc.put(key, dev, dev.nbytes, epoch=epoch)
         return dev
@@ -622,10 +632,14 @@ class Executor:
             plan["actualCardinality"] = int(count)
 
     def _count_device(self, index: Index, child: Call, shards) -> int:
+        import time as _time
+
+        from pilosa_tpu.utils import accounting
         program, leaves = self._compile(index, child, shards)
         if self.batcher is not None:
             # concurrent Counts coalesce into one device dispatch
-            # (continuous batching — parallel/batcher.py)
+            # (continuous batching — parallel/batcher.py; the batcher's
+            # _run charges each co-batched query its wall-time share)
             if program == ("leaf", 0) and len(leaves) == 1:
                 return self.batcher.count("id", leaves[0], None)
             if (len(leaves) == 2 and isinstance(program, tuple)
@@ -635,6 +649,9 @@ class Executor:
                     and program[2] == ("leaf", 1)
                     and leaves[0].shape == leaves[1].shape):
                 return self.batcher.count(program[0], leaves[0], leaves[1])
+        # un-batched dispatches are this query's alone: charge full wall
+        acct = accounting.current_account.get()
+        t0 = _time.perf_counter() if acct is not None else 0.0
         if (isinstance(program, tuple) and len(program) > 3
                 and program[0] == "and"
                 and all(p == ("leaf", i) for i, p in enumerate(program[1:]))
@@ -645,8 +662,12 @@ class Executor:
             # arity, so cardinality-reordered chains of the same width
             # share a compilation (ops/bitvector.py)
             from pilosa_tpu.ops.bitvector import intersect_chain_count_total
-            return int(intersect_chain_count_total(tuple(leaves)))
-        return self.runner.count_total_leaves(leaves, program)
+            n = int(intersect_chain_count_total(tuple(leaves)))
+        else:
+            n = self.runner.count_total_leaves(leaves, program)
+        if acct is not None:
+            acct.charge(device_ms=(_time.perf_counter() - t0) * 1e3)
+        return n
 
     # ------------------------------------------------- leaf materialization
 

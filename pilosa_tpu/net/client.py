@@ -17,7 +17,7 @@ import threading
 import urllib.parse
 from typing import Optional
 
-from pilosa_tpu.utils import failpoints, qctx, tracing
+from pilosa_tpu.utils import accounting, failpoints, qctx, tracing
 from pilosa_tpu.utils import profile as qprofile
 
 
@@ -56,6 +56,12 @@ class InternalClient:
         trace_id = tracing.current_trace_id.get()
         if trace_id:  # InjectHTTPHeaders (tracing/tracing.go:22)
             headers[tracing.TRACE_HEADER] = trace_id
+        acct = accounting.current_account.get()
+        if acct is not None:
+            # internal RPCs inherit the coordinator's principal exactly
+            # how the trace id propagates: remote work is charged to the
+            # original caller, not to this node (utils/accounting.py)
+            headers[accounting.PRINCIPAL_HEADER] = acct.principal
         sock_timeout = timeout if timeout is not None else self.timeout
         rem = qctx.remaining()
         if rem is not None:
@@ -198,6 +204,11 @@ class InternalClient:
                                       profile=prof is not None)
         out = self._request("POST", uri, f"/index/{index}/query", body,
                             CONTENT_TYPE, accept=CONTENT_TYPE)
+        acct = accounting.current_account.get()
+        if acct is not None:
+            # per-principal RPC bytes for the per-query fan-out path (the
+            # coalesced path charges per envelope entry in NodeCoalescer)
+            acct.charge(rpc_bytes=len(body) + len(out))
         resp = s.decode_query_response(out)
         if resp["err"]:
             raise ClientError(f"remote query: {resp['err']}")
@@ -330,6 +341,13 @@ class InternalClient:
         Peers that predate the route raise ClientError(status=404) — the
         federation degrades them to "legacy", never an error."""
         out = self._request("GET", uri, "/internal/stats", timeout=timeout)
+        return json.loads(out) if out else {}
+
+    def debug_usage(self, uri: str, timeout: Optional[float] = None) -> dict:
+        """One peer's usage-ledger document (GET /debug/usage) for the
+        /cluster/usage federation. Same legacy contract as node_stats:
+        a peer predating the route 404s and the caller degrades it."""
+        out = self._request("GET", uri, "/debug/usage", timeout=timeout)
         return json.loads(out) if out else {}
 
     def translate_keys(self, uri: str, index: str, field: Optional[str],

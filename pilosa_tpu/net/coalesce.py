@@ -40,7 +40,7 @@ from typing import Optional
 
 from pilosa_tpu.net.client import ClientError
 from pilosa_tpu.parallel.batcher import ContinuousBatcher
-from pilosa_tpu.utils import qctx, tracing
+from pilosa_tpu.utils import accounting, qctx, tracing
 from pilosa_tpu.utils import profile as qprofile
 
 # per-waiter sentinel: the destination 404'd the batch route; re-issue
@@ -59,6 +59,11 @@ class NodeCoalescer(ContinuousBatcher):
     batch at `max_batch`, and hands leadership off BEFORE the blocking
     HTTP send so the next envelope's admission overlaps this one's round
     trip."""
+
+    # the envelope's wall time is NETWORK time, not device time: waiters
+    # charge their per-entry RPC bytes instead (see query()); only the
+    # queue-wait share of the base-class accounting hook applies here
+    ACCOUNT_DEVICE_MS = False
 
     def __init__(self, client, window_s: float = 0.002, max_batch: int = 64,
                  legacy_ttl: float = 300.0, max_inflight: int = 2):
@@ -99,9 +104,12 @@ class NodeCoalescer(ContinuousBatcher):
             return self.client.query_proto(uri, index, pql, shards=shards,
                                            remote=True)
         prof = qprofile.current_profile.get()
+        acct = accounting.current_account.get()
         out = self.submit((uri,), (index, pql, shards, rem,
                                    tracing.current_trace_id.get(),
-                                   prof is not None))
+                                   prof is not None,
+                                   acct.principal if acct is not None
+                                   else None))
         if out is _FALLBACK:
             with self._meta_lock:
                 self.fallback_queries += 1
@@ -109,11 +117,17 @@ class NodeCoalescer(ContinuousBatcher):
                                            remote=True)
         if isinstance(out, ClientError):
             raise out  # per-entry remote error (QueryResponse.Err)
-        results, fragment = out
+        results, fragment, nbytes = out
         if prof is not None and fragment:
             # grafted on the WAITER's thread, not the envelope leader's:
             # the leader serves strangers whose profiles it must not touch
             prof.add_remote_fragment(uri, fragment)
+        if acct is not None and nbytes:
+            # charged per WAITER like the profile graft: the envelope is
+            # the leader's RPC, but each entry's response bytes belong to
+            # the caller whose query rode it (deduped dups each charge
+            # the shared entry's size — they each consumed the result)
+            acct.charge(rpc_bytes=nbytes)
         return results
 
     # -- in-flight window -------------------------------------------------
@@ -157,7 +171,7 @@ class NodeCoalescer(ContinuousBatcher):
         slots: list[int] = []
         uniq: dict[tuple, int] = {}
         entries: list[dict] = []
-        for (i, q, s, rem, trace_id, want_prof) in payloads:
+        for (i, q, s, rem, trace_id, want_prof, principal) in payloads:
             k = (i, q, tuple(s) if s is not None else None)
             at = uniq.get(k)
             if at is None:
@@ -172,6 +186,10 @@ class NodeCoalescer(ContinuousBatcher):
                      # followers share the FIRST caller's id (one remote
                      # execution can only belong to one trace).
                      **({"traceId": trace_id} if trace_id else {}),
+                     # per-entry principal (same inheritance rule as the
+                     # trace id): the remote charges this entry's work to
+                     # the ORIGINAL caller, not to the envelope leader
+                     **({"principal": principal} if principal else {}),
                      **({"profile": True} if want_prof else {})})
             else:
                 if rem is not None and "timeout" in entries[at]:
@@ -241,10 +259,13 @@ class NodeCoalescer(ContinuousBatcher):
             if resp["err"]:
                 out.append(ClientError(f"remote query: {resp['err']}"))
             else:
-                # (results, profile fragment) — query() unpacks on the
-                # waiter's own thread and grafts the fragment onto the
-                # waiter's profile (None/absent for legacy peers)
-                out.append((resp["results"], resp.get("profile")))
+                # (results, profile fragment, wire bytes) — query()
+                # unpacks on the waiter's own thread, grafts the fragment
+                # onto the waiter's profile (None/absent for legacy
+                # peers) and charges the entry's response bytes to the
+                # waiter's principal
+                out.append((resp["results"], resp.get("profile"),
+                            len(raw[at])))
         return out
 
     # -- legacy (mixed-version) tracking ----------------------------------

@@ -81,6 +81,17 @@ a { color: var(--series); }
 <div class="sub" id="meta">loading&hellip;</div>
 <div id="err"></div>
 
+<h2>SLO burn</h2>
+<div class="tiles" id="slo"></div>
+
+<h2>Top principals (usage)</h2>
+<table id="usage"><thead><tr>
+  <th>principal</th><th class="num">device ms</th><th class="num">HBM moved</th>
+  <th class="num">RPC bytes</th><th class="num">queue ms</th>
+  <th class="num">queries</th><th class="num">errors</th>
+  <th class="num">cache hits</th>
+</tr></thead><tbody></tbody></table>
+
 <h2>Fleet</h2>
 <table id="fleet"><thead><tr>
   <th>health</th><th>node</th><th>state</th><th class="num">uptime</th>
@@ -106,6 +117,9 @@ const LOCAL_SERIES = [
   ["residency.evictions_per_s", "evictions / s", fmtNum],
   ["batcher.queue_depth", "batcher queue depth", fmtNum],
   ["batcher.avg_wait_ms", "batch wait ms (window)", fmtNum],
+  ["plancache.hit_rate", "plan-cache hit rate (window)", fmtRatio],
+  ["planner.reorders_per_s", "planner reorders / s", fmtNum],
+  ["usage.queries_per_s", "accounted queries / s", fmtNum],
   ["fanout.queued", "fan-out queued", fmtNum],
   ["xla.compiles_per_s", "XLA compiles / s", fmtNum],
   ["wal.bytes", "storage+WAL bytes", fmtBytes],
@@ -249,6 +263,54 @@ function renderLocal() {
   }
 }
 
+// per-principal usage table + SLO burn tiles (GET /debug/usage: this
+// node's ledger, the burn-rate evaluation riding along)
+function renderUsage(doc) {
+  const body = document.querySelector("#usage tbody");
+  body.textContent = "";
+  const entries = Object.entries(doc.principals || {}).slice(0, 12);
+  for (const [name, e] of entries) {
+    const tr = document.createElement("tr");
+    tr.appendChild(td(name));
+    tr.appendChild(td(fmtNum(e.deviceMs), true));
+    tr.appendChild(td(fmtBytes(e.hbmBytes), true));
+    tr.appendChild(td(fmtBytes(e.rpcBytes), true));
+    tr.appendChild(td(fmtNum(e.queueMs), true));
+    tr.appendChild(td(fmtNum(e.queries), true));
+    tr.appendChild(td(fmtNum(e.errors), true));
+    tr.appendChild(td(fmtNum(e.planCacheHits), true));
+    body.appendChild(tr);
+  }
+  if (!entries.length) {
+    const tr = document.createElement("tr");
+    tr.appendChild(td("no accounted traffic yet"));
+    body.appendChild(tr);
+  }
+  const root = document.getElementById("slo");
+  root.textContent = "";
+  for (const [name, ob] of Object.entries(doc.slo || {})) {
+    const tile = document.createElement("div");
+    tile.className = "tile";
+    const nm = document.createElement("div");
+    nm.className = "name";
+    nm.textContent = name + " (target " + (100 * ob.target).toFixed(2) +
+      "%" + (ob.latencyMs ? " < " + ob.latencyMs + " ms" : "") + ")";
+    const val = document.createElement("div");
+    val.className = "val health health-" + ob.status;
+    const dot = document.createElement("span");
+    dot.className = "dot";
+    val.appendChild(dot);
+    val.appendChild(document.createTextNode(
+      ob.status + " · burn " + fmtNum(ob.burnShort) + "x (5m) / " +
+      fmtNum(ob.burnLong) + "x (1h)"));
+    tile.appendChild(nm); tile.appendChild(val);
+    root.appendChild(tile);
+  }
+  if (!root.children.length) {
+    root.textContent = "no [slo] objectives configured";
+  }
+}
+
 async function refresh() {
   const err = document.getElementById("err");
   try {
@@ -258,6 +320,8 @@ async function refresh() {
     for (const s of (ts.samples || [])) localSamples.push(s);
     while (localSamples.length > localLimit) localSamples.shift();
     renderLocal();
+    const us = await (await fetch("/debug/usage?top=12")).json();
+    renderUsage(us);
     const cs = await (await fetch("/cluster/stats")).json();
     renderFleet(cs);
     err.textContent = "";

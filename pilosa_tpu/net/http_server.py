@@ -18,7 +18,7 @@ from pilosa_tpu.api import API, ApiError
 from pilosa_tpu.encoding.protobuf import CONTENT_TYPE as PROTO_CONTENT_TYPE
 from pilosa_tpu.encoding.protobuf import Serializer
 from pilosa_tpu.models.field import FieldOptions
-from pilosa_tpu.utils import qctx, tracing
+from pilosa_tpu.utils import accounting, qctx, tracing
 
 # (method, regex) -> handler name; ordered
 ROUTES: list[tuple[str, re.Pattern, str]] = [
@@ -42,7 +42,9 @@ ROUTES: list[tuple[str, re.Pattern, str]] = [
     ("GET", re.compile(r"^/status$"), "get_status"),
     ("GET", re.compile(r"^/version$"), "get_version"),
     ("GET", re.compile(r"^/cluster/stats$"), "get_cluster_stats"),
+    ("GET", re.compile(r"^/cluster/usage$"), "get_cluster_usage"),
     ("GET", re.compile(r"^/debug/vars$"), "get_debug_vars"),
+    ("GET", re.compile(r"^/debug/usage$"), "get_debug_usage"),
     ("GET", re.compile(r"^/debug/query-history$"), "get_query_history"),
     ("GET", re.compile(r"^/debug/timeseries$"), "get_debug_timeseries"),
     ("GET", re.compile(r"^/debug/dashboard$"), "get_debug_dashboard"),
@@ -86,6 +88,7 @@ ALLOWED_QUERY_ARGS: dict[str, frozenset] = {
     "get_translate_data": frozenset({"offset"}),
     "get_debug_pprof": frozenset({"seconds"}),
     "get_debug_timeseries": frozenset({"since", "limit"}),
+    "get_debug_usage": frozenset({"since", "limit", "top"}),
 }
 
 
@@ -141,13 +144,27 @@ class Handler:
         return qctx.deadline.set(time.monotonic() + min(candidates))
 
     def dispatch(self, method: str, path: str, query: dict, body: bytes,
-                 headers=None):
+                 headers=None, client_addr=None):
         """-> (status, content_type, payload bytes)."""
         self._local.headers = headers
         # extractTracing middleware (http/handler.go:226-234): adopt the
         # caller's trace id for every span opened while serving this request
         incoming_trace = (headers or {}).get(tracing.TRACE_HEADER) if headers else None
         token = tracing.current_trace_id.set(incoming_trace) if incoming_trace else None
+        # accounting middleware (utils/accounting.py): install the
+        # caller's Account so every charge site in the stack attributes
+        # this request's device-ms/HBM/RPC spend to its principal —
+        # X-API-Key / Authorization (digested) / remote addr, or the
+        # X-Pilosa-Principal header an internal fan-out RPC inherited
+        # from its coordinator. One contextvar set; charge sites are nop
+        # when accounting is off.
+        acct_token = None
+        ledger = getattr(self.api, "usage_ledger", None)
+        if ledger is not None and ledger.enabled and accounting.enabled():
+            acct_token = accounting.current_account.set(
+                accounting.Account(
+                    ledger,
+                    accounting.principal_from_headers(headers, client_addr)))
         try:
             for m, rx, name in ROUTES:
                 if m != method:
@@ -189,6 +206,8 @@ class Handler:
         finally:
             if token is not None:
                 tracing.current_trace_id.reset(token)
+            if acct_token is not None:
+                accounting.current_account.reset(acct_token)
         if any(rx.match(path) for _, rx, _ in ROUTES):
             return 405, "application/json", b'{"error": "method not allowed"}'
         return 404, "application/json", b'{"error": "not found"}'
@@ -464,6 +483,15 @@ class Handler:
         fps = failpoints.snapshot()
         if fps["points"] or fps["armed"]:
             snap["failpoints"] = fps
+        # per-principal usage ledger + SLO burn rates (the /debug/usage
+        # document's totals/top rows, mirrored here so the expvar dump
+        # stays the one-stop snapshot)
+        ledger = getattr(self.api, "usage_ledger", None)
+        if ledger is not None:
+            snap["usage"] = ledger.snapshot(top=20)
+        slo = getattr(self.api, "slo", None)
+        if slo is not None:
+            snap["slo"] = slo.evaluate()
         return self._json(snap)
 
     def get_query_history(self, params, query, body):
@@ -502,6 +530,37 @@ class Handler:
         air-gapped from any node's port."""
         from pilosa_tpu.net.dashboard import render_dashboard
         return 200, "text/html; charset=utf-8", render_dashboard().encode()
+
+    def get_debug_usage(self, params, query, body):
+        """Per-principal usage ledger (utils/accounting.py): aggregates
+        sorted by device-ms (`?top=` bounds the list), exact totals, the
+        since-cursor delta ring (`?since=` — the /debug/timeseries
+        contract, each tick transfers once), and the current SLO
+        burn-rate evaluation."""
+        ledger = getattr(self.api, "usage_ledger", None)
+        if ledger is None:
+            raise ApiError("usage accounting not supported", status=501)
+        try:
+            since = int(self._arg(query, "since", "0"))
+            limit = int(self._arg(query, "limit", "0"))
+            top = int(self._arg(query, "top", "0"))
+        except ValueError:
+            raise ApiError("since, limit and top must be integers")
+        out = ledger.snapshot(top=top)
+        out.update(ledger.since(since, limit))
+        out["enabled"] = ledger.enabled and accounting.enabled()
+        slo = getattr(self.api, "slo", None)
+        if slo is not None:
+            out["slo"] = slo.evaluate()
+        return self._json(out)
+
+    def get_cluster_usage(self, params, query, body):
+        """The fleet's merged per-principal usage: every live peer's
+        ledger collected and summed per principal (Server.cluster_usage —
+        legacy peers that 404 the route degrade, never an error)."""
+        if self.api.cluster_usage_fn is None:
+            raise ApiError("cluster usage not supported", status=501)
+        return self._json(self.api.cluster_usage_fn())
 
     def get_internal_stats(self, params, query, body):
         """This node's fleet-telemetry document (fanned over by a peer's
@@ -605,6 +664,37 @@ class Handler:
             counts[f"xlaCompiles/{fam}"] = f["compiles"]
             counts[f"xlaCachedDispatches/{fam}"] = f["cached"]
         counts["xlaRecompileStorms"] = xs["storms"]
+        # per-principal usage + SLO burn-rate families: emitted
+        # unconditionally (zeros included) like the planner families, so
+        # scrapers can alert on "a principal's spend spiked" / "an SLO is
+        # burning" without a first-event race in the family's existence
+        ledger = getattr(self.api, "usage_ledger", None)
+        if ledger is not None:
+            us = ledger.snapshot()
+            for f, v in us["totals"].items():
+                counts[f"usage/{f}"] = round(v, 3)
+            gauges["usage/trackedPrincipals"] = us["trackedPrincipals"]
+            gauges["usage/spilledPrincipals"] = us["spilledPrincipals"]
+            # per-principal series ride `principal` labels on the same
+            # family; the scrape stays bounded by the ledger's own top-K
+            # bound plus this explicit cap
+            for i, (p, e) in enumerate(us["principals"].items()):
+                if i >= 20:
+                    break
+                for f in ("deviceMs", "hbmBytes", "rpcBytes", "queueMs",
+                          "queries", "errors"):
+                    counts[f"usage/{f},principal:{p}"] = round(e[f], 3)
+        slo = getattr(self.api, "slo", None)
+        if slo is not None:
+            worst = 0.0
+            for name, ob in slo.evaluate().items():
+                gauges[f"slo/burnShort,objective:{name}"] = ob["burnShort"]
+                gauges[f"slo/burnLong,objective:{name}"] = ob["burnLong"]
+                level = {"green": 0.0, "yellow": 1.0,
+                         "red": 2.0}[ob["status"]]
+                gauges[f"slo/status,objective:{name}"] = level
+                worst = max(worst, level)
+            gauges["slo/worst"] = worst
         if self.api.health_fn is not None:
             try:
                 score = self.api.health_fn()["score"]
@@ -799,7 +889,7 @@ class _RequestHandler(BaseHTTPRequestHandler):
         body = self.rfile.read(length) if length else b""
         status, ctype, payload = self.handler.dispatch(
             method, parsed.path, parse_qs(parsed.query), body,
-            headers=self.headers)
+            headers=self.headers, client_addr=self.client_address[0])
         self.send_response(status)
         self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(payload)))

@@ -53,6 +53,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from pilosa_tpu.ops.bitvector import popcount
+from pilosa_tpu.utils import accounting
 from pilosa_tpu.utils import profile as qprofile
 from pilosa_tpu.utils.telemetry import counted_jit
 
@@ -93,7 +94,7 @@ def _pow2(n: int) -> int:
 
 class _Req:
     __slots__ = ("payload", "event", "result", "exc", "promoted", "done",
-                 "server", "profile", "t_submit")
+                 "server", "profile", "account", "t_submit")
 
     def __init__(self, payload):
         self.payload = payload
@@ -108,6 +109,10 @@ class _Req:
         # attribution must be recorded against the SUBMITTER — the batch
         # is served on a leader thread belonging to a different query
         self.profile = qprofile.current_profile.get()
+        # likewise the submitter's usage account (utils/accounting.py):
+        # the dispatch share is charged to whoever submitted the query,
+        # not to the stranger whose thread led the batch
+        self.account = accounting.current_account.get()
         self.server: Optional[threading.Thread] = None  # thread serving the
         # batch this request was popped into (set at the cut; liveness
         # checks must consult it, not the leadership slot — leadership
@@ -117,6 +122,13 @@ class _Req:
 
 class ContinuousBatcher:
     """Leadership/queue machinery; subclasses implement _compute."""
+
+    # whether a dispatch's wall-time share is DEVICE time for accounting:
+    # True for the device batchers; NodeCoalescer overrides to False (its
+    # "dispatch" is an HTTP envelope — the waiters charge RPC bytes
+    # instead, and double-charging network wall as device-ms would break
+    # the per-principal device attribution admission control acts on)
+    ACCOUNT_DEVICE_MS = True
 
     def __init__(self, max_batch: int = MAX_BATCH):
         self.max_batch = max_batch
@@ -281,20 +293,31 @@ class ContinuousBatcher:
                     (t_done - r.t_submit) * 1e3 for r in batch)
                 self.waited += len(batch)
                 seq = self.batches
-            if t_cut is not None and any(r.profile is not None
-                                         for r in batch):
-                # dispatch attribution: every profiled co-batched query
-                # learns which dispatch served it, the batch size it
-                # shared, and its wall-time share (utils/profile.py) —
-                # NodeCoalescer envelopes ride this same hook, so the
-                # envelope coalesce factor is the batchSize of a
-                # "NodeCoalescer" dispatch record
-                wall_ms = (time.perf_counter() - t_cut) * 1e3
+            if t_cut is not None:
+                wall_ms = (t_done - t_cut) * 1e3
+                share_ms = wall_ms / max(1, len(batch))
                 kind = type(self).__name__
                 for r in batch:
+                    # dispatch attribution: every profiled co-batched
+                    # query learns which dispatch served it, the batch
+                    # size it shared, and its wall-time share
+                    # (utils/profile.py) — NodeCoalescer envelopes ride
+                    # this same hook, so the envelope coalesce factor is
+                    # the batchSize of a "NodeCoalescer" dispatch record
                     if r.profile is not None:
                         r.profile.record_dispatch(kind, seq, len(batch),
                                                   wall_ms)
+                    # usage attribution rides the identical share
+                    # convention (a query cannot be charged less than its
+                    # seat): device-ms = wall share, queue-wait = time
+                    # from submit to delivery minus the dispatch itself
+                    if r.account is not None:
+                        r.account.charge(
+                            device_ms=share_ms if self.ACCOUNT_DEVICE_MS
+                            else 0.0,
+                            queue_ms=max(
+                                0.0,
+                                (t_done - r.t_submit) * 1e3 - wall_ms))
             for r, res in zip(batch, results):
                 r.result = res
                 r.done = True

@@ -24,6 +24,7 @@ from typing import Callable, Optional
 import jax
 import numpy as np
 
+from pilosa_tpu.utils import accounting
 from pilosa_tpu.utils import profile as qprofile
 
 DEFAULT_BUDGET_BYTES = 4 << 30  # half a v5e chip's HBM
@@ -74,6 +75,12 @@ class DeviceResidency:
             # composed on device (bsicmp results) costs no link transfer
             prof.record_residency(hit=False,
                                   nbytes=arr.nbytes if uploaded else 0)
+        if uploaded:
+            # same only-real-uploads rule for per-principal accounting:
+            # the HBM bytes a caller moved over the host->device link
+            acct = accounting.current_account.get()
+            if acct is not None:
+                acct.charge(hbm_bytes=arr.nbytes)
         with self._lock:
             self.misses += 1
             if self.epoch != epoch:
@@ -165,7 +172,15 @@ class PlanCache:
                 return None
             self._lru.move_to_end(key)
             self.hits += 1
-            return entry[0]
+            value = entry[0]
+        # per-principal hit accounting OUTSIDE the LRU lock (the hit path
+        # is hot and the ledger has its own lock): a hit is work the
+        # caller reused instead of spending — the signal quota pricing
+        # needs to avoid charging a dashboard for its neighbors' warmup
+        acct = accounting.current_account.get()
+        if acct is not None:
+            acct.charge(plan_cache_hits=1)
+        return value
 
     def put(self, key: tuple, value, nbytes: int, epoch: int = None) -> None:
         """Insert `value` (device array or int). `epoch`, when given, is

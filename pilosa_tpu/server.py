@@ -80,7 +80,24 @@ class Server:
                  telemetry_ring: int = 720,
                  log_format: str = "plain",
                  plan: str = "on",
-                 plan_cache_bytes: int = 256 << 20):
+                 plan_cache_bytes: int = 256 << 20,
+                 usage_max_principals: int = 256,
+                 usage_ring: int = 360,
+                 slo_read_latency_ms: float = 0.0,
+                 slo_count_latency_ms: float = 0.0,
+                 slo_topn_latency_ms: float = 0.0,
+                 slo_groupby_latency_ms: float = 0.0,
+                 slo_latency_target: float = 0.99,
+                 slo_availability_target: float = 0.999,
+                 slo_burn_yellow: float = 6.0,
+                 slo_burn_red: float = 14.4,
+                 slo_window_short: float = 300.0,
+                 slo_window_long: float = 3600.0,
+                 trace_export: str = "off",
+                 trace_export_path: str = "",
+                 trace_export_endpoint: str = "",
+                 trace_export_format: str = "jaeger",
+                 trace_export_sample: float = 1.0):
         self.data_dir = data_dir
         # [storage] wal-fsync, plumbed down the model tree to every
         # Fragment (PILOSA_TPU_WAL_FSYNC env overrides per fragment —
@@ -171,6 +188,58 @@ class Server:
         self.api.profile_mode = profile_mode
         from pilosa_tpu.utils.profile import QueryHistory
         self.api.query_history = QueryHistory(query_history_size)
+        # per-principal resource accounting (utils/accounting.py): the
+        # bounded usage ledger every charge site in the stack attributes
+        # into ([metric] usage-max-principals / usage-ring knobs;
+        # PILOSA_TPU_ACCOUNTING=0 kill switch read per request), plus the
+        # [slo] objectives evaluated with multi-window burn-rate math.
+        from pilosa_tpu.utils import accounting as _accounting
+        self.usage = _accounting.UsageLedger(
+            max_principals=usage_max_principals, ring_size=usage_ring)
+        self.api.usage_ledger = self.usage
+        objectives = []
+        if slo_availability_target > 0:
+            objectives.append(_accounting.Objective(
+                "availability", None, None, slo_availability_target))
+        for cls, ms in (("read", slo_read_latency_ms),
+                        ("count", slo_count_latency_ms),
+                        ("topn", slo_topn_latency_ms),
+                        ("groupby", slo_groupby_latency_ms)):
+            if ms > 0:
+                objectives.append(_accounting.Objective(
+                    f"{cls}-latency", cls, ms, slo_latency_target))
+        self.slo = _accounting.SLOTracker(
+            objectives, short_window=slo_window_short,
+            long_window=slo_window_long, burn_yellow=slo_burn_yellow,
+            burn_red=slo_burn_red)
+        self.api.slo = self.slo
+        # external trace export ([metric] trace-export = off|file|http):
+        # finished cross-node profile trees — and, when no [tracing]
+        # endpoint claimed the recording tracer, its finished spans too —
+        # ship as Jaeger/OTLP-JSON batches to a spool file or collector.
+        # PILOSA_TPU_TRACE_EXPORT=0 is the kill switch (read per batch).
+        if trace_export not in ("off", "file", "http"):
+            raise ValueError(
+                f"invalid [metric] trace-export {trace_export!r} "
+                "(expected off | file | http)")
+        self.trace_exporter = None
+        if trace_export != "off":
+            from pilosa_tpu.utils.tracing import TraceExporter
+            spool = trace_export_path or os.path.join(
+                data_dir, "trace-spool.jsonl")
+            self.trace_exporter = TraceExporter(
+                mode=trace_export, path=spool,
+                endpoint=trace_export_endpoint, fmt=trace_export_format,
+                sample=trace_export_sample)
+            self.api.trace_exporter = self.trace_exporter
+            if exporter is None:
+                # the recording tracer ships its spans through the same
+                # egress; sampling follows trace-export-sample unless the
+                # operator configured an explicit [tracing] sampler
+                self.tracer.exporter = self.trace_exporter
+                if tracing_sampler_type == "off":
+                    self.tracer.sampler_type = "probabilistic"
+                    self.tracer.sampler_param = trace_export_sample
         # fleet telemetry (utils/telemetry.py): background sampler ->
         # bounded ring served at GET /debug/timeseries; [metric]
         # telemetry-interval / telemetry-ring knobs, PILOSA_TPU_TELEMETRY=0
@@ -190,6 +259,7 @@ class Server:
         self.api.health_fn = self.node_health
         self.api.node_stats_fn = self.node_stats
         self.api.cluster_stats_fn = self.cluster_stats
+        self.api.cluster_usage_fn = self.cluster_usage
         self.handler = Handler(self.api, cluster_message_fn=self.receive_message,
                                stats=self.stats, query_timeout=query_timeout,
                                telemetry=self.telemetry)
@@ -781,6 +851,9 @@ class Server:
         self.diagnostics.close()
         if self.tracer.exporter is not None:
             self.tracer.exporter.close()  # final flush
+        if self.trace_exporter is not None:
+            self.trace_exporter.close()  # idempotent when it IS the
+            # tracer's exporter (TraceExporter.close guards re-entry)
         self.http.close()
         self.holder.close()
         self.translate.close()
@@ -1407,6 +1480,27 @@ class Server:
             raw["planner.reorders"] = ps["reorders"]
             raw["planner.pushdowns"] = ps["pushdowns"]
             raw["planner.short_circuits"] = ps["shortCircuits"]
+        # per-principal usage ledger: tick its delta ring (the
+        # /debug/usage since-cursor feed rides the sampler's clock) and
+        # sample fleet-level gauges; SLO burn rates per objective
+        usage = getattr(self.api, "usage_ledger", None)
+        if usage is not None:
+            usage.sample_tick()
+            ut = usage.totals()
+            g["usage.tracked_principals"] = float(
+                usage.snapshot(top=1)["trackedPrincipals"])
+            raw["usage.queries"] = ut["queries"]
+            raw["usage.device_ms"] = ut["deviceMs"]
+            raw["usage.rpc_bytes"] = ut["rpcBytes"]
+        slo = getattr(self.api, "slo", None)
+        if slo is not None:
+            worst = 0.0
+            for name, ob in slo.evaluate().items():
+                g[f"slo.{name}.burn_short"] = ob["burnShort"]
+                g[f"slo.{name}.burn_long"] = ob["burnLong"]
+                worst = max(worst, {"green": 0.0, "yellow": 1.0,
+                                    "red": 2.0}[ob["status"]])
+            g["slo.worst"] = worst
         depth = 0
         for attr in ("batcher", "sum_batcher", "minmax_batcher"):
             b = getattr(ex, attr, None)
@@ -1511,6 +1605,9 @@ class Server:
         g["hedges.fired_per_s"] = rate("hedges.fired")
         g["http.errors_per_s"] = rate("http.errors")
         g["xla.compiles_per_s"] = rate("xla.compiles")
+        g["usage.queries_per_s"] = rate("usage.queries")
+        g["usage.device_ms_per_s"] = rate("usage.device_ms")
+        g["usage.rpc_bytes_per_s"] = rate("usage.rpc_bytes")
         self._telemetry_prev = (raw, now)
         return g
 
@@ -1536,7 +1633,7 @@ class Server:
             needs_rebuild = sum(1 for d in damaged if d["needsRebuild"])
             n_damaged = len(damaged)
         ps = self.executor.fanout_pool_stats()
-        return {
+        out = {
             "walPoisoned": poisoned,
             "needsRebuild": needs_rebuild,
             "damagedFragments": n_damaged,
@@ -1544,6 +1641,16 @@ class Server:
             "queueSaturation": ps["queued"] / max(1, ps["size"]),
             "recompileStormActive": _telemetry.xla.storm_active(),
         }
+        slo = getattr(self.api, "slo", None)
+        if slo is not None:
+            # an SLO burning its error budget makes the node yellow/red
+            # on /status and in the federation — the same single health
+            # definition load balancers act on
+            status, reason = slo.worst()
+            if status != "green":
+                out["sloStatus"] = status
+                out["sloReason"] = reason
+        return out
 
     def node_health(self) -> dict:
         from pilosa_tpu.utils.telemetry import health_score
@@ -1662,6 +1769,75 @@ class Server:
                 worst = score
         return {
             "fleet": {"health": worst, "counts": counts, "nodes": nodes},
+            "generatedBy": self.node_id,
+            "asOf": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        }
+
+    def cluster_usage(self) -> dict:
+        """The fleet's merged per-principal usage (GET /cluster/usage):
+        every live peer's /debug/usage ledger collected concurrently and
+        summed per principal, so "who is spending the fleet" is one
+        request from any node. Same degradation contract as
+        cluster_stats: peers that 404 the route are "legacy" (never an
+        error), down peers are skipped without an RPC, transient fetch
+        failures mark the node and leave the merge partial-but-honest."""
+        from pilosa_tpu.utils import accounting as _accounting
+
+        docs: dict[str, dict] = {}
+        nodes: list[dict] = []
+        timeout = max(2.0, self.probe_timeout)
+        fetchers: list[tuple] = []
+        for n in list(self.cluster.nodes):
+            if n.id == self.node_id:
+                docs[n.id] = self.usage.snapshot()
+                nodes.append({"id": n.id, "uri": self.uri, "status": "ok"})
+                continue
+            if self.cluster.is_down(n.id) or not n.uri:
+                nodes.append({"id": n.id, "uri": n.uri or "",
+                              "status": "down"})
+                continue
+            entry = {"id": n.id, "uri": n.uri, "status": "pending"}
+            nodes.append(entry)
+
+            def fetch(node=n, entry=entry):
+                try:
+                    docs[node.id] = self.client.debug_usage(node.uri,
+                                                            timeout)
+                    entry["status"] = "ok"
+                except ClientError as e:
+                    entry["status"] = ("legacy" if e.status == 404
+                                       else "error")
+                except Exception:  # noqa: BLE001 — never fail the merge
+                    entry["status"] = "error"
+
+            t = threading.Thread(target=fetch, daemon=True)
+            t.start()
+            fetchers.append((entry, t))
+        for entry, t in fetchers:
+            t.join(timeout + 1.0)
+            if entry["status"] == "pending":
+                entry["status"] = "error"
+        merged: dict[str, dict] = {}
+        totals = dict.fromkeys(_accounting.FIELDS, 0.0)
+        spilled = 0
+        for doc in docs.values():
+            for p, e in (doc.get("principals") or {}).items():
+                acc = merged.setdefault(
+                    p, dict.fromkeys(_accounting.FIELDS, 0.0))
+                for f in _accounting.FIELDS:
+                    acc[f] += float(e.get(f, 0.0))
+                acc["nodes"] = acc.get("nodes", 0) + 1
+            for f in _accounting.FIELDS:
+                totals[f] += float((doc.get("totals") or {}).get(f, 0.0))
+            spilled += int(doc.get("spilledPrincipals", 0))
+        ordered = dict(sorted(merged.items(),
+                              key=lambda kv: (-kv[1]["deviceMs"],
+                                              -kv[1]["queries"], kv[0])))
+        return {
+            "principals": ordered,
+            "totals": totals,
+            "spilledPrincipals": spilled,
+            "nodes": nodes,
             "generatedBy": self.node_id,
             "asOf": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
         }

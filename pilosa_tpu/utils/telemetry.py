@@ -393,7 +393,9 @@ def health_score(inputs: dict) -> dict:
     balancers and the `/cluster/stats` federation reuses it per node, so
     the two surfaces can never disagree. Inputs (all optional, absent =
     healthy): walPoisoned, needsRebuild, damagedFragments, errorRate
-    (5xx/s), queueSaturation (queued / pool size), recompileStormActive.
+    (5xx/s), queueSaturation (queued / pool size), recompileStormActive,
+    sloStatus/sloReason (the worst [slo] objective's multi-window
+    burn-rate verdict, utils/accounting.py SLOTracker.worst()).
     Liveness is the federation layer's job (a down node never answers)."""
     score = "green"
     reasons: list[str] = []
@@ -424,4 +426,8 @@ def health_score(inputs: dict) -> dict:
         worsen("yellow", f"fan-out queue saturated ({sat:.1f}x pool size)")
     if inputs.get("recompileStormActive"):
         worsen("yellow", "XLA recompile storm in progress")
+    slo_status = inputs.get("sloStatus")
+    if slo_status in ("yellow", "red"):
+        worsen(slo_status,
+               inputs.get("sloReason") or "SLO burn-rate alert")
     return {"score": score, "reasons": reasons}

@@ -161,6 +161,301 @@ class SpanExporter:
         self.flush()
 
 
+def trace_export_enabled() -> bool:
+    """PILOSA_TPU_TRACE_EXPORT=0 kills all external trace export (read per
+    batch: operators flip it at runtime when a collector misbehaves)."""
+    import os
+    return os.environ.get("PILOSA_TPU_TRACE_EXPORT", "1") != "0"
+
+
+def _new_span_id() -> str:
+    return f"{_trace_rng.getrandbits(64):016x}"
+
+
+def profile_to_spans(profile: dict) -> list[dict]:
+    """Flatten a cross-node QueryProfile tree (utils/profile.py to_dict)
+    into exportable span records with parent/child links, all under the
+    profile's ONE trace id — so a trace id found in the slow-query log can
+    be followed outside the process, remote hops included.
+
+    Record shape (the exporter's internal interchange, formatted to
+    Jaeger-JSON or OTLP-JSON at flush): traceID, spanID, parentSpanID
+    ("" = root), operationName, startTimeMicros, durationMicros, tags.
+
+    Structure: one root `pilosa.query` span per profile node; child spans
+    for executor calls, per-shard-group fan-out RPCs, and batched-dispatch
+    shares; remote profile fragments recurse under the fan-out span of
+    their node (falling back to the root when the RPC record is absent —
+    e.g. a hedge winner whose primary record sealed late)."""
+    spans: list[dict] = []
+
+    def emit(trace_id: str, name: str, start_us: int, dur_us: int,
+             parent: str, tags: dict) -> str:
+        sid = _new_span_id()
+        spans.append({
+            "traceID": trace_id, "spanID": sid, "parentSpanID": parent,
+            "operationName": name,
+            "startTimeMicros": int(start_us),
+            "durationMicros": max(0, int(dur_us)),
+            "tags": {k: str(v) for k, v in tags.items() if v is not None},
+        })
+        return sid
+
+    def walk(node: dict, parent: str, trace_id: str) -> None:
+        trace_id = node.get("traceId") or trace_id
+        start_us = int(float(node.get("startWall") or 0.0) * 1e6)
+        root = emit(trace_id, "pilosa.query", start_us,
+                    float(node.get("elapsedMs") or 0.0) * 1e3, parent,
+                    {"node": node.get("node"), "index": node.get("index"),
+                     "pql": node.get("pql")})
+        for c in node.get("calls", []):
+            emit(trace_id, f"call.{c.get('call', '?')}", start_us,
+                 float(c.get("ms") or 0.0) * 1e3, root, {})
+        fanout_span_by_node: dict[str, str] = {}
+        for fo in node.get("fanout", []):
+            kind = fo.get("kind")
+            if kind:  # hedge / failover bookkeeping records: tag-only spans
+                emit(trace_id, f"fanout.{kind}", start_us, 0, root, fo)
+                continue
+            sid = emit(trace_id, f"fanout.{fo.get('node', '?')}", start_us,
+                       float(fo.get("ms") or 0.0) * 1e3, root,
+                       {"shards": fo.get("shards"),
+                        "transport": fo.get("transport"),
+                        "hedge": fo.get("hedge"),
+                        "error": fo.get("error")})
+            fanout_span_by_node.setdefault(str(fo.get("node")), sid)
+        for d in node.get("dispatches", []):
+            emit(trace_id, f"dispatch.{d.get('batcher', '?')}", start_us,
+                 float(d.get("shareMs") or 0.0) * 1e3, root,
+                 {"dispatch": d.get("dispatch"),
+                  "batchSize": d.get("batchSize"),
+                  "wallMs": d.get("wallMs")})
+        for rem in node.get("remoteProfiles", []):
+            frag = rem.get("profile")
+            if not isinstance(frag, dict):
+                continue
+            # remote fragments are grafted under the peer's URI
+            # (coalesce/query_proto), while fan-out records carry the
+            # cluster node id — the fragment's OWN node id is the join
+            # key; the graft label is the fallback
+            anchor = (fanout_span_by_node.get(str(frag.get("node")))
+                      or fanout_span_by_node.get(str(rem.get("node")))
+                      or root)
+            walk(frag, anchor, trace_id)
+
+    walk(profile, "", profile.get("traceId") or _new_span_id())
+    return spans
+
+
+def spans_to_jaeger(records: list[dict],
+                    service_name: str = "pilosa-tpu") -> dict:
+    """Jaeger-JSON batch: the shape a Jaeger HTTP collector's JSON
+    endpoint (and jaeger-ui's import) accepts — references carry the
+    CHILD_OF links."""
+    spans = []
+    for r in records:
+        refs = []
+        if r.get("parentSpanID"):
+            refs.append({"refType": "CHILD_OF", "traceID": r["traceID"],
+                         "spanID": r["parentSpanID"]})
+        spans.append({
+            "traceID": r["traceID"], "spanID": r["spanID"],
+            "operationName": r["operationName"],
+            "references": refs,
+            "startTime": r["startTimeMicros"],
+            "duration": r["durationMicros"],
+            "tags": [{"key": k, "type": "string", "value": v}
+                     for k, v in sorted(r.get("tags", {}).items())],
+        })
+    return {"process": {"serviceName": service_name}, "spans": spans}
+
+
+def spans_to_otlp(records: list[dict],
+                  service_name: str = "pilosa-tpu") -> dict:
+    """OTLP/JSON ExportTraceServiceRequest. OTLP trace ids are 128-bit:
+    the native 64-bit ids are zero-padded left, which every OTLP consumer
+    accepts and keeps the join with log lines trivially greppable."""
+    spans = []
+    for r in records:
+        start_ns = r["startTimeMicros"] * 1000
+        spans.append({
+            "traceId": r["traceID"].rjust(32, "0"),
+            "spanId": r["spanID"],
+            "parentSpanId": r.get("parentSpanID", ""),
+            "name": r["operationName"],
+            "kind": 1,
+            "startTimeUnixNano": str(start_ns),
+            "endTimeUnixNano": str(start_ns + r["durationMicros"] * 1000),
+            "attributes": [{"key": k, "value": {"stringValue": v}}
+                           for k, v in sorted(r.get("tags", {}).items())],
+        })
+    return {"resourceSpans": [{
+        "resource": {"attributes": [
+            {"key": "service.name",
+             "value": {"stringValue": service_name}}]},
+        "scopeSpans": [{"scope": {"name": "pilosa-tpu"}, "spans": spans}],
+    }]}
+
+
+class TraceExporter:
+    """External trace egress: Jaeger-JSON or OTLP-JSON batches to a spool
+    file (one JSON batch per line — ship with any log forwarder) or an
+    HTTP collector endpoint ([metric] trace-export = off|file|http).
+
+    Feeds from two sources: the recording tracer's finished spans (wired
+    as Tracer.exporter — flat spans) and finished cross-node profile
+    trees (export_profile — parent/child-linked spans via
+    profile_to_spans). Sampling is deterministic per trace id (crc32,
+    the Tracer._sampled scheme) so every node of one trace agrees; the
+    `PILOSA_TPU_TRACE_EXPORT=0` kill switch and any I/O failure drop
+    batches — export must never block or break serving."""
+
+    def __init__(self, mode: str = "file", path: str = "",
+                 endpoint: str = "", fmt: str = "jaeger",
+                 sample: float = 1.0, batch_size: int = 64,
+                 flush_interval: float = 2.0,
+                 service_name: str = "pilosa-tpu"):
+        if mode not in ("file", "http"):
+            raise ValueError(
+                f"invalid trace-export mode {mode!r} (expected file | http)")
+        if fmt not in ("jaeger", "otlp"):
+            raise ValueError(
+                f"invalid trace-export format {fmt!r} "
+                "(expected jaeger | otlp)")
+        if mode == "file" and not path:
+            raise ValueError("trace-export = file requires a spool path")
+        if mode == "http" and not endpoint:
+            raise ValueError("trace-export = http requires an endpoint")
+        self.mode = mode
+        self.path = path
+        self.endpoint = endpoint
+        self.fmt = fmt
+        self.sample = sample
+        self.batch_size = batch_size
+        self.flush_interval = flush_interval
+        self.service_name = service_name
+        self._buf: list[dict] = []
+        self._lock = threading.Lock()
+        self._timer: Optional[threading.Timer] = None
+        self._flush_pending = False
+        self._closed = False
+        self.exported = 0  # span records successfully shipped
+        self.dropped = 0   # span records lost to I/O failures
+        self._schedule()
+
+    # -- sampling -----------------------------------------------------------
+
+    def sampled(self, trace_id: Optional[str]) -> bool:
+        if self.sample >= 1.0:
+            return True
+        if self.sample <= 0.0:
+            return False
+        import zlib
+        h = zlib.crc32((trace_id or "").encode())
+        return (h % 10_000) < self.sample * 10_000
+
+    # -- ingestion ----------------------------------------------------------
+
+    def export(self, span: "Span") -> None:
+        """Recording-tracer hook (the SpanExporter interface): one flat
+        finished span. Tracer._sampled already gated it."""
+        if not trace_export_enabled():
+            return
+        self._push([{
+            "traceID": span.trace_id, "spanID": _new_span_id(),
+            "parentSpanID": "",
+            "operationName": span.name,
+            "startTimeMicros": int(span.start_wall * 1e6),
+            "durationMicros": int(span.duration() * 1e6),
+            "tags": {k: str(v) for k, v in span.tags.items()},
+        }])
+
+    def export_profile(self, profile: dict) -> None:
+        """One finished cross-node profile tree -> linked spans."""
+        if not trace_export_enabled():
+            return
+        if not self.sampled(profile.get("traceId")):
+            return
+        try:
+            self._push(profile_to_spans(profile))
+        except Exception:  # noqa: BLE001 — export must never break serving
+            self.dropped += 1
+
+    def _push(self, records: list[dict]) -> None:
+        if not records:
+            return
+        with self._lock:
+            if self._closed:
+                return
+            self._buf.extend(records)
+            spawn = (len(self._buf) >= self.batch_size
+                     and not self._flush_pending)
+            if spawn:
+                self._flush_pending = True
+        if spawn:
+            threading.Thread(target=self._bg_flush, daemon=True).start()
+
+    # -- flushing -----------------------------------------------------------
+
+    def _schedule(self) -> None:
+        if self._closed or self.flush_interval <= 0:
+            return
+        self._timer = threading.Timer(self.flush_interval, self._tick)
+        self._timer.daemon = True
+        self._timer.start()
+
+    def _tick(self) -> None:
+        try:
+            self.flush()
+        finally:
+            self._schedule()
+
+    def _bg_flush(self) -> None:
+        try:
+            self.flush()
+        finally:
+            with self._lock:
+                self._flush_pending = False
+
+    def flush(self) -> None:
+        with self._lock:
+            batch, self._buf = self._buf, []
+        if not batch or not trace_export_enabled():
+            self.dropped += len(batch)
+            return
+        import json
+        body_obj = (spans_to_jaeger(batch, self.service_name)
+                    if self.fmt == "jaeger"
+                    else spans_to_otlp(batch, self.service_name))
+        try:
+            if self.mode == "file":
+                # one JSON batch per line: append-only spool any log
+                # shipper can tail; partial-line torn writes are bounded
+                # to the final line and skipped by readers
+                with open(self.path, "a") as f:
+                    f.write(json.dumps(body_obj) + "\n")
+            else:
+                import urllib.request
+                req = urllib.request.Request(
+                    self.endpoint, data=json.dumps(body_obj).encode(),
+                    method="POST",
+                    headers={"Content-Type": "application/json"})
+                with urllib.request.urlopen(req, timeout=2.0):
+                    pass
+            self.exported += len(batch)
+        except Exception:  # noqa: BLE001 — drop the batch: never let
+            # trace egress break (or block) serving
+            self.dropped += len(batch)
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        if self._timer is not None:
+            self._timer.cancel()
+        self.flush()
+
+
 class Tracer:
     """Recording tracer; keeps the last `limit` finished spans.
 

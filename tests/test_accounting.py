@@ -1,0 +1,504 @@
+"""Per-principal resource accounting, SLO burn rates, trace export
+(utils/accounting.py + utils/tracing.py TraceExporter + the HTTP
+surfaces): ledger bounds and spill, principal extraction and cross-node
+inheritance, multi-window burn math, /debug/usage and the federated
+/cluster/usage, the accounting kill switch, and the Jaeger/OTLP-JSON
+golden round-trip of a live 2-node profiled query's span tree."""
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+from pilosa_tpu.utils import accounting as A
+from pilosa_tpu.utils import tracing as T
+
+
+# ------------------------------------------------------------------- ledger
+
+
+def test_ledger_charges_and_totals():
+    led = A.UsageLedger()
+    led.charge("alice", device_ms=2.5, hbm_bytes=100, queries=1)
+    led.charge("alice", rpc_bytes=50, queue_ms=1.0, queries=1, errors=1)
+    led.charge("bob", device_ms=1.0, queries=1, plan_cache_hits=3)
+    snap = led.snapshot()
+    a = snap["principals"]["alice"]
+    assert a["deviceMs"] == 2.5 and a["hbmBytes"] == 100
+    assert a["rpcBytes"] == 50 and a["queueMs"] == 1.0
+    assert a["queries"] == 2 and a["errors"] == 1
+    assert snap["principals"]["bob"]["planCacheHits"] == 3
+    # totals are exact sums over every principal
+    assert snap["totals"]["queries"] == 3
+    assert snap["totals"]["deviceMs"] == 3.5
+    # sorted by deviceMs desc; top bounds the list but not the totals
+    assert list(snap["principals"]) == ["alice", "bob"]
+    topped = led.snapshot(top=1)
+    assert list(topped["principals"]) == ["alice"]
+    assert topped["totals"]["queries"] == 3
+
+
+def test_ledger_bounded_with_lowest_spender_spill():
+    led = A.UsageLedger(max_principals=4)
+    for i in range(10):
+        led.charge(f"p{i}", device_ms=float(i), queries=1)
+    snap = led.snapshot()
+    assert snap["trackedPrincipals"] <= 4
+    assert A.SPILL in snap["principals"]
+    assert snap["spilledPrincipals"] > 0
+    # NOTHING is lost: totals still count all ten queries, and the top
+    # spenders survive as named entries (top-K semantics)
+    assert snap["totals"]["queries"] == 10
+    assert "p9" in snap["principals"]
+    assert "p8" in snap["principals"]
+    # the spill bucket absorbed the evicted principals' charges
+    spilled_q = snap["principals"][A.SPILL]["queries"]
+    named_q = sum(e["queries"] for p, e in snap["principals"].items()
+                  if p != A.SPILL)
+    assert spilled_q + named_q == 10
+
+
+def test_ledger_delta_ring_since_cursor():
+    led = A.UsageLedger(ring_size=8)
+    led.charge("alice", queries=2)
+    led.sample_tick()
+    out = led.since(0)
+    assert out["samples"][-1]["gauges"]["alice"]["queries"] == 2
+    cur = out["seq"]
+    # a quiet tick still advances the cursor (cheap polling)
+    led.sample_tick()
+    out2 = led.since(cur)
+    assert out2["seq"] == cur + 1
+    assert out2["samples"][-1]["gauges"] == {}
+    # deltas, not totals: the next tick reports only NEW charges
+    led.charge("alice", queries=5)
+    led.sample_tick()
+    got = led.since(out2["seq"])["samples"][-1]["gauges"]
+    assert got["alice"]["queries"] == 5
+
+
+# -------------------------------------------------------------- principals
+
+
+def test_principal_extraction_precedence():
+    # inherited internal-RPC header wins (cross-node inheritance)
+    assert A.principal_from_headers(
+        {A.PRINCIPAL_HEADER: "key:alice", "X-API-Key": "bob"}) == "key:alice"
+    # API key used verbatim under the key: prefix
+    assert A.principal_from_headers({"X-API-Key": "alice"}) == "key:alice"
+    # Authorization is digested, never stored raw
+    p = A.principal_from_headers({"Authorization": "Bearer s3cret"})
+    assert p.startswith("auth:") and "s3cret" not in p
+    assert p == A.principal_from_headers({"Authorization": "Bearer s3cret"})
+    # remote-addr fallback, then anonymous
+    assert A.principal_from_headers({}, "10.0.0.7") == "addr:10.0.0.7"
+    assert A.principal_from_headers({}) == "anonymous"
+    # hostile header bytes cannot ride into labels / stats keys
+    weird = A.principal_from_headers({"X-API-Key": 'a,b:"c\nd' + "x" * 100})
+    assert "," not in weird and "\n" not in weird and len(weird) <= 68
+
+
+def test_account_contextvar_nop_fast_path():
+    assert A.current() is None  # nothing installed: charge sites nop
+    led = A.UsageLedger()
+    tok = A.current_account.set(A.Account(led, "key:x"))
+    try:
+        A.current().charge(queries=1)
+    finally:
+        A.current_account.reset(tok)
+    assert led.totals()["queries"] == 1
+    assert A.current() is None
+
+
+# --------------------------------------------------------------------- SLO
+
+
+def test_classify_query():
+    from pilosa_tpu.pql import parse_string_cached
+    assert A.classify_query(parse_string_cached("Count(Row(f=1))")) == "count"
+    assert A.classify_query(parse_string_cached("Row(f=1)")) == "read"
+    assert A.classify_query(
+        parse_string_cached("Intersect(Row(f=1), Row(f=2))")) == "read"
+    assert A.classify_query(
+        parse_string_cached('TopN(f, n=3)')) == "topn"
+    assert A.classify_query(
+        parse_string_cached("GroupBy(Rows(field=f))")) == "groupby"
+    assert A.classify_query("not parsed") == "other"
+
+
+def test_slo_burn_math_and_multiwindow_status():
+    tr = A.SLOTracker(
+        [A.Objective("count-latency", "count", 10.0, 0.9),
+         A.Objective("availability", None, None, 0.9)],
+        burn_yellow=1.0, burn_red=5.0)
+    # 20 good count queries: zero burn, green
+    for _ in range(20):
+        tr.observe("count", 0.001, True)
+    ev = tr.evaluate()
+    assert ev["count-latency"]["burnShort"] == 0.0
+    assert ev["count-latency"]["status"] == "green"
+    # other classes never touch the count objective
+    tr.observe("topn", 99.0, True)
+    assert tr.evaluate()["count-latency"]["windowShortTotal"] == 20
+    # every count query now blows the 10 ms bound: bad ratio 0.5 over the
+    # window, budget 0.1 -> burn 5x in BOTH windows -> red
+    for _ in range(20):
+        tr.observe("count", 0.05, True)
+    ev = tr.evaluate()
+    assert ev["count-latency"]["burnShort"] == pytest.approx(5.0)
+    assert ev["count-latency"]["status"] == "red"
+    # latency badness does NOT count against availability (no errors)
+    assert ev["availability"]["status"] == "green"
+    status, reason = tr.worst()
+    assert status == "red" and "count-latency" in reason
+
+
+def test_slo_idle_objective_is_green_and_bad_target_rejected():
+    tr = A.SLOTracker([A.Objective("availability", None, None, 0.999)])
+    assert tr.evaluate()["availability"]["status"] == "green"
+    with pytest.raises(ValueError):
+        A.Objective("x", None, None, 1.5)
+    with pytest.raises(ValueError):
+        A.SLOTracker([], short_window=10, long_window=5)
+
+
+def test_health_score_slo_input():
+    from pilosa_tpu.utils.telemetry import health_score
+    assert health_score({})["score"] == "green"
+    out = health_score({"sloStatus": "red", "sloReason": "SLO x burning"})
+    assert out["score"] == "red" and "SLO x burning" in out["reasons"]
+    assert health_score({"sloStatus": "yellow"})["score"] == "yellow"
+
+
+# ----------------------------------------------------------- profile spans
+
+
+def _sample_profile():
+    return {
+        "traceId": "feedc0de00000001", "node": "coord", "index": "i",
+        "pql": "Count(Row(f=1))", "startWall": 1000.0, "elapsedMs": 12.0,
+        "calls": [{"call": "Count", "ms": 11.0}],
+        "fanout": [{"node": "remote-1", "shards": 4, "ms": 6.0,
+                    "transport": "coalesced"}],
+        "dispatches": [{"batcher": "CountBatcher", "dispatch": 7,
+                        "batchSize": 4, "wallMs": 2.0, "shareMs": 0.5}],
+        "residency": {"hits": 1, "misses": 0, "hostToDeviceBytes": 0},
+        "plan": [],
+        "remoteProfiles": [{"node": "remote-1", "profile": {
+            "traceId": "feedc0de00000001", "node": "remote-1",
+            "startWall": 1000.002, "elapsedMs": 5.0,
+            "calls": [{"call": "Count", "ms": 4.0}],
+            "fanout": [], "dispatches": [], "remoteProfiles": []}}],
+    }
+
+
+def test_profile_to_spans_links_remote_under_fanout():
+    spans = T.profile_to_spans(_sample_profile())
+    assert len({s["traceID"] for s in spans}) == 1  # ONE trace id
+    by_id = {s["spanID"]: s for s in spans}
+    roots = [s for s in spans if not s["parentSpanID"]]
+    assert len(roots) == 1 and roots[0]["operationName"] == "pilosa.query"
+    # every parent link resolves inside the batch
+    for s in spans:
+        assert s["parentSpanID"] == "" or s["parentSpanID"] in by_id
+    # the remote node's query span hangs under the coordinator's fan-out
+    # span for that node — the cross-node parent/child link
+    remote_root = next(s for s in spans
+                       if s["operationName"] == "pilosa.query"
+                       and s["tags"].get("node") == "remote-1")
+    parent = by_id[remote_root["parentSpanID"]]
+    assert parent["operationName"] == "fanout.remote-1"
+    # remote's own call span chains up to the coordinator root
+    remote_call = next(s for s in spans
+                       if s["operationName"] == "call.Count"
+                       and s["parentSpanID"] == remote_root["spanID"])
+    hops = 0
+    cur = remote_call
+    while cur["parentSpanID"]:
+        cur = by_id[cur["parentSpanID"]]
+        hops += 1
+    assert cur is roots[0] and hops == 3
+
+
+def test_jaeger_and_otlp_batches_round_trip(tmp_path):
+    spans = T.profile_to_spans(_sample_profile())
+    jb = T.spans_to_jaeger(spans)
+    assert jb["process"]["serviceName"] == "pilosa-tpu"
+    # Jaeger: CHILD_OF references reproduce the exact parent links
+    child_of = {s["spanID"]: (s["references"][0]["spanID"]
+                              if s["references"] else "")
+                for s in jb["spans"]}
+    assert child_of == {s["spanID"]: s["parentSpanID"] for s in spans}
+    ob = T.spans_to_otlp(spans)
+    ospans = ob["resourceSpans"][0]["scopeSpans"][0]["spans"]
+    assert {s["spanId"]: s["parentSpanId"] for s in ospans} \
+        == {s["spanID"]: s["parentSpanID"] for s in spans}
+    # OTLP trace ids are the zero-padded 128-bit form of the same trace
+    assert {s["traceId"] for s in ospans} \
+        == {spans[0]["traceID"].rjust(32, "0")}
+    assert all(int(s["endTimeUnixNano"]) >= int(s["startTimeUnixNano"])
+               for s in ospans)
+    # file-mode exporter: one parseable JSON batch per spool line
+    spool = tmp_path / "spool.jsonl"
+    exp = T.TraceExporter(mode="file", path=str(spool), fmt="otlp",
+                          flush_interval=0)
+    exp.export_profile(_sample_profile())
+    exp.flush()
+    lines = spool.read_text().strip().splitlines()
+    assert len(lines) == 1
+    parsed = json.loads(lines[0])
+    assert parsed["resourceSpans"][0]["scopeSpans"][0]["spans"]
+    assert exp.exported == len(spans)
+    exp.close()
+
+
+def test_trace_exporter_kill_switch_and_sampling(tmp_path, monkeypatch):
+    spool = tmp_path / "spool.jsonl"
+    exp = T.TraceExporter(mode="file", path=str(spool), flush_interval=0)
+    monkeypatch.setenv("PILOSA_TPU_TRACE_EXPORT", "0")
+    exp.export_profile(_sample_profile())
+    exp.flush()
+    assert not spool.exists() and exp.exported == 0
+    monkeypatch.delenv("PILOSA_TPU_TRACE_EXPORT")
+    # sample=0 drops deterministically; sample=1 ships
+    exp0 = T.TraceExporter(mode="file", path=str(spool), sample=0.0,
+                           flush_interval=0)
+    exp0.export_profile(_sample_profile())
+    exp0.flush()
+    assert not spool.exists()
+    exp.export_profile(_sample_profile())
+    exp.flush()
+    assert spool.exists()
+    exp.close()
+    exp0.close()
+    with pytest.raises(ValueError):
+        T.TraceExporter(mode="carrier-pigeon", path="x")
+    with pytest.raises(ValueError):
+        T.TraceExporter(mode="file", path="")
+
+
+# ------------------------------------------------------------ live cluster
+
+
+def _post(uri, path, payload=None, raw=None, headers=None):
+    body = raw if raw is not None else json.dumps(payload or {}).encode()
+    req = urllib.request.Request(uri + path, data=body, method="POST",
+                                 headers=headers or {})
+    with urllib.request.urlopen(req, timeout=30) as r:
+        return json.loads(r.read())
+
+
+def _get(uri, path):
+    with urllib.request.urlopen(uri + path, timeout=15) as r:
+        return json.loads(r.read())
+
+
+@pytest.fixture(scope="module")
+def acct_cluster(tmp_path_factory):
+    """3-node cluster with a file trace exporter on the coordinator and a
+    deliberately-unmeetable count-latency SLO, serving two API keys."""
+    from pilosa_tpu.server import Server
+
+    tmp = tmp_path_factory.mktemp("acct")
+    spool = tmp / "spool.jsonl"
+    servers = []
+    for i in range(3):
+        kw = {}
+        if i == 0:
+            kw = {"trace_export": "file",
+                  "trace_export_path": str(spool),
+                  "slo_count_latency_ms": 0.0001,
+                  "slo_latency_target": 0.9,
+                  "slo_burn_yellow": 1.0, "slo_burn_red": 5.0}
+        servers.append(Server(str(tmp / f"n{i}"), port=0,
+                              node_id=chr(ord("a") + i),
+                              telemetry_interval=0.05, **kw).open())
+    uris = [s.uri for s in servers]
+    for s in servers:
+        s.cluster_hosts = uris
+        s.refresh_membership()
+    _post(uris[0], "/index/u", {})
+    _post(uris[0], "/index/u/field/f", {})
+    cols = list(range(0, 3 * 2 ** 20, 4099))
+    _post(uris[0], "/index/u/field/f/import",
+          {"rowIDs": [0] * len(cols), "columnIDs": cols,
+           })
+    _post(uris[0], "/index/u/field/f/import",
+          {"rowIDs": [1] * (len(cols) // 2), "columnIDs": cols[::2]})
+    yield servers, uris, spool
+    for s in servers:
+        s.close()
+
+
+def test_per_principal_usage_on_live_cluster(acct_cluster):
+    servers, uris, _ = acct_cluster
+    # distinct PQL per request so the plan cache cannot zero the device
+    # charges; alice issues twice bob's traffic
+    for i, (key, n) in enumerate((("alice", 6), ("bob", 3))):
+        for j in range(n):
+            _post(uris[0], "/index/u/query",
+                  raw=f"Count(Intersect(Row(f={i}), Row(f={j % 2})))"
+                  .encode(), headers={"X-API-Key": key})
+    doc = _get(uris[0], "/debug/usage")
+    assert doc["enabled"]
+    pa = doc["principals"]["key:alice"]
+    pb = doc["principals"]["key:bob"]
+    assert pa["queries"] == 6 and pb["queries"] == 3
+    assert pa["deviceMs"] > 0, pa
+    assert pa["rpcBytes"] > 0, pa  # fan-out to the other nodes
+    # per-principal rows sum to the ledger totals (the /debug/vars
+    # cross-check the acceptance criterion audits)
+    for f in ("deviceMs", "rpcBytes", "queries"):
+        total = sum(e[f] for e in doc["principals"].values())
+        assert total == pytest.approx(doc["totals"][f], rel=1e-6), f
+    # /debug/vars mirrors the same ledger
+    dv = _get(uris[0], "/debug/vars")
+    assert dv["usage"]["totals"]["queries"] == doc["totals"]["queries"]
+    assert "slo" in dv
+
+
+def test_principal_inherited_by_remote_nodes(acct_cluster):
+    """Internal fan-out RPCs charge the REMOTE node's ledger under the
+    coordinator's principal (header + envelope-entry inheritance)."""
+    servers, uris, _ = acct_cluster
+    _post(uris[0], "/index/u/query", raw=b"Count(Row(f=0))",
+          headers={"X-API-Key": "carol"})
+    found = False
+    for s in servers[1:]:
+        snap = s.usage.snapshot()
+        if "key:carol" in snap["principals"]:
+            p = snap["principals"]["key:carol"]
+            assert p["queries"] >= 1
+            found = True
+    assert found, [s.usage.snapshot()["principals"].keys()
+                   for s in servers]
+
+
+def test_cluster_usage_federates_and_sums(acct_cluster):
+    servers, uris, _ = acct_cluster
+    doc = _get(uris[1], "/cluster/usage")
+    assert {n["status"] for n in doc["nodes"]} == {"ok"}
+    assert len(doc["nodes"]) == 3
+    # the fleet totals are the sum of every node's ledger totals
+    expect = sum(s.usage.totals()["queries"] for s in servers)
+    assert doc["totals"]["queries"] == pytest.approx(expect)
+    merged_alice = doc["principals"]["key:alice"]
+    per_node = sum(
+        s.usage.snapshot()["principals"].get("key:alice",
+                                             {"queries": 0})["queries"]
+        for s in servers)
+    assert merged_alice["queries"] == pytest.approx(per_node)
+    assert merged_alice["nodes"] >= 1
+
+
+def test_cluster_usage_legacy_peer_degrades(acct_cluster):
+    servers, uris, _ = acct_cluster
+    orig = servers[2].handler.get_debug_usage
+
+    def _legacy_404(params, query, body):
+        return 404, "application/json", b'{"error": "not found"}'
+
+    servers[2].handler.get_debug_usage = _legacy_404
+    try:
+        doc = _get(uris[0], "/cluster/usage")
+        by_id = {n["id"]: n["status"] for n in doc["nodes"]}
+        assert by_id["c"] == "legacy"
+        assert by_id["a"] == "ok" and by_id["b"] == "ok"
+    finally:
+        servers[2].handler.get_debug_usage = orig
+
+
+def test_slo_red_trips_gauges_and_health(acct_cluster):
+    """The deliberately-unmeetable count-latency objective (0.0001 ms)
+    goes red once count traffic flows, and the red lands on /metrics,
+    /debug/usage and the node's health score."""
+    servers, uris, _ = acct_cluster
+    for j in range(4):
+        _post(uris[0], "/index/u/query",
+              raw=f"Count(Row(f={j % 2}))".encode(),
+              headers={"X-API-Key": "slo-prober"})
+    doc = _get(uris[0], "/debug/usage")
+    ob = doc["slo"]["count-latency"]
+    assert ob["status"] == "red", ob
+    assert ob["burnShort"] >= 5.0 and ob["burnLong"] >= 5.0
+    with urllib.request.urlopen(uris[0] + "/metrics", timeout=10) as r:
+        text = r.read().decode()
+    line = next(l for l in text.splitlines()
+                if l.startswith("pilosa_slo")
+                and 'key="status"' in l and "count-latency" in l)
+    assert line.rstrip().endswith("2")  # red = 2.0
+    health = servers[0].node_health()
+    assert health["score"] == "red"
+    assert any("count-latency" in r for r in health["reasons"])
+    # the availability objective is untouched by latency badness
+    assert doc["slo"]["availability"]["status"] == "green"
+
+
+def test_exported_trace_spans_cross_nodes(acct_cluster):
+    """Acceptance: a profiled cross-node query's exported batch contains
+    the coordinator AND remote spans under one trace id, with the remote
+    subtree parented into the coordinator's fan-out span."""
+    servers, uris, spool = acct_cluster
+    out = _post(uris[0], "/index/u/query?profile=true",
+                raw=b"Count(Row(f=0))", headers={"X-API-Key": "tracer"})
+    trace_id = out["profile"]["traceId"]
+    assert any(r.get("profile") for r in out["profile"]["remoteProfiles"]), \
+        "expected a remote profile fragment (cross-node query)"
+    servers[0].trace_exporter.flush()
+    batches = [json.loads(l) for l in
+               spool.read_text().strip().splitlines()]
+    spans = [s for b in batches for s in b["spans"]
+             if s["traceID"] == trace_id]
+    assert spans, "no exported spans for the profiled trace id"
+
+    def tags(s):  # Jaeger-JSON tags are [{key, type, value}] lists
+        return {t["key"]: t["value"] for t in s.get("tags", [])}
+
+    nodes = {tags(s).get("node") for s in spans
+             if s["operationName"] == "pilosa.query"}
+    assert "a" in nodes and len(nodes) >= 2, nodes  # coordinator + remote
+    by_id = {s["spanID"]: s for s in spans}
+    remote_roots = [s for s in spans if s["operationName"] == "pilosa.query"
+                    and tags(s).get("node") != "a"]
+    for rr in remote_roots:
+        parent = rr["references"][0]["spanID"]
+        assert parent in by_id
+        assert by_id[parent]["operationName"].startswith("fanout.")
+
+
+def test_accounting_kill_switch(tmp_path, monkeypatch):
+    from pilosa_tpu.server import Server
+    monkeypatch.setenv("PILOSA_TPU_ACCOUNTING", "0")
+    srv = Server(str(tmp_path / "ks"), port=0).open()
+    try:
+        _post(srv.uri, "/index/k", {})
+        _post(srv.uri, "/index/k/field/f", {})
+        _post(srv.uri, "/index/k/query", raw=b"Set(1, f=1)")
+        _post(srv.uri, "/index/k/query", raw=b"Count(Row(f=1))",
+              headers={"X-API-Key": "ghost"})
+        doc = _get(srv.uri, "/debug/usage")
+        assert not doc["enabled"]
+        assert doc["principals"] == {} and doc["totals"]["queries"] == 0
+    finally:
+        srv.close()
+
+
+def test_usage_ledger_runtime_toggle(tmp_path):
+    """ledger.enabled flips accounting at runtime (the bench A/B path)."""
+    from pilosa_tpu.server import Server
+    srv = Server(str(tmp_path / "tog"), port=0).open()
+    try:
+        _post(srv.uri, "/index/t", {})
+        _post(srv.uri, "/index/t/field/f", {})
+        _post(srv.uri, "/index/t/query", raw=b"Set(1, f=1)")
+        srv.usage.enabled = False
+        _post(srv.uri, "/index/t/query", raw=b"Count(Row(f=1))",
+              headers={"X-API-Key": "off"})
+        assert "key:off" not in srv.usage.snapshot()["principals"]
+        srv.usage.enabled = True
+        _post(srv.uri, "/index/t/query", raw=b"Count(Row(f=1))",
+              headers={"X-API-Key": "on"})
+        assert srv.usage.snapshot()["principals"]["key:on"]["queries"] == 1
+    finally:
+        srv.close()

@@ -246,12 +246,15 @@ def test_gossip_cluster_sigstop_liveness(tmp_path):
     backend drives the same mark_down/mark_up plumbing end to end across
     process boundaries (gossip/gossip.go:488-519 analog).
 
-    Load-deflaked (the commit-78793c6 recipe, VERDICT r5 weak #5): the
-    SWIM clock is widened — a loaded-but-alive node gets 0.6 s (not
-    0.15 s) to ack before suspicion, so host contention can't mark a
-    healthy peer down and flap the cluster state mid-assert — and every
-    cross-process observation polls until convergence with generous
-    deadlines instead of asserting a single snapshot."""
+    Load-deflaked twice (commit-78793c6, then the full-suite pass): the
+    SWIM clock is isolated from suite CPU contention — a loaded-but-alive
+    node now gets 1.5 s (not 0.15 s, not 0.6 s) to ack before suspicion,
+    with a 0.5 s protocol period so the suspicion window is ~3 s — and
+    the subprocesses run with the telemetry sampler and planner cache
+    disabled (background CPU they don't need, stolen from the prober
+    threads when the whole suite shares the host). Every cross-process
+    observation polls until convergence with generous deadlines instead
+    of asserting a single snapshot."""
     ports = free_ports(3)
     gports = free_ports(3)
     hosts = ", ".join(f'"http://127.0.0.1:{p}"' for p in ports)
@@ -270,11 +273,20 @@ def test_gossip_cluster_sigstop_liveness(tmp_path):
                 "[gossip]\n"
                 f"port = {gports[i]}\n"
                 f'seeds = ["127.0.0.1:{gports[0]}"]\n'
-                # widened suspicion tolerance: 0.1/0.15 s false-suspected
-                # healthy-but-slow peers under CPU contention (load flake)
-                "period = 0.25\n"
-                "probe-timeout = 0.6\n"
-                "push-pull-interval = 1.0\n"
+                # widened suspicion tolerance: sub-second ack windows
+                # false-suspect healthy-but-slow peers whenever the full
+                # suite loads the host; 1.5 s ack + 0.5 s period keeps
+                # the SWIM clock an order of magnitude above scheduler
+                # jitter while the waits below stay well inside their
+                # deadlines
+                "period = 0.5\n"
+                "probe-timeout = 1.5\n"
+                "push-pull-interval = 2.0\n"
+                "[metric]\n"
+                # no background sampler burning CPU in the subprocesses:
+                # this test is about the failure detector's clock, and
+                # suite-load contention was flaking it (ISSUE 8 satellite)
+                "telemetry-interval = 0\n"
                 "[mesh]\n"
                 'devices = "none"\n'
                 'platform = "cpu"\n')
@@ -282,6 +294,7 @@ def test_gossip_cluster_sigstop_liveness(tmp_path):
             env["PYTHONPATH"] = \
                 f"{REPO}:{os.path.expanduser('~')}/.axon_site"
             env["JAX_PLATFORMS"] = "cpu"
+            env["PILOSA_TPU_TELEMETRY"] = "0"
             p = subprocess.Popen(
                 [sys.executable, "-m", "pilosa_tpu.cli", "server",
                  "--config", str(cfg)],
@@ -298,7 +311,7 @@ def test_gossip_cluster_sigstop_liveness(tmp_path):
         os.kill(procs[2].pid, signal.SIGSTOP)
         assert wait_until(
             lambda: cluster_state(p0) == "DEGRADED"
-            and cluster_state(p1) == "DEGRADED", 90.0), \
+            and cluster_state(p1) == "DEGRADED", 120.0), \
             "gossip never marked the SIGSTOP'd node down"
 
         # queries still answer while DEGRADED (placement routes around);
@@ -312,7 +325,7 @@ def test_gossip_cluster_sigstop_liveness(tmp_path):
         os.kill(procs[2].pid, signal.SIGCONT)
         assert wait_until(
             lambda: cluster_state(p0) == "NORMAL"
-            and cluster_state(p1) == "NORMAL", 60.0), \
+            and cluster_state(p1) == "NORMAL", 90.0), \
             "gossip never revived the resumed node"
     finally:
         for p in procs:

@@ -144,7 +144,7 @@ def test_legacy_ttl_reprobes_the_destination():
     fc = FakeClient(batch_status=404)
     co = NodeCoalescer(fc, window_s=0.0, legacy_ttl=0.05)
     out = co._compute(("http://old:1",),
-                      [("idx", "q", None, None, None, False)])
+                      [("idx", "q", None, None, None, False, None)])
     assert len(out) == 1  # fallback sentinel per waiter
     assert co._is_legacy("http://old:1")
     time.sleep(0.06)
@@ -438,10 +438,10 @@ def test_trace_id_propagates_through_coalesced_fanout(pair):
     seen = []
     orig = servers[1].handler.dispatch
 
-    def spy(method, path, query, body, headers=None):
+    def spy(method, path, query, body, headers=None, **kw):
         if path == "/internal/query-batch":
             seen.append((headers or {}).get("X-Pilosa-Trace-Id"))
-        return orig(method, path, query, body, headers=headers)
+        return orig(method, path, query, body, headers=headers, **kw)
 
     servers[1].handler.dispatch = spy
     try:

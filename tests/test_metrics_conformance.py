@@ -284,6 +284,75 @@ def test_live_metrics_planner_and_plan_cache_series(pair):
     assert {"bytes", "entries"} <= gkeys
 
 
+def test_live_metrics_usage_and_slo_families(pair):
+    """Accounting PR satellite: the per-principal usage counters and the
+    SLO burn-rate gauges are scrapeable — emitted unconditionally (zeros
+    included) so the families always exist — and conform like everything
+    else. Per-principal series ride a `principal` label."""
+    servers, uris = pair
+    req = urllib.request.Request(
+        uris[0] + "/index/m/query", data=b"Count(Row(f=0))",
+        method="POST", headers={"X-API-Key": "conformance-key"})
+    urllib.request.urlopen(req, timeout=30).read()
+    with urllib.request.urlopen(uris[0] + "/metrics", timeout=10) as r:
+        text = r.read().decode()
+    types, samples = check_conformance(text)
+    assert types["pilosa_usage_total"] == "counter"
+    ukeys = {l.get("key") for n, l, _ in samples
+             if n == "pilosa_usage_total" and "principal" not in l}
+    assert {"deviceMs", "hbmBytes", "rpcBytes", "queueMs", "queries",
+            "errors", "planCacheHits"} <= ukeys
+    # per-principal rows carry the principal label; the API-key query
+    # above guarantees at least one tracked principal exists
+    principals = {l.get("principal") for n, l, _ in samples
+                  if n == "pilosa_usage_total" and "principal" in l}
+    assert "key:conformance-key" in principals
+    q = next(v for n, l, v in samples
+             if n == "pilosa_usage_total" and l.get("key") == "queries"
+             and l.get("principal") == "key:conformance-key")
+    assert q >= 1
+    assert types["pilosa_usage"] == "gauge"  # tracked/spilled principals
+    # SLO burn gauges per objective (the default availability objective
+    # exists on every server, so the family is unconditional)
+    assert types["pilosa_slo"] == "gauge"
+    skeys = {(l.get("key"), l.get("objective")) for n, l, _ in samples
+             if n == "pilosa_slo"}
+    assert ("burnShort", "availability") in skeys
+    assert ("burnLong", "availability") in skeys
+    assert ("status", "availability") in skeys
+    assert ("worst", None) in skeys
+
+
+def test_stats_registry_drift_guard(pair):
+    """Tier-1 drift guard: every counter/gauge/timing name registered in
+    the live StatsClient reaches the /metrics exposition — so a future PR
+    cannot add a stat that silently never becomes scrapeable."""
+    from pilosa_tpu.utils.stats import _split_key
+    servers, uris = pair
+    snap = servers[0].stats.snapshot()
+    assert snap.get("counts"), "live server should have counted something"
+    with urllib.request.urlopen(uris[0] + "/metrics", timeout=10) as r:
+        text = r.read().decode()
+    _, samples = check_conformance(text)
+    names = {n for n, _, _ in samples}
+    for key in snap.get("counts", {}):
+        fam, _ = _split_key(key)
+        assert f"pilosa_{fam}_total" in names, \
+            f"registered counter {key!r} missing from /metrics"
+    for key in snap.get("gauges", {}):
+        fam, _ = _split_key(key)
+        assert f"pilosa_{fam}" in names, \
+            f"registered gauge {key!r} missing from /metrics"
+    for key in snap.get("timings", {}):
+        fam, _ = _split_key(key)
+        assert f"pilosa_{fam}_count" in names, \
+            f"registered timing {key!r} missing from /metrics"
+    for key in snap.get("sets", {}):
+        fam, _ = _split_key(key)
+        assert f"pilosa_{fam}_cardinality" in names, \
+            f"registered set {key!r} missing from /metrics"
+
+
 def test_metrics_endpoint_without_stats_client(pair):
     """A handler with no stats wired still answers 200 with an empty
     (legal) exposition."""

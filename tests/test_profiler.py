@@ -109,9 +109,9 @@ def test_coalescer_entries_carry_trace_and_profile_flags():
     fc = FakeClient()
     co = NodeCoalescer(fc, window_s=0.0)
     co._compute(("http://n1:1",), [
-        ("idx", "q1", None, None, "trace-A", True),
-        ("idx", "q2", None, 1.5, None, False),
-        ("idx", "q1", None, None, "trace-B", False),  # dedup of q1
+        ("idx", "q1", None, None, "trace-A", True, "key:a"),
+        ("idx", "q2", None, 1.5, None, False, None),
+        ("idx", "q1", None, None, "trace-B", False, "key:b"),  # dedup of q1
     ])
     entries = fc.batch_calls[0]
     assert len(entries) == 2  # q1 deduped
