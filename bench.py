@@ -1093,6 +1093,226 @@ def bench_accounting(tmpdir) -> dict:
         srv.close()
 
 
+QOS_CLIENTS = int(os.environ.get("PILOSA_BENCH_QOS_CLIENTS", "64"))
+QOS_QPC = int(os.environ.get("PILOSA_BENCH_QOS_QPC", "8"))
+QOS_ROUNDS = int(os.environ.get("PILOSA_BENCH_QOS_ROUNDS", "3"))
+QOS_ABUSERS = int(os.environ.get("PILOSA_BENCH_QOS_ABUSERS", "8"))
+
+
+def bench_qos(tmpdir) -> dict:
+    """Multi-tenant QoS chaos-storm A/B (pilosa_tpu/qos.py).
+
+    (a) idle-path admission overhead: interleaved mode=off/enforce rounds
+        with no quota pressure — the admission check runs and admits
+        every query. Budget: <= 1% on the median latency.
+    (b) abusive-tenant isolation: QOS_CLIENTS well-behaved interactive
+        clients measured alone (baseline p99), then again while
+        QOS_ABUSERS threads flood batch-priority queries under a
+        quota'd principal. Acceptance: the well-behaved p99 moves
+        <= 15%, and the abuser's rejections are EARLY 429s carrying
+        Retry-After (median rejection latency far below a query's own
+        service time), not late timeouts."""
+    import http.client
+    import statistics
+    import threading
+
+    from pilosa_tpu.server import Server
+
+    srv = Server(os.path.join(tmpdir, "qos"), port=0, qos_mode="enforce",
+                 qos_principals={
+                     "key:abuser": {"priority": "batch",
+                                    "queries-per-s": 50.0}}).open()
+    try:
+        hostport = srv.uri.split("//", 1)[1]
+        _local = threading.local()
+
+        def post(path, body, key, priority=None):
+            conn = getattr(_local, "conn", None)
+            if conn is None:
+                conn = _local.conn = http.client.HTTPConnection(
+                    hostport, timeout=60)
+            headers = {"X-API-Key": key}
+            if priority:
+                headers["X-Pilosa-Priority"] = priority
+            try:
+                conn.request("POST", path, body=body, headers=headers)
+                resp = conn.getresponse()
+                out = resp.read()
+            except (http.client.HTTPException, OSError):
+                conn.close()
+                conn = _local.conn = http.client.HTTPConnection(
+                    hostport, timeout=60)
+                conn.request("POST", path, body=body, headers=headers)
+                resp = conn.getresponse()
+                out = resp.read()
+            return resp, out
+
+        def must(path, body, key):
+            resp, out = post(path, body, key)
+            if resp.status != 200:
+                raise RuntimeError(f"{path}: {resp.status}: {out[:200]}")
+            return out
+
+        must("/index/qs", b"{}", "setup")
+        must("/index/qs/field/f", b"{}", "setup")
+        rng = np.random.default_rng(47)
+        cols = rng.choice(4 * SHARD_WIDTH, size=100_000, replace=False)
+        half = len(cols) // 2
+        must("/index/qs/field/f/import", json.dumps({
+            "rowIDs": [0] * half + [1] * (len(cols) - half),
+            "columnIDs": cols.tolist()}).encode(), "setup")
+        q = b"Count(Intersect(Row(f=0), Row(f=1)))"
+        for _ in range(5):
+            must("/index/qs/query", q, "warm")
+
+        # -- (a) admission-check overhead A/B (no pressure) --------------
+        def overhead_round(mode: str) -> float:
+            srv.qos.mode = mode
+            lats: list[float] = []
+            lock = threading.Lock()
+            barrier = threading.Barrier(QOS_CLIENTS)
+
+            def client(i):
+                mine = []
+                barrier.wait()
+                for _ in range(QOS_QPC):
+                    t0 = time.perf_counter()
+                    must("/index/qs/query", q, f"good-{i}")
+                    mine.append((time.perf_counter() - t0) * 1e3)
+                with lock:
+                    lats.extend(mine)
+
+            ts = [threading.Thread(target=client, args=(i,))
+                  for i in range(QOS_CLIENTS)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+            return statistics.median(lats)
+
+        overhead_rounds = []
+        for _ in range(QOS_ROUNDS):
+            rnd = {"ms_off": round(overhead_round("off"), 4),
+                   "ms_on": round(overhead_round("enforce"), 4)}
+            rnd["overhead_pct"] = round(
+                100.0 * (rnd["ms_on"] / rnd["ms_off"] - 1.0), 2) \
+                if rnd["ms_off"] else 0.0
+            overhead_rounds.append(rnd)
+        overheads = sorted(r["overhead_pct"] for r in overhead_rounds)
+
+        # -- (b) abusive tenant vs well-behaved p99 ----------------------
+        def p99(vals):
+            vals = sorted(vals)
+            return vals[min(len(vals) - 1, int(0.99 * len(vals)))]
+
+        def storm_round(with_abuser: bool):
+            srv.qos.mode = "enforce"
+            lats: list[float] = []
+            shed_lats: list[float] = []
+            abuser_codes = {"200": 0, "429": 0, "other": 0}
+            retry_after_present = 0
+            lock = threading.Lock()
+            stop = threading.Event()
+
+            def good(i):
+                mine = []
+                for _ in range(QOS_QPC):
+                    t0 = time.perf_counter()
+                    must("/index/qs/query", q, f"good-{i}")
+                    mine.append((time.perf_counter() - t0) * 1e3)
+                with lock:
+                    lats.extend(mine)
+
+            def abuser():
+                nonlocal retry_after_present
+                while not stop.is_set():
+                    t0 = time.perf_counter()
+                    resp, _out = post("/index/qs/query", q, "abuser",
+                                      priority="batch")
+                    dt = (time.perf_counter() - t0) * 1e3
+                    with lock:
+                        if resp.status == 429:
+                            abuser_codes["429"] += 1
+                            shed_lats.append(dt)
+                            if resp.getheader("Retry-After"):
+                                retry_after_present += 1
+                        elif resp.status == 200:
+                            abuser_codes["200"] += 1
+                        else:
+                            abuser_codes["other"] += 1
+
+            abuser_threads = []
+            if with_abuser:
+                for _ in range(QOS_ABUSERS):
+                    t = threading.Thread(target=abuser, daemon=True)
+                    t.start()
+                    abuser_threads.append(t)
+                # warm the storm to steady state: the abuser's token
+                # bucket opens with a full burst, and measuring during
+                # that window would compare against an unthrottled
+                # flood the quota has not engaged on yet
+                deadline = time.monotonic() + 5.0
+                while time.monotonic() < deadline:
+                    with lock:
+                        if abuser_codes["429"] >= 1:
+                            break
+                    time.sleep(0.05)
+                with lock:
+                    shed_lats.clear()
+            ts = [threading.Thread(target=good, args=(i,))
+                  for i in range(QOS_CLIENTS)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+            stop.set()
+            for t in abuser_threads:
+                t.join(timeout=5)
+            out = {"p99_ms": round(p99(lats), 3),
+                   "p50_ms": round(statistics.median(lats), 3)}
+            if with_abuser:
+                out["abuser"] = dict(abuser_codes)
+                out["abuserRetryAfterPresent"] = retry_after_present
+                if shed_lats:
+                    out["shed_p50_ms"] = round(
+                        statistics.median(shed_lats), 3)
+            return out
+
+        storm_rounds = []
+        for _ in range(QOS_ROUNDS):
+            base = storm_round(False)
+            storm = storm_round(True)
+            delta = (100.0 * (storm["p99_ms"] / base["p99_ms"] - 1.0)
+                     if base["p99_ms"] else 0.0)
+            storm_rounds.append({"baseline": base, "storm": storm,
+                                 "p99_delta_pct": round(delta, 2)})
+        deltas = sorted(r["p99_delta_pct"] for r in storm_rounds)
+        snap = srv.qos.snapshot()
+        last = storm_rounds[-1]["storm"]
+        return {
+            "metric": "qos_p99_delta_pct",
+            "value": deltas[len(deltas) // 2],
+            "unit": "% (well-behaved p99, abuser storm vs baseline, "
+                    "enforce; budget <= 15%)",
+            "admission_overhead_pct": overheads[len(overheads) // 2],
+            "admission_overhead_rounds": overhead_rounds,
+            "storm_rounds": storm_rounds,
+            "abuser_throttled_429": last.get("abuser", {}).get("429", 0),
+            "abuser_retry_after_present":
+                last.get("abuserRetryAfterPresent", 0),
+            "shed_p50_ms": last.get("shed_p50_ms", 0.0),
+            "sheds_counted": snap["throttled"],
+            "vs_baseline": 0.0,
+            "path": f"{QOS_CLIENTS} interactive keep-alive clients x "
+                    f"{QOS_QPC} Count(Intersect) vs {QOS_ABUSERS} "
+                    "batch-priority abuser threads under a 50 q/s quota; "
+                    "interleaved baseline/storm rounds + mode off/enforce "
+                    "idle-path A/B",
+        }
+    finally:
+        srv.close()
+
+
 PLANNER_SHARDS = 8
 PLANNER_CLIENTS = int(os.environ.get("PILOSA_BENCH_PLANNER_CLIENTS", "256"))
 PLANNER_ROUNDS = int(os.environ.get("PILOSA_BENCH_PLANNER_ROUNDS", "3"))
@@ -1603,6 +1823,7 @@ def worker() -> None:
         stage("profiler", bench_profiler, tmp)
         stage("telemetry", bench_telemetry, tmp)
         stage("accounting", bench_accounting, tmp)
+        stage("qos", bench_qos, tmp)
         stage("planner", bench_planner, tmp)
         stage("distributed", bench_distributed, tmp)
     finally:

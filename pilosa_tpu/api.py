@@ -36,9 +36,10 @@ from pilosa_tpu.parallel.cluster import (
     STATE_STARTING,
     Cluster,
 )
+from pilosa_tpu import qos
 from pilosa_tpu.utils import accounting
 from pilosa_tpu.utils import profile as qprofile
-from pilosa_tpu.utils import tracing
+from pilosa_tpu.utils import qctx, tracing
 from pilosa_tpu.utils.translate import TranslateStore
 
 
@@ -150,6 +151,12 @@ class API:
         self.trace_exporter = None
         # federation hook for GET /cluster/usage (Server.cluster_usage)
         self.cluster_usage_fn = None
+        # multi-tenant QoS plane (pilosa_tpu/qos.py QosPlane); set by
+        # Server. The HTTP layer runs admission against it; here it
+        # collects execution-boundary sheds (expired deadlines — local
+        # and remote envelope entries — and doomed-cost sheds) and the
+        # per-class service-cost observations its estimates feed on.
+        self.qos_plane = None
 
     def _broadcast(self, msg: dict) -> None:
         if self.broadcast_fn is not None:
@@ -216,6 +223,29 @@ class API:
                     f"too many writes in a single request: {writes} > "
                     f"{self.max_writes_per_request}")
         import time as _time
+        # QoS execution-boundary checks (pilosa_tpu/qos.py). (1) A query
+        # whose deadline ALREADY expired is shed here — before planning,
+        # residency uploads or any device dispatch. Remote envelope
+        # entries hit this with the coordinator's shrunken budget, so a
+        # doomed distributed query stops burning device time on every
+        # node it fanned to. (2) Under enforce, a query whose class's
+        # observed device cost alone exceeds the remaining budget is
+        # shed as doomed (503 + code so clients back off, not retry-storm).
+        plane = self.qos_plane
+        rem = qctx.remaining()
+        if rem is not None and rem <= 0:
+            if plane is not None:
+                plane.record_expired(remote)
+            raise qctx.QueryTimeoutError("query deadline exceeded")
+        if (plane is not None and plane.mode == "enforce" and not remote
+                and rem is not None):
+            est_ms = plane.class_cost_ms(accounting.classify_query(query))
+            if est_ms > 0 and rem * 1e3 < est_ms:
+                plane.record_cost_shed()
+                raise ApiError(
+                    f"query shed: estimated cost {est_ms:.0f} ms exceeds "
+                    f"remaining deadline {rem * 1e3:.0f} ms",
+                    status=503, code="shed")
         profiling = self._should_profile(profile)
         slow_armed = self.long_query_time > 0
         trace_tok = None
@@ -233,6 +263,19 @@ class API:
                 trace_id=tracing.current_trace_id.get() or "",
                 node_id=self.cluster.local_id, index=index_name,
                 pql=qprofile.truncate_pql(pql))
+            pr = qos.current_priority.get() if qos.enabled() else None
+            if pr is not None or plane is not None:
+                # QoS ride-along on the profile tree: the class this
+                # query ran under, its deadline budget at execution, and
+                # the admission-time wait estimate it beat
+                prof.qos = {
+                    "priority": pr or (plane.default_priority
+                                       if plane is not None else None),
+                    "deadlineMs": (round(rem * 1e3, 1)
+                                   if rem is not None else None),
+                    "estimatedWaitMs": (round(plane.estimated_wait_ms(), 3)
+                                        if plane is not None else None),
+                }
             prof_tok = qprofile.current_profile.set(prof)
         start = _time.perf_counter()
         ok = False
@@ -268,9 +311,14 @@ class API:
             # SLO observation by query class; coordinator-side only —
             # remote sub-requests are an implementation detail of the
             # same user-visible query and must not dilute the objective
-            if self.slo is not None and not remote:
-                self.slo.observe(accounting.classify_query(query),
-                                 elapsed, ok)
+            if not remote:
+                qclass = accounting.classify_query(query)
+                if self.slo is not None:
+                    self.slo.observe(qclass, elapsed, ok)
+                if plane is not None and ok:
+                    # per-class device-cost EWMA: what the doomed-query
+                    # shed and the admission wait estimate are fed by
+                    plane.observe_service(qclass, elapsed * 1e3)
             if (prof is not None and not remote
                     and self.trace_exporter is not None):
                 # coordinator-only export: the finished tree already
@@ -345,6 +393,7 @@ class API:
             dl_token = None
             tr_token = None
             acct_token = None
+            prio_token = None
             try:
                 timeout = e.get("timeout")
                 if timeout is not None:
@@ -375,6 +424,12 @@ class API:
                         accounting.Account(self.usage_ledger,
                                            accounting._sanitize(
                                                str(principal))))
+                priority = e.get("priority")
+                if priority and qos.enabled():
+                    # per-entry QoS priority (trace id / principal twin):
+                    # this entry's device batcher cuts and pool submits
+                    # order under the ORIGINAL caller's class
+                    prio_token = qos.current_priority.set(str(priority))
                 pql = e.get("query", "")
                 query = parse_string_cached(pql)
                 for c in query.calls:
@@ -406,6 +461,8 @@ class API:
                     tracing.current_trace_id.reset(tr_token)
                 if acct_token is not None:
                     accounting.current_account.reset(acct_token)
+                if prio_token is not None:
+                    qos.current_priority.reset(prio_token)
 
         if len(entries) <= 1:
             return [one(e) for e in entries]

@@ -85,6 +85,33 @@ class QueryConfig:
 
 
 @dataclass
+class QosConfig:
+    """[qos] — multi-tenant QoS plane (pilosa_tpu/qos.py;
+    docs/operations.md "Overload control and QoS").
+
+    mode: "off" (default — no admission, no behavior change), "observe"
+    (count + log every would-shed/would-throttle decision without
+    rejecting: the safe rollout step), "enforce". default-priority is the
+    class untagged requests run as; default-deadline (seconds/duration, 0
+    = none) gives every query a budget so deadline shedding can act.
+    queries-per-s / device-ms-per-s / bytes-per-s are the DEFAULT
+    per-principal quotas (0 = unlimited); burst is the bucket depth in
+    seconds of rate. Per-principal overrides (any quota key plus
+    `priority`) live in [qos.principals."<principal>"] sub-tables keyed
+    by the accounting principal (e.g. "key:dashboards").
+    PILOSA_TPU_QOS=0 is the env kill switch over everything."""
+    mode: str = "off"
+    default_priority: str = "interactive"
+    default_deadline: float = 0.0
+    queries_per_s: float = 0.0
+    device_ms_per_s: float = 0.0
+    bytes_per_s: float = 0.0
+    burst: float = 2.0
+    max_principals: int = 256
+    principals: dict = field(default_factory=dict)
+
+
+@dataclass
 class StorageConfig:
     """[storage] — durability knobs (docs/operations.md "Failure modes and
     recovery"). wal-fsync: "off" (default; matches the reference, which
@@ -188,6 +215,11 @@ class GossipSection:
     period: float = 1.0
     probe_timeout: float = 0.5
     push_pull_interval: float = 10.0
+    # shared-key transport encryption (parallel/gossip.py): a non-empty
+    # secret AES-GCM-encrypts every gossip datagram (key derived by
+    # blake2b from this passphrase); nodes without the key — and
+    # plaintext datagrams when a key is set — are silently dropped.
+    secret: str = ""
 
 
 @dataclass
@@ -227,6 +259,7 @@ class Config:
     verbose: bool = False
     tls: TLSConfig = field(default_factory=TLSConfig)
     query: QueryConfig = field(default_factory=QueryConfig)
+    qos: QosConfig = field(default_factory=QosConfig)
     slo: SLOConfig = field(default_factory=SLOConfig)
     cluster: ClusterConfig = field(default_factory=ClusterConfig)
     storage: StorageConfig = field(default_factory=StorageConfig)
@@ -256,7 +289,7 @@ class Config:
     def _apply_dict(self, data: dict) -> None:
         for key, value in data.items():
             attr = key.replace("-", "_")
-            if attr in ("tls", "query", "slo", "cluster", "storage", "anti_entropy", "metric", "diagnostics", "tracing", "mesh", "gossip") and isinstance(value, dict):
+            if attr in ("tls", "query", "qos", "slo", "cluster", "storage", "anti_entropy", "metric", "diagnostics", "tracing", "mesh", "gossip") and isinstance(value, dict):
                 sub = getattr(self, attr)
                 for k, v in value.items():
                     sk = k.replace("-", "_")
@@ -278,7 +311,7 @@ class Config:
 
     def _set_path(self, parts: list[str], raw: str) -> None:
         # try sub-config first (cluster_replicas -> cluster.replicas)
-        for sub_name in ("tls", "query", "slo", "cluster", "storage", "anti_entropy", "metric", "diagnostics", "tracing", "mesh", "gossip"):
+        for sub_name in ("tls", "query", "qos", "slo", "cluster", "storage", "anti_entropy", "metric", "diagnostics", "tracing", "mesh", "gossip"):
             sub_parts = sub_name.split("_")
             if parts[: len(sub_parts)] == sub_parts and len(parts) > len(sub_parts):
                 sub = getattr(self, sub_name)
@@ -287,6 +320,14 @@ class Config:
                     setattr(sub, attr, _coerce(raw, getattr(sub, attr)))
                 return
         attr = "_".join(parts)
+        if attr in ("tls", "query", "qos", "slo", "cluster", "storage",
+                    "anti_entropy", "metric", "diagnostics", "tracing",
+                    "mesh", "gossip"):
+            # a bare section name is never a config path — notably
+            # PILOSA_TPU_QOS=0 is the runtime kill switch (read by
+            # pilosa_tpu/qos.py per call), and coercing it here would
+            # clobber the whole [qos] section object with a string
+            return
         if hasattr(self, attr):
             setattr(self, attr, _coerce(raw, getattr(self, attr)))
 
@@ -317,6 +358,24 @@ class Config:
             "[query]",
             f'plan = "{self.query.plan}"',
             f"plan-cache-bytes = {self.query.plan_cache_bytes}",
+            "",
+            "[qos]",
+            f'mode = "{self.qos.mode}"',
+            f'default-priority = "{self.qos.default_priority}"',
+            f"default-deadline = {self.qos.default_deadline}",
+            f"queries-per-s = {self.qos.queries_per_s}",
+            f"device-ms-per-s = {self.qos.device_ms_per_s}",
+            f"bytes-per-s = {self.qos.bytes_per_s}",
+            f"burst = {self.qos.burst}",
+            f"max-principals = {self.qos.max_principals}",
+            *[line
+              for pname, over in self.qos.principals.items()
+              for line in (
+                  "",
+                  f'[qos.principals."{pname}"]',
+                  *(f"{str(k).replace('_', '-')} = "
+                    + (f'"{v}"' if isinstance(v, str) else str(v))
+                    for k, v in over.items()))],
             "",
             "[slo]",
             f"read-latency-ms = {self.slo.read_latency_ms}",
@@ -368,6 +427,7 @@ class Config:
             f"period = {self.gossip.period}",
             f"probe-timeout = {self.gossip.probe_timeout}",
             f"push-pull-interval = {self.gossip.push_pull_interval}",
+            f'secret = "{self.gossip.secret}"',
             "",
             "[mesh]",
             f'devices = "{self.mesh.devices}"',

@@ -178,6 +178,16 @@ def cmd_server(args) -> int:
         slo_burn_red=cfg.slo.burn_red,
         slo_window_short=cfg.slo.window_short,
         slo_window_long=cfg.slo.window_long,
+        qos_mode=cfg.qos.mode,
+        qos_default_priority=cfg.qos.default_priority,
+        qos_default_deadline=cfg.qos.default_deadline,
+        qos_queries_per_s=cfg.qos.queries_per_s,
+        qos_device_ms_per_s=cfg.qos.device_ms_per_s,
+        qos_bytes_per_s=cfg.qos.bytes_per_s,
+        qos_burst=cfg.qos.burst,
+        qos_max_principals=cfg.qos.max_principals,
+        qos_principals=cfg.qos.principals,
+        gossip_secret=cfg.gossip.secret,
         log_format=cfg.log_format,
         diagnostics_url=cfg.diagnostics.url,
         diagnostics_interval=cfg.diagnostics.interval,

@@ -236,6 +236,16 @@ class Serializer:
                 # without it, remote spans of a coalesced query start a
                 # fresh trace instead of joining the coordinator's
                 entry["traceId"] = str(e["traceId"])
+            if e.get("principal"):
+                # per-entry principal (the trace id's twin): the remote
+                # charges each entry's work to its ORIGINAL caller, not
+                # to whichever caller led the envelope
+                entry["principal"] = str(e["principal"])
+            if e.get("priority"):
+                # per-entry QoS class (pilosa_tpu/qos.py): the remote's
+                # batchers/pools order this entry under its caller's
+                # priority instead of the envelope leader's
+                entry["priority"] = str(e["priority"])
             if e.get("profile"):
                 entry["profile"] = True
             out.append(entry)

@@ -213,9 +213,20 @@ class Executor:
             return pool
         with self._pool_lock:
             if getattr(self, attr) is None:
-                from concurrent.futures import ThreadPoolExecutor
-                setattr(self, attr, ThreadPoolExecutor(
-                    max_workers=size, thread_name_prefix=name))
+                # fan-out + inbound-envelope pools are priority-ordered
+                # (pilosa_tpu/qos.py PriorityPool): under saturation a
+                # batch tenant's submits queue behind interactive ones.
+                # With one priority class it degrades to FIFO, and the
+                # kill switch falls back to the plain executor.
+                from pilosa_tpu import qos
+                if attr in ("_fanout_pool", "_batch_exec_pool") \
+                        and qos.enabled():
+                    setattr(self, attr, qos.PriorityPool(
+                        size, thread_name_prefix=name))
+                else:
+                    from concurrent.futures import ThreadPoolExecutor
+                    setattr(self, attr, ThreadPoolExecutor(
+                        max_workers=size, thread_name_prefix=name))
             return getattr(self, attr)
 
     @property

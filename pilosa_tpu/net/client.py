@@ -11,21 +11,71 @@ from __future__ import annotations
 import base64
 import http.client
 import json
+import random
 import socket
 import ssl
 import threading
+import time
 import urllib.parse
 from typing import Optional
 
+from pilosa_tpu import qos
 from pilosa_tpu.utils import accounting, failpoints, qctx, tracing
 from pilosa_tpu.utils import profile as qprofile
 
+# backpressure handling (the QoS plane's 429/503 + Retry-After contract):
+# how many times one logical RPC re-issues after a backpressure rejection,
+# and the ceiling on how long it will honor a peer's Retry-After before
+# giving the error back to the caller (whose own failover takes over)
+BACKPRESSURE_RETRIES = 2
+RETRY_AFTER_CAP_S = 2.0
+
+
+def parse_retry_after(value: Optional[str]) -> Optional[float]:
+    """Retry-After header -> seconds, or None when absent/garbage.
+
+    Accepts both RFC 7231 forms: delta-seconds ("3", "1.5" tolerated) and
+    an HTTP-date (converted to a remaining delta, floored at 0). Garbage
+    returns None — an unparseable hint must not produce a sleep."""
+    if not value:
+        return None
+    value = value.strip()
+    try:
+        return max(0.0, float(value))
+    except ValueError:
+        pass
+    from email.utils import parsedate_to_datetime
+    try:
+        dt = parsedate_to_datetime(value)
+    except (TypeError, ValueError):
+        return None
+    if dt is None:
+        return None
+    from datetime import datetime, timezone
+    if dt.tzinfo is None:
+        dt = dt.replace(tzinfo=timezone.utc)
+    return max(0.0, (dt - datetime.now(timezone.utc)).total_seconds())
+
+
+def backoff_delay(retry_after: float, cap: float = RETRY_AFTER_CAP_S,
+                  rng=random.random) -> float:
+    """Capped jittered backoff: honor the peer's Retry-After up to `cap`
+    seconds, multiplied into [0.5, 1.0]x so a herd of throttled callers
+    does not re-arrive in one synchronized burst."""
+    base = min(max(retry_after, 0.05), cap)
+    return base * (0.5 + 0.5 * rng())
+
 
 class ClientError(Exception):
-    def __init__(self, msg: str, status: int = 0, code: str = ""):
+    def __init__(self, msg: str, status: int = 0, code: str = "",
+                 retry_after: Optional[float] = None):
         super().__init__(msg)
         self.status = status
         self.code = code  # machine-readable ApiError.code from the peer
+        # parsed Retry-After seconds on a 429/503 backpressure rejection
+        # (None otherwise): drives the capped jittered retry below, and
+        # callers that give up can surface it to THEIR callers
+        self.retry_after = retry_after
 
 
 class InternalClient:
@@ -50,6 +100,35 @@ class InternalClient:
                  content_type: str = "application/json",
                  accept: Optional[str] = None,
                  timeout: Optional[float] = None) -> bytes:
+        """One logical RPC, honoring peer backpressure: a 429/503 that
+        carries Retry-After is a DELIBERATE pre-execution rejection from
+        the peer's QoS admission (it never reached a handler, so a
+        re-send cannot double side effects), retried after a capped
+        jittered sleep — bounded by the caller's remaining deadline, so
+        backing off never converts a rejection into a blown budget. Any
+        other error propagates unchanged; so does the final rejection
+        when the retries are spent (callers fail over per shard)."""
+        for bp_attempt in range(BACKPRESSURE_RETRIES + 1):
+            try:
+                return self._request_once(method, uri, path, body=body,
+                                          content_type=content_type,
+                                          accept=accept, timeout=timeout)
+            except ClientError as e:
+                if (e.status not in (429, 503) or e.retry_after is None
+                        or bp_attempt >= BACKPRESSURE_RETRIES):
+                    raise
+                delay = backoff_delay(e.retry_after)
+                rem = qctx.remaining()
+                if rem is not None and delay >= rem:
+                    raise  # no budget left to wait out the backpressure
+                time.sleep(delay)
+        raise AssertionError("unreachable")  # pragma: no cover
+
+    def _request_once(self, method: str, uri: str, path: str,
+                      body: Optional[bytes] = None,
+                      content_type: str = "application/json",
+                      accept: Optional[str] = None,
+                      timeout: Optional[float] = None) -> bytes:
         headers = {"Content-Type": content_type} if body is not None else {}
         if accept:
             headers["Accept"] = accept
@@ -62,6 +141,12 @@ class InternalClient:
             # how the trace id propagates: remote work is charged to the
             # original caller, not to this node (utils/accounting.py)
             headers[accounting.PRINCIPAL_HEADER] = acct.principal
+        priority = qos.current_priority.get() if qos.enabled() else None
+        if priority:
+            # the QoS priority class fans out with the query (the
+            # principal header's twin): the remote orders this RPC's
+            # work under the original caller's class
+            headers[qos.PRIORITY_HEADER] = priority
         sock_timeout = timeout if timeout is not None else self.timeout
         rem = qctx.remaining()
         if rem is not None:
@@ -146,7 +231,9 @@ class InternalClient:
                 except (ValueError, AttributeError):
                     pass
                 raise ClientError(f"{method} {path}: {resp.status}: {detail}",
-                                  status=resp.status, code=code)
+                                  status=resp.status, code=code,
+                                  retry_after=parse_retry_after(
+                                      resp.getheader("Retry-After")))
             return data
 
     def _conn_for(self, key: tuple, sock_timeout: float):

@@ -38,6 +38,7 @@ import threading
 import time
 from typing import Optional
 
+from pilosa_tpu import qos
 from pilosa_tpu.net.client import ClientError
 from pilosa_tpu.parallel.batcher import ContinuousBatcher
 from pilosa_tpu.utils import accounting, qctx, tracing
@@ -109,7 +110,8 @@ class NodeCoalescer(ContinuousBatcher):
                                    tracing.current_trace_id.get(),
                                    prof is not None,
                                    acct.principal if acct is not None
-                                   else None))
+                                   else None,
+                                   qos.current_priority.get()))
         if out is _FALLBACK:
             with self._meta_lock:
                 self.fallback_queries += 1
@@ -171,7 +173,8 @@ class NodeCoalescer(ContinuousBatcher):
         slots: list[int] = []
         uniq: dict[tuple, int] = {}
         entries: list[dict] = []
-        for (i, q, s, rem, trace_id, want_prof, principal) in payloads:
+        for (i, q, s, rem, trace_id, want_prof, principal,
+             priority) in payloads:
             k = (i, q, tuple(s) if s is not None else None)
             at = uniq.get(k)
             if at is None:
@@ -190,6 +193,11 @@ class NodeCoalescer(ContinuousBatcher):
                      # trace id): the remote charges this entry's work to
                      # the ORIGINAL caller, not to the envelope leader
                      **({"principal": principal} if principal else {}),
+                     # per-entry QoS priority (pilosa_tpu/qos.py): the
+                     # remote installs it before executing, so its device
+                     # batchers and pool order the entry's work under the
+                     # original caller's class, not the leader's
+                     **({"priority": priority} if priority else {}),
                      **({"profile": True} if want_prof else {})})
             else:
                 if rem is not None and "timeout" in entries[at]:
@@ -201,6 +209,12 @@ class NodeCoalescer(ContinuousBatcher):
                     # any profiled dup makes the shared execution profiled
                     # (unprofiled dups just ignore the fragment)
                     entries[at]["profile"] = True
+                if priority and qos.priority_level(priority) < \
+                        qos.priority_level(entries[at].get("priority")):
+                    # deduped followers share one remote execution; it
+                    # runs at the MOST urgent class among them (a batch
+                    # dup must not drag an interactive caller down)
+                    entries[at]["priority"] = priority
             slots.append(at)
         # the send runs with the ENVELOPE's deadline — the loosest of the
         # entries' budgets — not the leader's own: the leader is just

@@ -120,6 +120,10 @@ const LOCAL_SERIES = [
   ["plancache.hit_rate", "plan-cache hit rate (window)", fmtRatio],
   ["planner.reorders_per_s", "planner reorders / s", fmtNum],
   ["usage.queries_per_s", "accounted queries / s", fmtNum],
+  ["qos.admitted_per_s", "QoS admitted / s", fmtNum],
+  ["qos.shed_per_s", "QoS shed / s", fmtNum],
+  ["qos.throttled_per_s", "QoS throttled (429) / s", fmtNum],
+  ["qos.estimated_wait_ms", "QoS est. wait ms", fmtNum],
   ["fanout.queued", "fan-out queued", fmtNum],
   ["xla.compiles_per_s", "XLA compiles / s", fmtNum],
   ["wal.bytes", "storage+WAL bytes", fmtBytes],

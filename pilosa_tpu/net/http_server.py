@@ -14,6 +14,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Optional
 from urllib.parse import parse_qs, urlparse
 
+from pilosa_tpu import qos
 from pilosa_tpu.api import API, ApiError
 from pilosa_tpu.encoding.protobuf import CONTENT_TYPE as PROTO_CONTENT_TYPE
 from pilosa_tpu.encoding.protobuf import Serializer
@@ -97,12 +98,17 @@ class Handler:
 
     def __init__(self, api: API,
                  cluster_message_fn: Optional[Callable[[dict], None]] = None,
-                 stats=None, query_timeout: float = 0.0, telemetry=None):
+                 stats=None, query_timeout: float = 0.0, telemetry=None,
+                 qos_plane=None):
         self.api = api
         self.cluster_message_fn = cluster_message_fn
         self.stats = stats
         self.query_timeout = query_timeout  # [cluster] query-timeout default
         self.telemetry = telemetry  # TelemetrySampler (GET /debug/timeseries)
+        # multi-tenant QoS plane (pilosa_tpu/qos.py): admission control —
+        # quotas, priority resolution, deadline-aware shedding — runs here
+        # at dispatch, BEFORE parse. None = no admission (plumbing only).
+        self.qos = qos_plane
         self.errors_5xx = 0  # cumulative 5xx responses (health-score input)
         self.serializer = Serializer()
         self._local = threading.local()
@@ -159,12 +165,33 @@ class Handler:
         # from its coordinator. One contextvar set; charge sites are nop
         # when accounting is off.
         acct_token = None
+        principal = None
         ledger = getattr(self.api, "usage_ledger", None)
         if ledger is not None and ledger.enabled and accounting.enabled():
+            principal = accounting.principal_from_headers(headers,
+                                                          client_addr)
             acct_token = accounting.current_account.set(
-                accounting.Account(
-                    ledger,
-                    accounting.principal_from_headers(headers, client_addr)))
+                accounting.Account(ledger, principal))
+        # QoS priority install (pilosa_tpu/qos.py): header value, or the
+        # principal's [qos.principals] override, or the [qos] default
+        # class — one contextvar set carried by every batcher cut, pool
+        # submit and fan-out RPC this request makes. Plumbing works even
+        # without a plane (header-only), and the kill switch drops it all.
+        prio_token = None
+        plane = self.qos
+        hdr_priority = (headers or {}).get(qos.PRIORITY_HEADER) \
+            if headers is not None and hasattr(headers, "get") else None
+        if qos.enabled() and (plane is not None or hdr_priority):
+            if plane is not None:
+                if principal is None:
+                    principal = accounting.principal_from_headers(
+                        headers, client_addr)
+                pname = plane.priority_for(hdr_priority, principal)
+            else:
+                pname = (hdr_priority or "").strip().lower()
+                pname = pname if pname in qos.PRIORITIES else None
+            if pname:
+                prio_token = qos.current_priority.set(pname)
         try:
             for m, rx, name in ROUTES:
                 if m != method:
@@ -178,6 +205,8 @@ class Handler:
                         400, f"invalid query argument(s): {', '.join(sorted(unknown))}")
                 handler = getattr(self, name)
                 dl_token = None
+                qos_dl_token = None
+                qos_rejected = False
                 try:
                     # inside the try: an invalid ?timeout= must map to a
                     # clean 400 like any other ApiError, not escape dispatch
@@ -186,7 +215,39 @@ class Handler:
                     from pilosa_tpu.utils import failpoints
                     failpoints.hit("http.server.dispatch")
                     dl_token = self._set_deadline(name, query, headers)
-                    resp = handler(match.groupdict(), query, body)
+                    rej = None
+                    if (plane is not None and qos.enabled()
+                            and name == "post_query"
+                            and not self._qos_inherited(query, headers)):
+                        # [qos] default-deadline: every query gets a
+                        # budget even when the client sent none, so
+                        # deadline-aware shedding has something to shed
+                        # against. Never applied to inherited fan-out
+                        # entries — their budget is the coordinator's.
+                        if (plane.default_deadline > 0
+                                and qctx.deadline.get() is None):
+                            import time as _t
+                            qos_dl_token = qctx.deadline.set(
+                                _t.monotonic() + plane.default_deadline)
+                        # admission: quotas + deadline/health shedding,
+                        # BEFORE the body is even parsed
+                        rej = plane.admit(
+                            principal or "anonymous",
+                            qos.current_priority.get()
+                            or plane.default_priority,
+                            qctx.remaining())
+                    if rej is not None:
+                        qos_rejected = True
+                        st, ct, payload = self._error(
+                            rej.status, rej.message,
+                            code=("quota-exhausted" if rej.status == 429
+                                  else "shed"))
+                        resp = (st, ct, payload, {
+                            "Retry-After":
+                                qos.retry_after_header(rej.retry_after),
+                            "X-Pilosa-Shed-Reason": rej.reason})
+                    else:
+                        resp = handler(match.groupdict(), query, body)
                 except qctx.QueryTimeoutError as e:
                     resp = self._error(504, str(e))
                 except ApiError as e:
@@ -194,11 +255,16 @@ class Handler:
                 except Exception as e:  # noqa: BLE001 — surface as 500
                     resp = self._error(500, str(e))
                 finally:
+                    if qos_dl_token is not None:
+                        qctx.deadline.reset(qos_dl_token)
                     if dl_token is not None:
                         qctx.deadline.reset(dl_token)
-                if resp[0] >= 500:
+                if resp[0] >= 500 and not qos_rejected:
                     # server-error rate feeds the node health score (the
-                    # telemetry sampler derives errors/s from this)
+                    # telemetry sampler derives errors/s from this).
+                    # Deliberate QoS sheds are EXCLUDED: counting them
+                    # would raise the error rate, worsen health, and shed
+                    # harder — a self-amplifying feedback loop.
                     self.errors_5xx += 1
                     if self.stats is not None:
                         self.stats.count("http/serverErrors")
@@ -208,11 +274,27 @@ class Handler:
                 tracing.current_trace_id.reset(token)
             if acct_token is not None:
                 accounting.current_account.reset(acct_token)
+            if prio_token is not None:
+                qos.current_priority.reset(prio_token)
         if any(rx.match(path) for _, rx, _ in ROUTES):
             return 405, "application/json", b'{"error": "method not allowed"}'
         return 404, "application/json", b'{"error": "not found"}'
 
     # -- helpers ------------------------------------------------------------
+
+    @staticmethod
+    def _qos_inherited(query: dict, headers) -> bool:
+        """True when this query was fanned out BY a coordinator (the
+        ?remote= flag or the inherited-principal header an internal RPC
+        always carries): the coordinator already ran admission, and
+        re-admitting at every remote would multiply one user query's
+        quota charge by the fan-out width."""
+        vals = query.get("remote")
+        if vals and vals[0] in ("1", "true"):
+            return True
+        h = headers if headers is not None and hasattr(headers, "get") \
+            else {}
+        return bool(h.get(accounting.PRINCIPAL_HEADER))
 
     def _error(self, status: int, msg: str, code: str = ""):
         """Protobuf clients get errors as QueryResponse{Err} so they can
@@ -492,6 +574,10 @@ class Handler:
         slo = getattr(self.api, "slo", None)
         if slo is not None:
             snap["slo"] = slo.evaluate()
+        # multi-tenant QoS plane (pilosa_tpu/qos.py): admission verdicts
+        # per priority/reason/principal, the live wait estimate, mode
+        if self.qos is not None:
+            snap["qos"] = self.qos.snapshot()
         return self._json(snap)
 
     def get_query_history(self, params, query, body):
@@ -695,6 +781,13 @@ class Handler:
                 gauges[f"slo/status,objective:{name}"] = level
                 worst = max(worst, level)
             gauges["slo/worst"] = worst
+        # QoS admission families: the full priority/reason key space is
+        # emitted unconditionally (zeros included) like the planner and
+        # usage families, so "shed rate" alerts never race the first shed
+        if self.qos is not None:
+            qc, qg = self.qos.metrics_series()
+            counts.update(qc)
+            gauges.update(qg)
         if self.api.health_fn is not None:
             try:
                 score = self.api.health_fn()["score"]
@@ -887,12 +980,19 @@ class _RequestHandler(BaseHTTPRequestHandler):
         parsed = urlparse(self.path)
         length = int(self.headers.get("Content-Length", 0) or 0)
         body = self.rfile.read(length) if length else b""
-        status, ctype, payload = self.handler.dispatch(
+        out = self.handler.dispatch(
             method, parsed.path, parse_qs(parsed.query), body,
             headers=self.headers, client_addr=self.client_address[0])
+        # dispatch returns (status, ctype, payload[, extra-headers]) —
+        # the 4th element carries e.g. Retry-After on QoS rejections
+        status, ctype, payload = out[0], out[1], out[2]
+        extra = out[3] if len(out) > 3 else None
         self.send_response(status)
         self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(payload)))
+        if extra:
+            for k, v in extra.items():
+                self.send_header(k, v)
         self.end_headers()
         self.wfile.write(payload)
 

@@ -52,6 +52,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from pilosa_tpu import qos
 from pilosa_tpu.ops.bitvector import popcount
 from pilosa_tpu.utils import accounting
 from pilosa_tpu.utils import profile as qprofile
@@ -94,11 +95,15 @@ def _pow2(n: int) -> int:
 
 class _Req:
     __slots__ = ("payload", "event", "result", "exc", "promoted", "done",
-                 "server", "profile", "account", "t_submit")
+                 "server", "profile", "account", "t_submit", "priority")
 
     def __init__(self, payload):
         self.payload = payload
         self.t_submit = time.perf_counter()  # queue-wait telemetry anchor
+        # the submitter's QoS priority level (pilosa_tpu/qos.py): when the
+        # queue exceeds one batch, the cut is ordered by this — batch
+        # traffic waits out interactive traffic instead of starving it
+        self.priority = qos.current_level()
         self.event = threading.Event()
         self.result = None
         self.exc: Optional[BaseException] = None
@@ -245,6 +250,12 @@ class ContinuousBatcher:
                 time.sleep(0.0005)
         with self._lock:
             q = self._pending[key]
+            if len(q) > self.max_batch:
+                # QoS priority ordering at the cut — ONLY when the queue
+                # overflows one batch (inside a batch everyone is served
+                # together, so ordering is moot and the common case pays
+                # nothing). Stable sort: FIFO within a priority class.
+                q.sort(key=lambda r: r.priority)
             batch, q[:] = q[:self.max_batch], q[self.max_batch:]
             for r in batch:  # liveness anchor for followers (see _Req)
                 r.server = threading.current_thread()

@@ -19,6 +19,12 @@ write routing, and resize behave identically under either.
 
 Wire format: one JSON object per UDP datagram (control-plane rates make
 encoding cost irrelevant; JSON keeps datagrams debuggable with tcpdump).
+With a shared `secret_key` (memberlist's SecretKey analog), every
+datagram is AES-GCM sealed (utils/aesgcm.py: version byte + random
+96-bit nonce + ciphertext/tag) — a node without the key can neither read
+membership state nor inject it, and a keyed node silently drops both
+cleartext datagrams and any ciphertext that fails authentication
+(counted in `crypto_drops`; there is no downgrade path).
 Message types:
   ping      {t, seq, from}                 probe; answered with ack
   ack       {t, seq, from}
@@ -162,9 +168,17 @@ class Gossip:
                  on_alive: Optional[Callable[[Member], None]] = None,
                  on_suspect: Optional[Callable[[Member], None]] = None,
                  on_dead: Optional[Callable[[Member], None]] = None,
+                 secret_key: Optional[bytes] = None,
                  logger=None) -> None:
         self.node_id = node_id
         self.config = config or GossipConfig()
+        # shared-key transport encryption ([gossip] secret): every
+        # datagram sealed with AES-GCM; unauthenticated traffic dropped
+        self._cipher = None
+        if secret_key:
+            from pilosa_tpu.utils.aesgcm import AESGCM
+            self._cipher = AESGCM(secret_key)
+        self.crypto_drops = 0  # cleartext/forged/undecryptable datagrams
         self._meta = dict(meta or {})
         self.on_alive = on_alive
         self.on_suspect = on_suspect
@@ -319,6 +333,9 @@ class Gossip:
         if len(data) > _MAX_DATAGRAM:  # shed piggyback before giving up
             msg["updates"] = []
             data = json.dumps(msg).encode()
+        if self._cipher is not None:
+            from pilosa_tpu.utils.aesgcm import seal
+            data = seal(self._cipher, data)
         try:
             self._sock.sendto(data, addr)
         except OSError:
@@ -334,6 +351,18 @@ class Gossip:
                 continue
             except OSError:
                 return
+            if self._cipher is not None:
+                # keyed transport: ONLY authentic ciphertext is admitted.
+                # Cleartext (a mis-configured or pre-upgrade peer) and
+                # forged/corrupt ciphertext drop silently — feeding
+                # either into the membership state machine would let an
+                # unkeyed sender inject suspicion/death rumors.
+                from pilosa_tpu.utils.aesgcm import open_sealed
+                try:
+                    data = open_sealed(self._cipher, data)
+                except ValueError:
+                    self.crypto_drops += 1
+                    continue
             try:
                 msg = json.loads(data)
             except ValueError:

@@ -97,7 +97,17 @@ class Server:
                  trace_export_path: str = "",
                  trace_export_endpoint: str = "",
                  trace_export_format: str = "jaeger",
-                 trace_export_sample: float = 1.0):
+                 trace_export_sample: float = 1.0,
+                 qos_mode: str = "off",
+                 qos_default_priority: str = "interactive",
+                 qos_default_deadline: float = 0.0,
+                 qos_queries_per_s: float = 0.0,
+                 qos_device_ms_per_s: float = 0.0,
+                 qos_bytes_per_s: float = 0.0,
+                 qos_burst: float = 2.0,
+                 qos_max_principals: int = 256,
+                 qos_principals: Optional[dict] = None,
+                 gossip_secret: str = ""):
         self.data_dir = data_dir
         # [storage] wal-fsync, plumbed down the model tree to every
         # Fragment (PILOSA_TPU_WAL_FSYNC env overrides per fragment —
@@ -260,9 +270,27 @@ class Server:
         self.api.node_stats_fn = self.node_stats
         self.api.cluster_stats_fn = self.cluster_stats
         self.api.cluster_usage_fn = self.cluster_usage
+        # multi-tenant QoS plane (pilosa_tpu/qos.py): per-principal quota
+        # buckets refilled against the usage ledger, priority classes the
+        # batchers/pools order by, deadline-aware admission + shedding.
+        # Built unconditionally (mode="off" = zero behavior change) so
+        # the qos/* observability families always exist; QosPlane
+        # validates mode/priority/overrides and fails the boot on typos.
+        # PILOSA_TPU_QOS=0 is the env kill switch over any mode.
+        from pilosa_tpu.qos import QosPlane
+        self.qos = QosPlane(
+            mode=qos_mode, default_priority=qos_default_priority,
+            default_deadline=qos_default_deadline,
+            queries_per_s=qos_queries_per_s,
+            device_ms_per_s=qos_device_ms_per_s,
+            bytes_per_s=qos_bytes_per_s, burst_s=qos_burst,
+            max_principals=qos_max_principals, principals=qos_principals,
+            executor=self.executor, ledger=self.usage,
+            health_fn=self.node_health, logger=self.logger)
+        self.api.qos_plane = self.qos
         self.handler = Handler(self.api, cluster_message_fn=self.receive_message,
                                stats=self.stats, query_timeout=query_timeout,
-                               telemetry=self.telemetry)
+                               telemetry=self.telemetry, qos_plane=self.qos)
         self.http = HTTPServer(self.handler, host=host, port=port,
                                tls_certificate=tls_certificate, tls_key=tls_key)
         self._bind_host = host
@@ -317,6 +345,10 @@ class Server:
         self._gossip_port = gossip_port
         self._gossip_seeds = gossip_seeds or []
         self._gossip_config = gossip_config
+        # [gossip] secret: non-empty -> every gossip datagram is AES-GCM
+        # encrypted under a key derived from the shared passphrase
+        # (parallel/gossip.py; utils/aesgcm.py)
+        self._gossip_secret = gossip_secret
         # join=True: this node is being added to an existing cluster —
         # cluster_hosts are seed URIs (the gossip-seeds analog). It announces
         # itself and stays STARTING until the coordinator's resize completes
@@ -491,12 +523,15 @@ class Server:
         reference uses for the same purpose, gossip/gossip.go:248-257), so
         peers discovered purely by gossip can be admitted to membership."""
         from pilosa_tpu.parallel.gossip import Gossip, parse_seed
+        from pilosa_tpu.utils.aesgcm import derive_key
         self.gossip = Gossip(self.node_id, bind_host=self._bind_host,
                              bind_port=self._gossip_port,
                              meta={"uri": self.http.uri},
                              config=self._gossip_config,
                              on_alive=self._on_gossip_alive,
                              on_dead=self._on_gossip_dead,
+                             secret_key=(derive_key(self._gossip_secret)
+                                         if self._gossip_secret else None),
                              logger=self.logger)
         self.gossip.open(seeds=[parse_seed(s) for s in self._gossip_seeds])
         self.logger.printf("gossip: listening on %s:%d (seeds: %s)",
@@ -1501,6 +1536,15 @@ class Server:
                 worst = max(worst, {"green": 0.0, "yellow": 1.0,
                                     "red": 2.0}[ob["status"]])
             g["slo.worst"] = worst
+        # QoS plane: admission/shed/throttle totals (windowed to rates
+        # below) + the live wait estimate admission decides against
+        qp = getattr(self, "qos", None)
+        if qp is not None:
+            qt = qp.totals()
+            raw["qos.admitted"] = qt["admitted"]
+            raw["qos.shed"] = qt["shed"] + qt["wouldShed"]
+            raw["qos.throttled"] = qt["throttled"]
+            g["qos.estimated_wait_ms"] = round(qp.estimated_wait_ms(), 3)
         depth = 0
         for attr in ("batcher", "sum_batcher", "minmax_batcher"):
             b = getattr(ex, attr, None)
@@ -1602,6 +1646,9 @@ class Server:
             g["batcher.avg_wait_ms"] = (max(0.0, dwait) / dwaited
                                         if dwaited > 0 else 0.0)
         g["batcher.batches_per_s"] = rate("batcher.batches")
+        g["qos.admitted_per_s"] = rate("qos.admitted")
+        g["qos.shed_per_s"] = rate("qos.shed")
+        g["qos.throttled_per_s"] = rate("qos.throttled")
         g["hedges.fired_per_s"] = rate("hedges.fired")
         g["http.errors_per_s"] = rate("http.errors")
         g["xla.compiles_per_s"] = rate("xla.compiles")

@@ -199,6 +199,15 @@ class UsageLedger:
 
     # -- read side ----------------------------------------------------------
 
+    def peek(self, principal: str) -> Optional[dict]:
+        """One principal's current aggregates (a copy), or None when not
+        tracked. The QoS plane's quota buckets withdraw the DELTA of these
+        between a principal's requests — the measured spend, batch-smeared
+        attribution included, not an up-front estimate."""
+        with self._lock:
+            e = self._p.get(principal)
+            return {f: e[f] for f in FIELDS} if e is not None else None
+
     def totals(self) -> dict:
         """Exact cluster-auditable sums over every principal (spill
         included) — what /debug/vars and the usage/* counter families

@@ -66,7 +66,8 @@ class QueryProfile:
     __slots__ = ("trace_id", "node_id", "index", "pql", "start",
                  "start_wall", "elapsed_ms", "calls", "fanout", "dispatches",
                  "residency_hits", "residency_misses", "h2d_bytes",
-                 "remotes", "plans", "_lock", "_sealed", "_cached_dict")
+                 "remotes", "plans", "qos", "_lock", "_sealed",
+                 "_cached_dict")
 
     def __init__(self, trace_id: str = "", node_id: str = "",
                  index: str = "", pql: str = ""):
@@ -89,6 +90,10 @@ class QueryProfile:
         self.h2d_bytes = 0                 # host->device upload bytes
         self.remotes: list[dict] = []      # [{node, profile}] child trees
         self.plans: list[dict] = []        # planner decisions per call
+        # QoS admission context (pilosa_tpu/qos.py): priority class,
+        # deadline budget and the admission-time wait estimate — set once
+        # by api.query_results when a plane is wired, None otherwise
+        self.qos: Optional[dict] = None
         self._lock = threading.Lock()
 
     # -- recording hooks (each guarded by a current() is-None check at the
@@ -209,6 +214,8 @@ class QueryProfile:
                 "plan": [dict(p) for p in self.plans],
                 "remoteProfiles": list(self.remotes),
             }
+            if self.qos is not None:
+                d["qos"] = dict(self.qos)
             if self._sealed:
                 self._cached_dict = d
             return d

@@ -79,12 +79,23 @@ class ScriptedServer:
                 if action == "drop":
                     conn.close()
                     return
-                body = b'{"ok": true}' if action != "400" \
-                    else b'{"error": "bad", "code": "ErrTest"}'
-                status = b"200 OK" if action != "400" else b"400 Bad Request"
+                extra = b""
+                if action == "429":
+                    body = b'{"error": "quota", "code": "quota-exhausted"}'
+                    status = b"429 Too Many Requests"
+                    extra = b"Retry-After: 1\r\n"
+                elif action == "503-no-retry-after":
+                    body = b'{"error": "down"}'
+                    status = b"503 Service Unavailable"
+                elif action == "400":
+                    body = b'{"error": "bad", "code": "ErrTest"}'
+                    status = b"400 Bad Request"
+                else:
+                    body = b'{"ok": true}'
+                    status = b"200 OK"
                 conn.sendall(
                     b"HTTP/1.1 " + status + b"\r\n"
-                    b"Content-Type: application/json\r\n"
+                    b"Content-Type: application/json\r\n" + extra +
                     b"Content-Length: " + str(len(body)).encode() + b"\r\n"
                     b"\r\n" + body)
                 if action == "close-after":
@@ -152,3 +163,104 @@ def test_connection_refused_is_clienterror():
     c = InternalClient(timeout=2)
     with pytest.raises(ClientError):
         c._json("POST", "http://127.0.0.1:9", "/x", {})
+
+
+# -- 429/503 + Retry-After backpressure (QoS plane contract) ---------------
+
+
+def test_parse_retry_after_forms():
+    from pilosa_tpu.net.client import parse_retry_after
+    assert parse_retry_after("3") == 3.0
+    assert parse_retry_after(" 1.5 ") == 1.5
+    assert parse_retry_after("-2") == 0.0  # negative floors at zero
+    assert parse_retry_after(None) is None
+    assert parse_retry_after("") is None
+    assert parse_retry_after("soon-ish") is None  # garbage: no sleep
+    # HTTP-date form -> remaining delta (a past date floors at 0)
+    assert parse_retry_after("Wed, 21 Oct 2015 07:28:00 GMT") == 0.0
+    from email.utils import format_datetime
+    from datetime import datetime, timedelta, timezone
+    future = format_datetime(datetime.now(timezone.utc)
+                             + timedelta(seconds=40))
+    got = parse_retry_after(future)
+    assert got is not None and 30 < got <= 41
+
+
+def test_backoff_delay_is_capped_and_jittered():
+    from pilosa_tpu.net.client import RETRY_AFTER_CAP_S, backoff_delay
+    # a hostile/huge Retry-After is capped before jitter
+    assert backoff_delay(3600.0, rng=lambda: 1.0) == RETRY_AFTER_CAP_S
+    assert backoff_delay(3600.0, rng=lambda: 0.0) == RETRY_AFTER_CAP_S / 2
+    # jitter spans [0.5, 1.0]x of the (floored) base
+    lo = backoff_delay(1.0, rng=lambda: 0.0)
+    hi = backoff_delay(1.0, rng=lambda: 1.0)
+    assert lo == pytest.approx(0.5) and hi == pytest.approx(1.0)
+    # tiny hints floor at 50 ms so the retry isn't a busy-loop
+    assert backoff_delay(0.0, rng=lambda: 1.0) == pytest.approx(0.05)
+
+
+def test_429_with_retry_after_is_retried_then_succeeds(monkeypatch):
+    # two rejections then success: the client sleeps the (capped,
+    # jittered) hint and re-issues — backpressure honored, not surfaced
+    import pilosa_tpu.net.client as client_mod
+    sleeps = []
+    monkeypatch.setattr(client_mod.time, "sleep", sleeps.append)
+    srv = ScriptedServer(["429", "429", "ok"])
+    try:
+        c = InternalClient(timeout=5)
+        assert c._json("POST", srv.uri, "/x", {}) == {"ok": True}
+        assert srv.requests == 3
+        assert len(sleeps) == 2
+        assert all(0.05 <= s <= client_mod.RETRY_AFTER_CAP_S
+                   for s in sleeps)
+    finally:
+        srv.close()
+
+
+def test_429_retries_are_bounded(monkeypatch):
+    import pilosa_tpu.net.client as client_mod
+    monkeypatch.setattr(client_mod.time, "sleep", lambda s: None)
+    srv = ScriptedServer(["429"] * 10)
+    try:
+        c = InternalClient(timeout=5)
+        with pytest.raises(ClientError) as exc:
+            c._json("POST", srv.uri, "/x", {})
+        assert exc.value.status == 429
+        assert exc.value.retry_after == 1.0
+        assert srv.requests == 1 + client_mod.BACKPRESSURE_RETRIES
+    finally:
+        srv.close()
+
+
+def test_503_without_retry_after_is_not_retried():
+    # a bare 503 (peer crash-looping, proxy error) carries no
+    # backpressure contract: fail fast so per-shard failover engages
+    srv = ScriptedServer(["503-no-retry-after", "ok"])
+    try:
+        c = InternalClient(timeout=5)
+        with pytest.raises(ClientError) as exc:
+            c._json("POST", srv.uri, "/x", {})
+        assert exc.value.status == 503
+        assert exc.value.retry_after is None
+        assert srv.requests == 1
+    finally:
+        srv.close()
+
+
+def test_backpressure_respects_remaining_deadline(monkeypatch):
+    # with 10 ms of budget left, a 1 s Retry-After must NOT be slept:
+    # the rejection surfaces immediately
+    import time as _time
+
+    from pilosa_tpu.utils import qctx
+    srv = ScriptedServer(["429", "ok"])
+    tok = qctx.deadline.set(_time.monotonic() + 0.01)
+    try:
+        c = InternalClient(timeout=5)
+        with pytest.raises(ClientError) as exc:
+            c._json("POST", srv.uri, "/x", {})
+        assert exc.value.status == 429
+        assert srv.requests == 1
+    finally:
+        qctx.deadline.reset(tok)
+        srv.close()

@@ -144,7 +144,7 @@ def test_legacy_ttl_reprobes_the_destination():
     fc = FakeClient(batch_status=404)
     co = NodeCoalescer(fc, window_s=0.0, legacy_ttl=0.05)
     out = co._compute(("http://old:1",),
-                      [("idx", "q", None, None, None, False, None)])
+                      [("idx", "q", None, None, None, False, None, None)])
     assert len(out) == 1  # fallback sentinel per waiter
     assert co._is_legacy("http://old:1")
     time.sleep(0.06)

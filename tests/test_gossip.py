@@ -355,3 +355,131 @@ def test_gossip_mode_set_coordinator_cluster_wide(tmp_path):
     finally:
         b.close()
         a.close()
+
+
+# -- shared-key AES-GCM transport encryption (utils/aesgcm.py) -------------
+
+
+def test_aesgcm_known_answer_vectors():
+    """FIPS-197 / NIST SP 800-38D known answers pin the pure-stdlib
+    implementation (the image has no `cryptography` wheel): AES-128 and
+    AES-256 single blocks, the GHASH key, and two full GCM cases."""
+    from pilosa_tpu.utils.aesgcm import AESGCM, _encrypt_block, _expand_key
+
+    w, nr = _encrypt_block, None  # noqa: F841 — readability
+    w, nr = _expand_key(bytes.fromhex("000102030405060708090a0b0c0d0e0f"))
+    assert _encrypt_block(
+        w, nr, bytes.fromhex("00112233445566778899aabbccddeeff")).hex() \
+        == "69c4e0d86a7b0430d8cdb78070b4c55a"  # FIPS-197 C.1
+    w, nr = _expand_key(bytes.fromhex(
+        "000102030405060708090a0b0c0d0e0f"
+        "101112131415161718191a1b1c1d1e1f"))
+    assert _encrypt_block(
+        w, nr, bytes.fromhex("00112233445566778899aabbccddeeff")).hex() \
+        == "8ea2b7ca516745bfeafc49904b496089"  # FIPS-197 C.3
+    w, nr = _expand_key(b"\x00" * 16)
+    assert _encrypt_block(w, nr, b"\x00" * 16).hex() \
+        == "66e94bd4ef8a2c3b884cfa59ca342b2e"  # the GHASH key H
+    # GCM test case 2: zero key/IV, one zero block
+    g = AESGCM(b"\x00" * 16)
+    assert g.encrypt(b"\x00" * 12, b"\x00" * 16).hex() == (
+        "0388dace60b6a392f328c2b971b2fe78"
+        "ab6e47d42cec13bdf53a67b21257bddf")
+    # GCM test case 3: the classic 64-byte message
+    key = bytes.fromhex("feffe9928665731c6d6a8f9467308308")
+    iv = bytes.fromhex("cafebabefacedbaddecaf888")
+    pt = bytes.fromhex(
+        "d9313225f88406e5a55909c5aff5269a86a7a9531534f7da2e4c303d8a318a72"
+        "1c3c0c95956809532fcf0e2449a6b525b16aedf5aa0de657ba637b391aafd255")
+    assert AESGCM(key).encrypt(iv, pt).hex() == (
+        "42831ec2217774244b7221b784d0d49ce3aa212f2c02a4e035c17e2329aca12e"
+        "21d514b25466931c7d8f6a5aac84aa051ba30b396a0aac973d58e091473f5985"
+        "4d5c2af327cd64a62cf35abd2ba6fab4")
+
+
+def test_aesgcm_roundtrip_aad_and_tamper():
+    from pilosa_tpu.utils.aesgcm import AESGCM, derive_key, open_sealed, seal
+    g = AESGCM(derive_key("hush"))
+    ct = g.encrypt(b"n" * 12, b"membership state", b"aad")
+    assert g.decrypt(b"n" * 12, ct, b"aad") == b"membership state"
+    with pytest.raises(ValueError):  # flipped tag bit
+        g.decrypt(b"n" * 12, ct[:-1] + bytes([ct[-1] ^ 1]), b"aad")
+    with pytest.raises(ValueError):  # wrong AAD
+        g.decrypt(b"n" * 12, ct, b"other")
+    with pytest.raises(ValueError):  # wrong key
+        AESGCM(derive_key("loud")).decrypt(b"n" * 12, ct, b"aad")
+    # seal/open datagram framing (version + nonce + ct/tag)
+    dg = seal(g, b'{"t": "ping"}')
+    assert open_sealed(g, dg) == b'{"t": "ping"}'
+    with pytest.raises(ValueError):  # cleartext is never admitted
+        open_sealed(g, b'{"t": "ping"}')
+    # distinct passphrases derive distinct keys
+    from pilosa_tpu.utils.aesgcm import derive_key as dk
+    assert dk("a") != dk("b") and len(dk("a")) == 16
+
+
+def test_encrypted_cluster_converges_and_drops_unkeyed():
+    """Nodes sharing the secret converge exactly like cleartext gossip;
+    a cleartext datagram (unkeyed sender) is dropped and counted, and an
+    injected suspicion rumor from an unkeyed sender cannot poison the
+    member map — there is no downgrade path."""
+    from pilosa_tpu.utils.aesgcm import derive_key
+    key = derive_key("cluster-secret")
+    nodes = [Gossip(f"e{i}", config=GossipConfig(**FAST), secret_key=key)
+             for i in range(3)]
+    try:
+        seed = (nodes[0].host, nodes[0].port)
+        for i, g in enumerate(nodes):
+            g.open(seeds=[seed] if i else [])
+        want = {"e0", "e1", "e2"}
+        wait_for(lambda: all(alive_ids(g) == want for g in nodes),
+                 msg="encrypted cluster convergence")
+        # cleartext injection: a rumor that would mark e2 suspect
+        rumor = {"t": "ping", "seq": 4242, "from": "liar", "updates": [
+            {"id": "e2", "host": nodes[2].host, "port": nodes[2].port,
+             "state": SUSPECT, "inc": nodes[2].incarnation + 10}]}
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        s.sendto(json.dumps(rumor).encode(), (nodes[0].host, nodes[0].port))
+        s.close()
+        wait_for(lambda: nodes[0].crypto_drops >= 1,
+                 msg="cleartext datagram dropped and counted")
+        # the rumor never entered the state machine
+        assert "e2" not in {m.id for m in nodes[0].members(state=SUSPECT)}
+    finally:
+        close_all(nodes)
+
+
+def test_wrong_key_node_never_joins():
+    from pilosa_tpu.utils.aesgcm import derive_key
+    right = [Gossip(f"r{i}", config=GossipConfig(**FAST),
+                    secret_key=derive_key("right")) for i in range(2)]
+    wrong = Gossip("w0", config=GossipConfig(**FAST),
+                   secret_key=derive_key("wrong"))
+    try:
+        seed = (right[0].host, right[0].port)
+        right[0].open(seeds=[])
+        right[1].open(seeds=[seed])
+        wait_for(lambda: alive_ids(right[0]) == {"r0", "r1"},
+                 msg="keyed pair converges")
+        wrong.open(seeds=[seed])
+        time.sleep(0.5)  # several protocol periods
+        assert "w0" not in alive_ids(right[0])
+        assert "w0" not in alive_ids(right[1])
+        assert right[0].crypto_drops >= 1  # its sync datagrams dropped
+        # and the wrong-key node learned nothing either
+        assert alive_ids(wrong) == {"w0"}
+    finally:
+        close_all(right)
+        wrong.close()
+
+
+def test_server_gossip_secret_wires_cipher(tmp_path):
+    """[gossip] secret on a Server turns the transport cipher on."""
+    from pilosa_tpu.server import Server
+    srv = Server(str(tmp_path / "enc"), port=0, membership_interval=0,
+                 gossip_port=0, gossip_config=GossipConfig(**FAST),
+                 gossip_secret="hush").open()
+    try:
+        assert srv.gossip._cipher is not None
+    finally:
+        srv.close()

@@ -323,6 +323,39 @@ def test_live_metrics_usage_and_slo_families(pair):
     assert ("worst", None) in skeys
 
 
+def test_live_metrics_qos_families(pair):
+    """QoS PR satellite: the admission plane's counters — admitted per
+    priority, shed per reason, throttled per reason, the observe-mode
+    would-* twins — and its gauges are scrapeable, emitted
+    unconditionally (zeros included; mode off on this server) so a
+    shed-rate alert can never race the first shed. Every priority class
+    and every shed/throttle reason in the glossary must be present."""
+    from pilosa_tpu.qos import PRIORITIES, SHED_REASONS, THROTTLE_REASONS
+    servers, uris = pair
+    with urllib.request.urlopen(uris[0] + "/metrics", timeout=10) as r:
+        text = r.read().decode()
+    types, samples = check_conformance(text)
+    assert types["pilosa_qos_total"] == "counter"
+    keyspace = {(l.get("key"), l.get("priority"), l.get("reason"))
+                for n, l, _ in samples if n == "pilosa_qos_total"}
+    for p in PRIORITIES:
+        assert ("admitted", p, None) in keyspace
+    for reason in SHED_REASONS:
+        assert ("shed", None, reason) in keyspace
+        assert ("wouldShed", None, reason) in keyspace
+    for reason in THROTTLE_REASONS:
+        assert ("throttled", None, reason) in keyspace
+        assert ("wouldThrottled", None, reason) in keyspace
+    assert types["pilosa_qos"] == "gauge"
+    gkeys = {l.get("key") for n, l, _ in samples if n == "pilosa_qos"}
+    assert {"estimatedWaitMs", "queuePressure", "mode"} <= gkeys
+    # mode off on this server -> gauge 0; real traffic admitted counts
+    # only under observe/enforce, so the zeros themselves are the assert
+    mode = next(v for n, l, v in samples
+                if n == "pilosa_qos" and l.get("key") == "mode")
+    assert mode == 0.0
+
+
 def test_stats_registry_drift_guard(pair):
     """Tier-1 drift guard: every counter/gauge/timing name registered in
     the live StatsClient reaches the /metrics exposition — so a future PR

@@ -109,9 +109,12 @@ def test_coalescer_entries_carry_trace_and_profile_flags():
     fc = FakeClient()
     co = NodeCoalescer(fc, window_s=0.0)
     co._compute(("http://n1:1",), [
-        ("idx", "q1", None, None, "trace-A", True, "key:a"),
-        ("idx", "q2", None, 1.5, None, False, None),
-        ("idx", "q1", None, None, "trace-B", False, "key:b"),  # dedup of q1
+        ("idx", "q1", None, None, "trace-A", True, "key:a", "batch"),
+        ("idx", "q2", None, 1.5, None, False, None, None),
+        # dedup of q1: later caller must not erase the first trace, and
+        # its more urgent class upgrades the shared execution
+        ("idx", "q1", None, None, "trace-B", False, "key:b",
+         "interactive"),
     ])
     entries = fc.batch_calls[0]
     assert len(entries) == 2  # q1 deduped
@@ -119,7 +122,9 @@ def test_coalescer_entries_carry_trace_and_profile_flags():
     e2 = next(e for e in entries if e["query"] == "q2")
     assert e1["traceId"] == "trace-A"  # first caller's trace wins
     assert e1["profile"] is True  # any profiled dup profiles the execution
+    assert e1["priority"] == "interactive"  # most urgent dup wins
     assert "traceId" not in e2 and "profile" not in e2
+    assert "priority" not in e2
     assert e2["timeout"] == 1.5
 
 
