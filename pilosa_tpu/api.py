@@ -157,6 +157,13 @@ class API:
         # and remote envelope entries — and doomed-cost sheds) and the
         # per-class service-cost observations its estimates feed on.
         self.qos_plane = None
+        # graceful-drain hooks (server.py drain lifecycle); set by
+        # Server. drain_fn(abort=) starts/cancels a drain and returns
+        # the status doc; node_state_fn() -> "READY" | "DRAINING" rides
+        # /status so load balancers and probing peers see the lifecycle.
+        self.drain_fn = None
+        self.drain_status_fn = None
+        self.node_state_fn = None
 
     def _broadcast(self, msg: dict) -> None:
         if self.broadcast_fn is not None:
@@ -860,6 +867,11 @@ class API:
                # federation computes, so the two can never disagree
                "uptimeSeconds": int(time.time() - self.start_time),
                "version": __version__}
+        if self.node_state_fn is not None:
+            # lifecycle state of THIS node ("READY" | "DRAINING"): load
+            # balancers stop sending here on DRAINING, and a probing
+            # peer uses it to tell a restarted node from a draining one
+            out["nodeState"] = self.node_state_fn()
         if self.health_fn is not None:
             try:
                 out["health"] = self.health_fn()
@@ -924,6 +936,17 @@ class API:
                 raise ApiError(str(e))
             return
         self.cluster.abort_resize()
+
+    def drain(self, abort: bool = False) -> dict:
+        """POST /cluster/drain: begin a graceful drain of this node (or
+        cancel one with abort=True). The drain runs in the background —
+        the returned status document reflects progress; operators poll
+        /status (nodeState) for completion before restarting the
+        process. Deliberately NOT state-gated: draining must work in any
+        cluster state (that is the point of a lifecycle plane)."""
+        if self.drain_fn is None:
+            raise ApiError("drain not supported", status=501)
+        return self.drain_fn(abort=abort)
 
     def recalculate_caches(self) -> None:
         for idx in self.holder.indexes.values():

@@ -68,6 +68,16 @@ class ClusterConfig:
     profile: str = "auto"
     # slow-query ring size served at GET /debug/query-history
     query_history_size: int = 100
+    # zero-downtime operations (docs/operations.md "Rolling restarts and
+    # drains"): hint-max-bytes caps each down replica's on-disk hint log
+    # (overflow drops the hint durably and forces the anti-entropy
+    # fallback); hint-max-age (duration) expires hints at replay time;
+    # drain-timeout (duration) bounds how long SIGTERM / POST
+    # /cluster/drain waits for in-flight work and queue flushes before
+    # snapshotting anyway
+    hint_max_bytes: int = 64 * 1024 * 1024
+    hint_max_age: float = 3600.0
+    drain_timeout: float = 30.0
 
 
 @dataclass
@@ -354,6 +364,9 @@ class Config:
             f"hedge-delay = {self.cluster.hedge_delay}",
             f'profile = "{self.cluster.profile}"',
             f"query-history-size = {self.cluster.query_history_size}",
+            f"hint-max-bytes = {self.cluster.hint_max_bytes}",
+            f"hint-max-age = {self.cluster.hint_max_age}",
+            f"drain-timeout = {self.cluster.drain_timeout}",
             "",
             "[query]",
             f'plan = "{self.query.plan}"',

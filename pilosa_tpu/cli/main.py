@@ -153,6 +153,9 @@ def cmd_server(args) -> int:
         hedge_delay=cfg.cluster.hedge_delay,
         profile_mode=cfg.cluster.profile,
         query_history_size=cfg.cluster.query_history_size,
+        hint_max_bytes=cfg.cluster.hint_max_bytes,
+        hint_max_age=cfg.cluster.hint_max_age,
+        drain_timeout=cfg.cluster.drain_timeout,
         plan=cfg.query.plan,
         plan_cache_bytes=cfg.query.plan_cache_bytes,
         max_writes_per_request=cfg.max_writes_per_request,
@@ -207,14 +210,26 @@ def cmd_server(args) -> int:
           flush=True)
 
     stop = threading.Event()
+    # SIGTERM = graceful drain (the deploy/rolling-restart path): shed new
+    # queries, let in-flight work finish, flush queues, land a final
+    # snapshot — then exit. A SECOND signal skips the remaining drain and
+    # stops immediately (the kill -9 escape hatch that still closes
+    # cleanly). SIGINT (^C) behaves the same for interactive parity.
+    signals_seen = []
 
     def _sig(_s, _f):
+        signals_seen.append(_s)
+        if len(signals_seen) > 1:
+            server._drain_abort.set()  # cut the drain short, exit now
         stop.set()
 
     signal.signal(signal.SIGINT, _sig)
     signal.signal(signal.SIGTERM, _sig)
     try:
         stop.wait()
+        if not server._drain_abort.is_set():
+            print("draining (send another signal to skip)...", flush=True)
+            server.drain()
     finally:
         server.close()
     return 0
@@ -326,10 +341,28 @@ def cmd_inspect(args) -> int:
 
 
 def cmd_check(args) -> int:
+    from pilosa_tpu.storage.hints import HINT_MAGIC, verify_hint_log
     from pilosa_tpu.storage.roaring import Bitmap
     failed = 0
     for path in args.paths:
         try:
+            # hint logs (".hints" files / 0xFB lead byte) get framing
+            # validation; everything else is a fragment/roaring file
+            with open(path, "rb") as f:
+                lead = f.read(1)
+            if path.endswith(".hints") or (
+                    lead and lead[0] == HINT_MAGIC):
+                rep = verify_hint_log(path)
+                if rep["error"]:
+                    failed += 1
+                    print(f"{path}: FAILED: hint log damaged at byte "
+                          f"{rep['validBytes']}/{rep['bytes']} "
+                          f"({rep['error']}); {rep['records']} valid "
+                          f"record(s) precede the damage")
+                else:
+                    print(f"{path}: OK ({rep['records']} hint record(s), "
+                          f"{rep['droppedMarkers']} drop marker(s))")
+                continue
             with open(path, "rb") as f:
                 b = Bitmap.from_bytes(f.read())
             b.check()

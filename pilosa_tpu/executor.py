@@ -204,6 +204,32 @@ class Executor:
         if os.environ.get("PILOSA_TPU_PLAN_CACHE", "1") != "0":
             from pilosa_tpu.parallel.residency import PlanCache
             self.plan_cache = PlanCache()
+        # durable hinted handoff (storage/hints.py HintStore; set by
+        # Server): a replica write skipped because the target is down or
+        # draining is appended to the target's on-disk hint log instead
+        # of being silently dropped. None = the old skip-silently behavior
+        # (bare executors / tests without a server).
+        self.hints = None
+        # read fence (rejoin consistency): (index, shard) pairs whose
+        # local fragments may be stale after a down/drain rejoin. Reads
+        # for fenced shards route to a peer replica — locally by
+        # re-grouping the fan-out plan, remotely by refusing the shard so
+        # the coordinator's per-shard failover retries elsewhere — until
+        # hint replay or a block-checksum-verified scrub confirms parity
+        # (server._verify_fence_pass lifts the fence).
+        self.read_fence: set[tuple[str, int]] = set()
+        self._fence_lock = _threading.Lock()
+        self.fence_rerouted = 0  # reads routed around a fenced local shard
+        self.fence_refused = 0  # remote reads refused into peer failover
+        self.fence_served_stale = 0  # no live alternative: stale > down
+        # announce_shard_fn(index, field, shard): synchronous cluster
+        # broadcast of a create-shard, set by Server. Used by the Set()
+        # write path when the write CREATES the shard, so the ack implies
+        # cluster-wide shard visibility (read-your-writes through ANY
+        # node). Shard creation happens once per shard lifetime, so the
+        # extra broadcast round-trip is paid ~never; bulk imports keep
+        # the async announcement queue.
+        self.announce_shard_fn = None
 
     # ------------------------------------------------------ fan-out pools
 
@@ -275,6 +301,102 @@ class Executor:
                     pool.shutdown(wait=False, cancel_futures=True)
                     setattr(self, attr, None)
 
+    # ------------------------------------------------- read fence (rejoin)
+
+    def fence_reads(self, keys) -> int:
+        """Fence (index, shard) pairs: local reads re-route to a peer
+        replica until the server's rejoin verifier lifts the fence."""
+        with self._fence_lock:
+            before = len(self.read_fence)
+            self.read_fence.update(keys)
+            return len(self.read_fence) - before
+
+    def unfence_reads(self, key) -> bool:
+        with self._fence_lock:
+            if key in self.read_fence:
+                self.read_fence.discard(key)
+                return True
+            return False
+
+    def fence_snapshot(self) -> dict:
+        with self._fence_lock:
+            return {
+                "fencedShards": len(self.read_fence),
+                "rerouted": self.fence_rerouted,
+                "refusedRemote": self.fence_refused,
+                "servedStale": self.fence_served_stale,
+            }
+
+    def _fence_peer(self, index_name: str, shard: int):
+        """A live, un-excluded peer replica for a fenced shard, or None
+        (fencing only acts when someone else can serve the read)."""
+        for n in self.cluster.shard_nodes(index_name, shard):
+            if n.id != self.cluster.local_id and n.uri \
+                    and not self.cluster.is_unavailable(n.id):
+                return n
+        return None
+
+    def _check_remote_fence(self, index_name: str, query: Query,
+                            shards) -> None:
+        """Remote (fan-out sub-request) entry: refuse fenced shards so
+        the COORDINATOR's existing per-shard failover re-maps them onto a
+        healthy replica — the rejoining node never serves a possibly
+        stale read while a peer can serve a verified one. Writes and
+        hint-replay traffic pass through (the fence is a READ fence; the
+        heal itself must land here)."""
+        if not shards:
+            return
+        if any(self._call_has_write(c) for c in query.calls):
+            return
+        with self._fence_lock:
+            fenced = [s for s in shards
+                      if (index_name, s) in self.read_fence]
+        for s in fenced:
+            if self._fence_peer(index_name, s) is not None:
+                with self._fence_lock:
+                    self.fence_refused += 1
+                raise ExecutionError(
+                    f"shard {s} read-fenced pending rejoin sync "
+                    "(code=read-fenced)")
+        if fenced:
+            # every replica of the fenced shards is down/draining: serve
+            # the local copy — stale beats unavailable
+            with self._fence_lock:
+                self.fence_served_stale += len(fenced)
+
+    def _fanout_groups(self, index: Index, qshards: list[int]) -> dict:
+        """shards_by_node plus the local read-fence re-route: fenced
+        shards this node owns are planned onto the next live replica (the
+        per-shard failover path, taken up front instead of after a
+        round-trip refusal)."""
+        groups = self.cluster.shards_by_node(index.name, qshards)
+        if not self.read_fence:
+            return groups
+        local = groups.get(self.cluster.local_id)
+        if not local:
+            return groups
+        with self._fence_lock:
+            fenced = [s for s in local
+                      if (index.name, s) in self.read_fence]
+        if not fenced:
+            return groups
+        keep = [s for s in local if s not in set(fenced)]
+        for s in fenced:
+            peer = self._fence_peer(index.name, s)
+            if peer is None:
+                keep.append(s)  # no live alternative: stale > down
+                with self._fence_lock:
+                    self.fence_served_stale += 1
+                continue
+            groups.setdefault(peer.id, []).append(s)
+            with self._fence_lock:
+                self.fence_rerouted += 1
+        if keep:
+            groups[self.cluster.local_id] = keep
+        else:
+            groups.pop(self.cluster.local_id, None)
+        return groups
+
     def clear_caches(self) -> None:
         """Drop the host row cache and all HBM-resident leaves. Called on
         index/field deletion: a recreated schema object restarts its
@@ -304,6 +426,10 @@ class Executor:
         index = self.holder.index(index_name)
         if index is None:
             raise ExecutionError(f"index not found: {index_name}")
+        if remote and self.read_fence and self.cluster is not None:
+            # rejoin read fence: refuse possibly-stale shards back into
+            # the coordinator's per-shard failover (see fence_reads)
+            self._check_remote_fence(index_name, query, shards)
         distributed = (not remote and self.cluster is not None
                        and self.client is not None
                        and len(self.cluster.nodes) > 1)
@@ -1648,7 +1774,7 @@ class Executor:
                             {k: v for k, v in call.args.items() if k != "limit"},
                             call.children)
         qshards = self._query_shards(index, shards)
-        groups = self.cluster.shards_by_node(index.name, qshards)
+        groups = self._fanout_groups(index, qshards)
         if len(groups) <= 1:
             partials = []
             for node_id, node_shards in groups.items():
@@ -1708,6 +1834,12 @@ class Executor:
                                             excluded)]
             except ClientError as e:
                 err = e
+                if e.shed_reason == "draining":
+                    # the peer announced its drain through the rejection
+                    # itself (we raced its broadcast): mark it draining NOW
+                    # so every later query this node plans routes around
+                    # it without another round trip
+                    self.cluster.mark_draining(node_id)
         if prof is not None:
             # the batch re-maps shard-by-shard onto replicas below; the
             # profile keeps the evidence (which node failed, how many
@@ -1720,10 +1852,10 @@ class Executor:
         for s in node_shards:
             replicas = [n.id for n in self.cluster.shard_nodes(index.name, s)
                         if n.id not in excluded]
-            # prefer replicas not marked down by liveness probing; fall back
-            # to a down-marked one (the marker may be stale) before erroring
+            # prefer replicas not marked down/draining by liveness; fall
+            # back to a marked one (the marker may be stale) before erroring
             cand = next((r for r in replicas
-                         if not self.cluster.is_down(r)),
+                         if not self.cluster.is_unavailable(r)),
                         replicas[0] if replicas else None)
             if cand is None:
                 raise ExecutionError(
@@ -1813,7 +1945,7 @@ class Executor:
                 return None
         common.discard(node.id)
         common -= set(excluded)
-        common = {c for c in common if not self.cluster.is_down(c)}
+        common = {c for c in common if not self.cluster.is_unavailable(c)}
         if not common:
             return None
         if self.cluster.local_id in common:
@@ -1917,19 +2049,26 @@ class Executor:
             qshards = self._query_shards(index, shards)
             groups = self.cluster.shards_by_node(index.name, qshards)
             partials = []
+            hinted: dict[str, list[int]] = {}  # skipped replica -> shards
             for node_id, node_shards in groups.items():
                 # writes also land on replicas of each shard
                 replica_targets: dict[str, list[int]] = {}
                 for s in node_shards:
-                    live = [n for n in self.cluster.shard_nodes(index.name, s)
-                            if not self.cluster.is_down(n.id)]
+                    owners = self.cluster.shard_nodes(index.name, s)
+                    live = [n for n in owners
+                            if not self.cluster.is_unavailable(n.id)]
                     if not live:
                         # never ack a write that landed nowhere
                         raise ExecutionError(
                             f"all replicas down for write to shard {s}")
                     for n in live:
-                        # down replicas heal via anti-entropy on return
                         replica_targets.setdefault(n.id, []).append(s)
+                    for n in owners:
+                        if n not in live:
+                            # down/draining replica: the write becomes a
+                            # durable hint, replayed in order when the
+                            # node returns (storage/hints.py)
+                            hinted.setdefault(n.id, []).append(s)
                 for rid, rshards in replica_targets.items():
                     if rid == self.cluster.local_id:
                         partials.append(self._execute_call(index, call, rshards))
@@ -1942,33 +2081,70 @@ class Executor:
                             partials.append(results[0])
                         except ClientError as e:
                             raise ExecutionError(f"replica write failed: {e}")
+            for nid, hshards in hinted.items():
+                self._hint_write(nid, index.name, pql, hshards)
             return any(bool(p) for p in partials)
 
+        new_shard = False
         if call.name in ("Set", "Clear", "SetColumnAttrs"):
             col = self._translate_col(index, call.args["_col"])
             targets = self.cluster.shard_nodes(index.name, col // SHARD_WIDTH)
+            if call.name == "Set":
+                fld = index.field(call.field_arg())
+                new_shard = (fld is not None and not
+                             fld.available_shards.contains(
+                                 col // SHARD_WIDTH))
         else:  # SetRowAttrs
             targets = self.cluster.nodes
-        # skip probe-detected-down replicas: a write acked by the live
-        # replicas lands on the returning node via anti-entropy; all
-        # replicas down -> hard error below (no live target)
-        live = [n for n in targets if not self.cluster.is_down(n.id)]
+        # Down/draining replicas are skipped from the synchronous write —
+        # but no longer silently: each skipped replica gets the mutation
+        # appended to its durable hint log (storage/hints.py), replayed in
+        # order when liveness reports it back. All replicas down -> hard
+        # error (never ack a write that landed nowhere).
+        live = [n for n in targets if not self.cluster.is_unavailable(n.id)]
         if targets and not live:
             raise ExecutionError("all replicas down for write")
+        skipped = [n for n in targets if n not in live]
         targets = live
         result = None
+        acked = 0
         for node in targets:
             if node.id == self.cluster.local_id:
                 r = self._execute_call(index, call, None)
+                acked += 1
             else:
                 try:
                     results = self.client.query_proto(node.uri, index.name,
                                                       pql, shards=None,
                                                       remote=True)
                     r = results[0]
+                    acked += 1
                 except ClientError as e:
+                    if e.shed_reason == "draining" \
+                            or self.cluster.is_unavailable(node.id):
+                        # the replica started draining (or was marked
+                        # down) between planning and send: demote it to a
+                        # hint instead of failing the whole write
+                        if e.shed_reason == "draining":
+                            self.cluster.mark_draining(node.id)
+                        skipped.append(node)
+                        continue
                     raise ExecutionError(f"replica write failed: {e}")
             result = r if result is None else (result or r)
+        if skipped and not acked:
+            # every target raced into draining: the write landed nowhere
+            raise ExecutionError("all replicas draining for write")
+        for node in skipped:
+            self._hint_write(node.id, index.name, pql, None)
+        if new_shard and self.announce_shard_fn is not None:
+            # this Set CREATED the shard: announce it SYNCHRONOUSLY so
+            # the ack implies every live node can already plan queries
+            # over it — an immediately-following read through any node
+            # must not race the async announcement queue. The replicas'
+            # own async announcements still fire (idempotent); this just
+            # closes the window before the write is acked.
+            self.announce_shard_fn(index.name, call.field_arg(),
+                                   col // SHARD_WIDTH)
         if (call.name == "Set"
                 and all(n.id != self.cluster.local_id for n in targets)):
             # first-hand knowledge: the Set just landed on the shard's
@@ -1984,6 +2160,15 @@ class Executor:
             if f is not None:
                 f.add_available_shard(col // SHARD_WIDTH, quiet=True)
         return result
+
+    def _hint_write(self, node_id: str, index_name: str, pql: str,
+                    hshards: Optional[list[int]]) -> None:
+        """Queue one skipped replica write as a durable hint (nop without
+        a HintStore — bare executors keep the legacy skip-silently
+        behavior, which the anti-entropy scrubber still covers)."""
+        if self.hints is None:
+            return
+        self.hints.append(node_id, index_name, pql, shards=hshards)
 
     def _reduce(self, call: Call, partials: list, index: Optional[Index] = None,
                 shards: Optional[list[int]] = None):
@@ -2048,7 +2233,7 @@ class Executor:
         recount.args.pop("n", None)
         partials = []
         qshards = self._query_shards(index, shards)
-        groups = self.cluster.shards_by_node(index.name, qshards)
+        groups = self._fanout_groups(index, qshards)
         for node_id, node_shards in groups.items():
             partials.extend(self._map_node(index, recount, node_id,
                                            node_shards, set()))

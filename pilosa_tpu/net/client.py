@@ -66,9 +66,13 @@ def backoff_delay(retry_after: float, cap: float = RETRY_AFTER_CAP_S,
     return base * (0.5 + 0.5 * rng())
 
 
+SHED_REASON_HEADER = "X-Pilosa-Shed-Reason"
+
+
 class ClientError(Exception):
     def __init__(self, msg: str, status: int = 0, code: str = "",
-                 retry_after: Optional[float] = None):
+                 retry_after: Optional[float] = None,
+                 shed_reason: str = ""):
         super().__init__(msg)
         self.status = status
         self.code = code  # machine-readable ApiError.code from the peer
@@ -76,6 +80,11 @@ class ClientError(Exception):
         # (None otherwise): drives the capped jittered retry below, and
         # callers that give up can surface it to THEIR callers
         self.retry_after = retry_after
+        # the peer's X-Pilosa-Shed-Reason on a deliberate rejection:
+        # "draining" means the peer is gracefully restarting — fail over
+        # to the next replica IMMEDIATELY, no backoff sleep (the hint is
+        # "go elsewhere", not "come back later")
+        self.shed_reason = shed_reason
 
 
 class InternalClient:
@@ -114,6 +123,13 @@ class InternalClient:
                                           content_type=content_type,
                                           accept=accept, timeout=timeout)
             except ClientError as e:
+                if e.shed_reason == "draining":
+                    # a draining peer is telling us to go AWAY, not to
+                    # come back: surface immediately (no sleep, no
+                    # re-issue) so the caller's per-shard failover picks
+                    # the next replica — unlike quota 429s, whose capped
+                    # jittered backoff below stays unchanged
+                    raise
                 if (e.status not in (429, 503) or e.retry_after is None
                         or bp_attempt >= BACKPRESSURE_RETRIES):
                     raise
@@ -233,7 +249,9 @@ class InternalClient:
                 raise ClientError(f"{method} {path}: {resp.status}: {detail}",
                                   status=resp.status, code=code,
                                   retry_after=parse_retry_after(
-                                      resp.getheader("Retry-After")))
+                                      resp.getheader("Retry-After")),
+                                  shed_reason=resp.getheader(
+                                      SHED_REASON_HEADER) or "")
             return data
 
     def _conn_for(self, key: tuple, sock_timeout: float):

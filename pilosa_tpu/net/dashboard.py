@@ -124,6 +124,10 @@ const LOCAL_SERIES = [
   ["qos.shed_per_s", "QoS shed / s", fmtNum],
   ["qos.throttled_per_s", "QoS throttled (429) / s", fmtNum],
   ["qos.estimated_wait_ms", "QoS est. wait ms", fmtNum],
+  ["hints.pending_bytes", "hint log bytes (handoff)", fmtBytes],
+  ["hints.replayed_per_s", "hints replayed / s", fmtNum],
+  ["drain.shed_per_s", "drain sheds / s", fmtNum],
+  ["fence.fenced_shards", "read-fenced shards", fmtNum],
   ["fanout.queued", "fan-out queued", fmtNum],
   ["xla.compiles_per_s", "XLA compiles / s", fmtNum],
   ["wal.bytes", "storage+WAL bytes", fmtBytes],

@@ -24,6 +24,7 @@ from pilosa_tpu.utils import accounting, qctx, tracing
 # (method, regex) -> handler name; ordered
 ROUTES: list[tuple[str, re.Pattern, str]] = [
     ("GET", re.compile(r"^/$"), "home"),
+    ("POST", re.compile(r"^/cluster/drain$"), "post_cluster_drain"),
     ("POST", re.compile(r"^/cluster/resize/abort$"), "post_resize_abort"),
     ("POST", re.compile(r"^/cluster/resize/remove-node$"), "post_remove_node"),
     ("POST", re.compile(r"^/cluster/resize/set-coordinator$"), "post_set_coordinator"),
@@ -110,8 +111,22 @@ class Handler:
         # at dispatch, BEFORE parse. None = no admission (plumbing only).
         self.qos = qos_plane
         self.errors_5xx = 0  # cumulative 5xx responses (health-score input)
+        # graceful-drain gate (server.drain flips it): new external
+        # queries get 503 + X-Pilosa-Shed-Reason: draining; internal
+        # fan-out entries and non-query routes keep working so peers can
+        # finish in-flight work, replay hints and fetch fragments
+        self.draining = False
+        self.drain_sheds = 0
+        # in-flight work-route requests (query/import/query-batch): the
+        # drain sequence waits for this to hit zero before snapshotting
+        self.active_queries = 0
+        self._counter_lock = threading.Lock()
         self.serializer = Serializer()
         self._local = threading.local()
+
+    # routes the drain sequence waits out (and counts as in-flight work)
+    WORK_ROUTES = frozenset({"post_query", "post_query_batch",
+                             "post_import", "post_import_roaring"})
 
     def _set_deadline(self, route: str, query: dict, headers) -> object:
         """Adopt the caller's remaining deadline (X-Pilosa-Deadline, set by
@@ -207,6 +222,10 @@ class Handler:
                 dl_token = None
                 qos_dl_token = None
                 qos_rejected = False
+                is_work = name in self.WORK_ROUTES
+                if is_work:
+                    with self._counter_lock:
+                        self.active_queries += 1
                 try:
                     # inside the try: an invalid ?timeout= must map to a
                     # clean 400 like any other ApiError, not escape dispatch
@@ -215,6 +234,27 @@ class Handler:
                     from pilosa_tpu.utils import failpoints
                     failpoints.hit("http.server.dispatch")
                     dl_token = self._set_deadline(name, query, headers)
+                    if (self.draining and name == "post_query"
+                            and not self._qos_inherited(query, headers)):
+                        # graceful drain: NEW external queries are shed
+                        # (clients fail over to the next replica with no
+                        # backoff — net/client.py honors the reason
+                        # header); fan-out entries a coordinator already
+                        # admitted finish normally. Excluded from the
+                        # 5xx health input like QoS sheds — a drain must
+                        # not page as an error spike.
+                        qos_rejected = True
+                        with self._counter_lock:
+                            self.drain_sheds += 1
+                        if self.qos is not None:
+                            self.qos.record_drain_shed()
+                        st, ct, payload = self._error(
+                            503, "node is draining (graceful restart): "
+                                 "retry against another replica",
+                            code="shed")
+                        return (st, ct, payload, {
+                            "Retry-After": "1",
+                            "X-Pilosa-Shed-Reason": "draining"})
                     rej = None
                     if (plane is not None and qos.enabled()
                             and name == "post_query"
@@ -255,6 +295,9 @@ class Handler:
                 except Exception as e:  # noqa: BLE001 — surface as 500
                     resp = self._error(500, str(e))
                 finally:
+                    if is_work:
+                        with self._counter_lock:
+                            self.active_queries -= 1
                     if qos_dl_token is not None:
                         qctx.deadline.reset(qos_dl_token)
                     if dl_token is not None:
@@ -536,6 +579,19 @@ class Handler:
                 "hedgesWon": getattr(ex, "hedges_won", 0),
                 "hedgesCancelled": getattr(ex, "hedges_cancelled", 0),
             }
+            # durable hinted handoff (storage/hints.py): queued/replayed/
+            # dropped totals + per-target pending bytes — the previously
+            # silent skipped-replica writes, now an operator surface
+            hints = getattr(ex, "hints", None)
+            if hints is not None:
+                snap["writeHandoffs"] = hints.snapshot()
+            # rejoin read fence: shards still awaiting parity verification
+            fence = ex.fence_snapshot()
+            if any(fence.values()):
+                snap["readFence"] = fence
+        # graceful-drain lifecycle state (server.drain)
+        if self.api.drain_status_fn is not None:
+            snap["drain"] = self.api.drain_status_fn()
         holder = getattr(self.api, "holder", None)
         if holder is not None:
             # volatility surface (frozen bulk loads are NOT durable until
@@ -735,6 +791,26 @@ class Handler:
                 counts["planCache/evictions"] = cs["evictions"]
                 gauges["planCache/bytes"] = cs["bytes"]
                 gauges["planCache/entries"] = cs["entries"]
+            # hinted handoff + rejoin fence: emitted unconditionally
+            # (zeros included) like the planner families — "hint log
+            # growing" / "fence stuck" alerts must never race the first
+            # skipped write for the family to exist
+            hints = getattr(ex, "hints", None)
+            if hints is not None:
+                hsnap = hints.snapshot()
+                counts["writeHandoffs/queued"] = hsnap["queued"]
+                counts["writeHandoffs/replayed"] = hsnap["replayed"]
+                counts["writeHandoffs/dropped"] = hsnap["dropped"]
+                counts["writeHandoffs/replayFailures"] = \
+                    hsnap["replayFailures"]
+                gauges["writeHandoffs/pendingBytes"] = hsnap["pendingBytes"]
+                gauges["writeHandoffs/pendingTargets"] = len(
+                    hsnap["pendingTargets"])
+            fence = ex.fence_snapshot()
+            counts["readFence/rerouted"] = fence["rerouted"]
+            counts["readFence/refusedRemote"] = fence["refusedRemote"]
+            counts["readFence/servedStale"] = fence["servedStale"]
+            gauges["readFence/fencedShards"] = fence["fencedShards"]
         holder = getattr(self.api, "holder", None)
         if holder is not None:
             damaged = holder.damaged_fragments()
@@ -788,6 +864,13 @@ class Handler:
             qc, qg = self.qos.metrics_series()
             counts.update(qc)
             gauges.update(qg)
+        # drain lifecycle: unconditional gauges + the shed counter so a
+        # "rolling restart in progress" panel needs no family bootstrap
+        if self.api.drain_status_fn is not None:
+            ds = self.api.drain_status_fn()
+            gauges["drain/draining"] = 1.0 if ds["draining"] else 0.0
+            gauges["drain/activeQueries"] = ds["activeQueries"]
+            counts["drain/shedQueries"] = ds["shedQueries"]
         if self.api.health_fn is not None:
             try:
                 score = self.api.health_fn()["score"]
@@ -844,6 +927,14 @@ class Handler:
     def post_recalculate_caches(self, params, query, body):
         self.api.recalculate_caches()
         return self._json({})
+
+    def post_cluster_drain(self, params, query, body):
+        """Graceful drain (docs/operations.md "Rolling restarts and
+        drains"): starts the drain in the background and returns the
+        status document immediately; {"abort": true} cancels an
+        in-progress drain and re-announces READY."""
+        req = self._body_json(body)
+        return self._json(self.api.drain(abort=bool(req.get("abort"))))
 
     def post_resize_abort(self, params, query, body):
         self.api.resize_abort()
@@ -977,6 +1068,16 @@ class _RequestHandler(BaseHTTPRequestHandler):
     handler: Handler = None  # injected by server factory
 
     def _handle(self, method: str):
+        if getattr(self.server, "shutting_down", False):
+            # the server was close()d but this keep-alive connection's
+            # thread outlived it (ThreadingHTTPServer only closes the
+            # LISTENER): drop the connection without answering, exactly
+            # as a process exit would — answering from a torn-down
+            # handler would serve stale lifecycle state (e.g. a dead
+            # drain flag) to clients that already reached the restarted
+            # listener on this same port
+            self.close_connection = True
+            return
         parsed = urlparse(self.path)
         length = int(self.headers.get("Content-Length", 0) or 0)
         body = self.rfile.read(length) if length else b""
@@ -1048,6 +1149,9 @@ class HTTPServer:
         self._thread.start()
 
     def close(self) -> None:
+        # flag FIRST: lingering per-connection threads must stop
+        # answering before the listener goes away (see _handle)
+        self._srv.shutting_down = True
         self._srv.shutdown()
         self._srv.server_close()
         if self._thread is not None:

@@ -117,6 +117,12 @@ class Cluster:
         # reference keeps them in Topology with nodeStateDown,
         # cluster.go:1697-1701) but placement routes around them.
         self.down_ids: set[str] = set()
+        # Nodes that announced a graceful drain (node-state broadcast,
+        # server.drain): still ALIVE — they answer probes, serve internal
+        # RPCs, finish in-flight work — but routing, hedging and write
+        # placement treat them like down IMMEDIATELY, without waiting a
+        # probe-timeout for the process to actually exit.
+        self.draining_ids: set[str] = set()
 
     # -- membership ---------------------------------------------------------
 
@@ -195,6 +201,40 @@ class Cluster:
     def is_down(self, node_id: str) -> bool:
         return node_id in self.down_ids
 
+    def is_draining(self, node_id: str) -> bool:
+        return node_id in self.draining_ids
+
+    def is_unavailable(self, node_id: str) -> bool:
+        """Down OR draining: the routing predicate. Every placement
+        decision (fan-out grouping, hedge candidates, write targets)
+        treats a draining peer exactly like a dead one, so a graceful
+        restart stops receiving new work the instant the drain broadcast
+        lands — not liveness_threshold probe timeouts later."""
+        return node_id in self.down_ids or node_id in self.draining_ids
+
+    def mark_draining(self, node_id: str) -> None:
+        """A peer announced a graceful drain (server.drain broadcast)."""
+        if node_id == self.local_id or node_id in self.draining_ids:
+            return
+        self.draining_ids.add(node_id)
+        n = self.node_by_id(node_id)
+        if n is not None and n.state != "DOWN":
+            n.state = "DRAINING"
+        if self.state != STATE_RESIZING:
+            self._recompute_liveness_state()
+
+    def clear_draining(self, node_id: str) -> None:
+        """The drained peer came back (rejoin broadcast / status probe
+        reporting READY) or aborted its drain."""
+        if node_id not in self.draining_ids:
+            return
+        self.draining_ids.discard(node_id)
+        n = self.node_by_id(node_id)
+        if n is not None and n.state == "DRAINING":
+            n.state = "READY"
+        if self.state != STATE_RESIZING:
+            self._recompute_liveness_state()
+
     def mark_down(self, node_id: str) -> None:
         """A peer failed K consecutive liveness probes: route around it and
         recompute cluster state (nodeStateDown + determineClusterState,
@@ -211,9 +251,13 @@ class Cluster:
     def mark_up(self, node_id: str) -> None:
         """A down peer answered a probe again — the temporarily-unavailable
         host came back (cluster.go:1694-1696 'expect it to come back up')."""
-        if node_id not in self.down_ids:
+        if node_id not in self.down_ids and node_id not in self.draining_ids:
             return
         self.down_ids.discard(node_id)
+        # a node confirmed back up is no longer draining either (the
+        # DRAINING mark survives the down transition so a restart that
+        # reuses the drain path clears both at once)
+        self.draining_ids.discard(node_id)
         n = self.node_by_id(node_id)
         if n is not None:
             n.state = "READY"
@@ -227,10 +271,13 @@ class Cluster:
         window (probe-driven mark_down/mark_up) defer; authoritative
         membership replacement (set_static, resize completion) recomputes
         unconditionally — that transition is what ends RESIZING."""
-        self.down_ids &= {n.id for n in self.nodes}
-        if not self.down_ids:
+        member_ids = {n.id for n in self.nodes}
+        self.down_ids &= member_ids
+        self.draining_ids &= member_ids
+        unavailable = self.down_ids | self.draining_ids
+        if not unavailable:
             self._set_state(STATE_NORMAL)
-        elif len(self.down_ids) < self.replica_n:
+        elif len(unavailable) < self.replica_n:
             self._set_state(STATE_DEGRADED)
         else:
             self._set_state(STATE_STARTING)
@@ -262,7 +309,7 @@ class Cluster:
         out: dict[str, list[int]] = {}
         for s in shards:
             nodes = self.shard_nodes(index, s)
-            live = [n for n in nodes if n.id not in self.down_ids]
+            live = [n for n in nodes if not self.is_unavailable(n.id)]
             if live:
                 out.setdefault(live[0].id, []).append(s)
             elif nodes:

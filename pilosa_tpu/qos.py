@@ -75,7 +75,7 @@ MODES = ("off", "observe", "enforce")
 # exactly one of these, and the Prometheus families emit all of them
 # unconditionally so a scrape never sees a missing series
 SHED_REASONS = ("deadline", "estimatedWait", "estimatedCost", "healthRed",
-                "deadlineRemote")
+                "deadlineRemote", "draining")
 THROTTLE_REASONS = ("queriesPerS", "deviceMsPerS", "bytesPerS")
 
 # Retry-After ceiling: backpressure is a hint, not a ban — a throttled
@@ -503,6 +503,14 @@ class QosPlane:
     def record_cost_shed(self) -> None:
         with self._lock:
             self.shed["estimatedCost"] += 1
+
+    def record_drain_shed(self) -> None:
+        """A new external query arrived on a draining node and was shed
+        with `503 + X-Pilosa-Shed-Reason: draining` (server.drain). NOT
+        gated on [qos] mode — drain shedding is a lifecycle decision, not
+        an overload policy; this just rides the same counter families."""
+        with self._lock:
+            self.shed["draining"] += 1
 
     def _reject(self, principal: str, priority: str, status: int,
                 retry_after: float, reason: str,

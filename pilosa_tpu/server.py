@@ -107,7 +107,10 @@ class Server:
                  qos_burst: float = 2.0,
                  qos_max_principals: int = 256,
                  qos_principals: Optional[dict] = None,
-                 gossip_secret: str = ""):
+                 gossip_secret: str = "",
+                 hint_max_bytes: int = 64 << 20,
+                 hint_max_age: float = 3600.0,
+                 drain_timeout: float = 30.0):
         self.data_dir = data_dir
         # [storage] wal-fsync, plumbed down the model tree to every
         # Fragment (PILOSA_TPU_WAL_FSYNC env overrides per fragment —
@@ -184,6 +187,39 @@ class Server:
             self.executor.coalescer.admission_s = fanout_coalesce_window
             self.executor.coalescer.max_batch = max(
                 1, fanout_coalesce_max_batch)
+        # durable hinted handoff (storage/hints.py): replica writes
+        # skipped because the target is down/draining append here and
+        # replay in order when the target returns ([cluster]
+        # hint-max-bytes / hint-max-age knobs; fsync follows wal-fsync —
+        # a hint guards an acked write, so it gets the WAL's durability)
+        from pilosa_tpu.storage.hints import HintStore
+        if hint_max_age < 0 or drain_timeout < 0:
+            raise ValueError(
+                "[cluster] hint-max-age and drain-timeout must be >= 0")
+        self.hints = HintStore(os.path.join(data_dir, ".hints"),
+                               max_bytes=hint_max_bytes,
+                               max_age=hint_max_age,
+                               fsync=(wal_fsync == "always"),
+                               stats=self.stats, logger=self.logger)
+        self.executor.hints = self.hints
+        # graceful-drain lifecycle (docs/operations.md "Rolling restarts
+        # and drains"): SIGTERM / POST /cluster/drain moves this node to
+        # a broadcast DRAINING state, sheds new external queries with
+        # 503 + X-Pilosa-Shed-Reason: draining, waits out in-flight work
+        # and queue flushes, then lands a final snapshot per dirty
+        # fragment so the restart replays no WAL.
+        self.drain_timeout = drain_timeout
+        self.draining = False
+        self.drained = False
+        self._drain_lock = threading.Lock()
+        self._drain_thread: Optional[threading.Thread] = None
+        self._drain_abort = threading.Event()
+        self._drain_info: dict = {}
+        # rejoin read fence: how long a fenced shard may wait for parity
+        # verification before availability wins and the fence lifts loudly
+        self.rejoin_fence_timeout = 120.0
+        self._fence_thread: Optional[threading.Thread] = None
+        self._fence_wake = threading.Event()
         self.api = API(self.holder, self.cluster, executor=self.executor,
                        translate_store=self.cluster_translate)
         # distributed query profiler knobs ([cluster] profile /
@@ -288,6 +324,10 @@ class Server:
             executor=self.executor, ledger=self.usage,
             health_fn=self.node_health, logger=self.logger)
         self.api.qos_plane = self.qos
+        self.api.drain_fn = self.request_drain
+        self.api.drain_status_fn = self.drain_status
+        self.api.node_state_fn = (
+            lambda: "DRAINING" if self.draining else "READY")
         self.handler = Handler(self.api, cluster_message_fn=self.receive_message,
                                stats=self.stats, query_timeout=query_timeout,
                                telemetry=self.telemetry, qos_plane=self.qos)
@@ -447,6 +487,10 @@ class Server:
             if self.membership_interval > 0:
                 self._schedule_membership_refresh()
         self.api.broadcast_fn = self.broadcast
+        # shard-CREATING Set writes announce before the ack
+        # (read-your-writes through any node; see executor.py) — bulk
+        # imports keep the async _on_shard_added queue
+        self.executor.announce_shard_fn = self._announce_shard_bounded
         self.api.resize_fn = self._resize_request
         self.api.abort_fn = self._abort_request
         self.api.forward_import_fn = self.client.import_bits
@@ -478,6 +522,16 @@ class Server:
         if _telemetry.xla.log_fn is None:
             _telemetry.xla.log_fn = self.logger.printf
         self.telemetry.start()
+        # rejoin protocol (docs/operations.md "Rolling restarts and
+        # drains"): (1) read-fence local fragments that may have missed
+        # writes while this process was away, until parity with a replica
+        # is verified; (2) announce the return so peers clear our
+        # DRAINING/down mark and replay queued hints immediately instead
+        # of waiting a probe cycle.
+        self._arm_read_fence()
+        if self.cluster_hosts and not self.join:
+            self.broadcast({"type": "node-state", "id": self.node_id,
+                            "state": "READY"})
         return self
 
     def _schedule_membership_refresh(self) -> None:
@@ -512,6 +566,11 @@ class Server:
                     # otherwise gossip is the failure detector; the HTTP
                     # probe loop would fight its suspicion timing
                     self._probe_peers()
+            # hinted-handoff retry: a replay that failed mid-stream (the
+            # target flapped, an injected fault) keeps its log; if the
+            # target is alive NOW, re-run the return-heal rather than
+            # waiting for another down/up transition that may never come
+            self._retry_pending_hints()
         finally:
             self._schedule_membership_refresh()
 
@@ -639,6 +698,7 @@ class Server:
         # probe concurrently: N down peers must cost one probe_timeout per
         # tick, not N of them (the membership timer is a single thread)
         claims: dict[str, str] = {}  # live peer -> its coordinator claim
+        node_states: dict[str, str] = {}  # live peer -> its nodeState
 
         def probe(node):
             try:
@@ -646,6 +706,7 @@ class Server:
                 claim = st.get("coordinatorID")
                 if claim:
                     claims[node.id] = claim
+                node_states[node.id] = st.get("nodeState", "")
                 return True
             except Exception:  # noqa: BLE001 — ANY probe failure means
                 # not-alive (ClientError, socket teardown mid-close, ...);
@@ -682,6 +743,15 @@ class Server:
                     self.logger.printf("liveness: node %s (%s) back up",
                                        node.id, node.uri)
                     self.cluster.mark_up(node.id)
+                    self._on_node_return(node)
+                elif self.cluster.is_draining(node.id) \
+                        and node_states.get(node.id) == "READY":
+                    # the drained peer restarted and we missed its rejoin
+                    # broadcast: its own /status says READY — clear the
+                    # mark and run the return-heal (hint replay first)
+                    self.logger.printf(
+                        "drain: peer %s back from drain (probe)", node.id)
+                    self.cluster.clear_draining(node.id)
                     self._on_node_return(node)
             else:
                 self._probe_successes.pop(node.id, None)
@@ -839,11 +909,33 @@ class Server:
                         self.logger.printf(
                             "liveness: coordinator re-push to %s failed: %s",
                             node.id, e)
+                # durable hinted handoff first: writes skipped while the
+                # node was away stream back in order (idempotent apply).
+                # The O(blocks) anti-entropy sync runs ONLY when hints
+                # were dropped (byte/age caps, torn log) — a clean replay
+                # IS the heal, no scrub pass required.
+                complete = True
                 try:
-                    self._sync_with_node(node.id)
+                    _r, _d, complete = self.replay_hints(node)
+                except Exception as e:  # noqa: BLE001 — replay failure
+                    # falls back to the full sync below
+                    complete = False
+                    self.logger.printf(
+                        "hints: replay to %s failed: %s", node.id, e)
+                try:
+                    if not complete:
+                        self._sync_with_node(node.id)
                 except Exception as e:  # noqa: BLE001 — best-effort healing
                     self.logger.printf(
                         "liveness: post-return sync failed: %s", e)
+                # tell the returning node its hints are in, so its rejoin
+                # read fence verifies and lifts now, not at the next poll
+                try:
+                    self.client.send_message(node.uri, {
+                        "type": "hints-replayed", "target": node.id,
+                        "from": self.node_id, "complete": complete})
+                except ClientError:
+                    pass
             finally:
                 self._return_sync_running.discard(node.id)
 
@@ -864,6 +956,329 @@ class Server:
                             merged += self._sync_fragment(
                                 iname, fname, vname, shard)
         return merged
+
+    # -- graceful drain + rejoin (docs/operations.md "Rolling restarts") ----
+
+    def _handle_node_state(self, msg: dict) -> None:
+        """A peer's lifecycle announcement: DRAINING routes around it
+        immediately (no probe-timeout wait); READY is the rejoin — clear
+        its marks and run the return-heal (hint replay first, anti-entropy
+        only if hints were dropped)."""
+        nid = msg.get("id")
+        state = msg.get("state")
+        if not nid or nid == self.node_id:
+            return
+        node = self.cluster.node_by_id(nid)
+        if state == "DRAINING":
+            if node is not None and not self.cluster.is_draining(nid):
+                self.logger.printf(
+                    "drain: peer %s is draining — routing around it", nid)
+                self.cluster.mark_draining(nid)
+                self.stats.count("drain/peerDraining")
+        elif state == "READY":
+            was_away = (self.cluster.is_down(nid)
+                        or self.cluster.is_draining(nid))
+            self.cluster.mark_up(nid)
+            self.cluster.clear_draining(nid)
+            self._probe_failures.pop(nid, None)
+            self._probe_successes.pop(nid, None)
+            if was_away and node is not None:
+                self.logger.printf(
+                    "drain: peer %s rejoined — replaying hints", nid)
+                self._on_node_return(node)
+
+    def request_drain(self, abort: bool = False,
+                      timeout: Optional[float] = None) -> dict:
+        """API hook for POST /cluster/drain (and the CLI's SIGTERM path):
+        start the drain on a background thread — the endpoint answers
+        immediately with the status document; operators poll /status
+        (nodeState) for completion. abort=True cancels an in-progress
+        drain and re-announces READY."""
+        if abort:
+            self.abort_drain()
+            return self.drain_status()
+        with self._drain_lock:
+            if self._drain_thread is None or not self._drain_thread.is_alive():
+                self._drain_abort.clear()
+                self._drain_thread = threading.Thread(
+                    target=self.drain, args=(timeout,), daemon=True,
+                    name="pilosa-drain")
+                self._drain_thread.start()
+        return self.drain_status()
+
+    def abort_drain(self) -> None:
+        """Cancel a drain: stop shedding, re-announce READY so peers
+        restore routing (an operator's change of heart must not leave the
+        node half-out of the cluster)."""
+        if not self.draining:
+            return
+        self._drain_abort.set()
+        self.draining = False
+        self.handler.draining = False
+        me = self.cluster.local_node
+        if me is not None and me.state == "DRAINING":
+            me.state = "READY"
+        self.logger.printf("drain: aborted — resuming service")
+        self.broadcast({"type": "node-state", "id": self.node_id,
+                        "state": "READY"})
+
+    def _drain_wait(self, cond, deadline: Optional[float]) -> bool:
+        while not cond():
+            if self._drain_abort.is_set() or self.closed:
+                return False
+            if deadline is not None and time.monotonic() >= deadline:
+                return False
+            time.sleep(0.01)
+        return True
+
+    def drain(self, timeout: Optional[float] = None) -> dict:
+        """The graceful-drain sequence, run to completion (synchronous;
+        request_drain wraps it in a thread):
+
+          1. shed — new external queries get 503 + Retry-After +
+             X-Pilosa-Shed-Reason: draining; internal RPCs (replica
+             writes, fragment retrieval, stats, hint replay) keep working
+          2. announce — peers mark this node DRAINING and route/hedge/
+             coalesce around it immediately
+          3. settle — in-flight external queries finish, then the device
+             batchers and the network coalescer flush their queues
+          4. persist — rank caches flush, every fragment with pending WAL
+             ops (or volatile bulk loads) lands a final snapshot, so the
+             restarted process replays nothing
+        The node then reports nodeState=DRAINING until the process exits
+        (the operator's signal to proceed with the restart)."""
+        timeout = self.drain_timeout if timeout is None else timeout
+        t0 = time.monotonic()
+        deadline = t0 + timeout if timeout and timeout > 0 else None
+        first = not self.draining
+        if first:
+            self.draining = True
+            self.handler.draining = True
+            me = self.cluster.local_node
+            if me is not None:
+                me.state = "DRAINING"
+            self.stats.count("drain/started")
+            self.logger.printf(
+                "drain: shedding new external queries (timeout %.1fs)",
+                timeout)
+            self.broadcast({"type": "node-state", "id": self.node_id,
+                            "state": "DRAINING"})
+        inflight_ok = self._drain_wait(
+            lambda: self.handler.active_queries == 0, deadline)
+
+        def queues_empty() -> bool:
+            depth = 0
+            for attr in ("batcher", "sum_batcher", "minmax_batcher",
+                         "coalescer"):
+                b = getattr(self.executor, attr, None)
+                if b is not None:
+                    depth += b.queue_depth()
+            return depth == 0
+
+        flushed_ok = self._drain_wait(queues_empty, deadline)
+        if self._drain_abort.is_set():
+            return self.drain_status()
+        snapshotted = 0
+        snapshot_errors = 0
+        try:
+            self.holder.flush_caches()
+        except Exception as e:  # noqa: BLE001 — caches are rebuildable
+            self.logger.printf("drain: cache flush failed: %s", e)
+        for iname, fname, vname, shard, frag in \
+                list(self.holder.walk_fragments()):
+            dirty = (int(getattr(frag.storage, "op_n", 0) or 0) > 0
+                     or getattr(frag, "_volatile", False))
+            if not dirty:
+                continue
+            try:
+                frag.snapshot()
+                snapshotted += 1
+            except (OSError, ValueError) as e:
+                snapshot_errors += 1
+                self.logger.printf(
+                    "drain: final snapshot of %s/%s/%s/%d failed: %s",
+                    iname, fname, vname, shard, e)
+        self.drained = True
+        self._drain_info = {
+            "inflightDrained": inflight_ok,
+            "queuesFlushed": flushed_ok,
+            "snapshotted": snapshotted,
+            "snapshotErrors": snapshot_errors,
+            "durationSeconds": round(time.monotonic() - t0, 3),
+        }
+        self.stats.count("drain/completed")
+        self.logger.printf(
+            "drain: complete in %.2fs (inflight=%s queues=%s snapshots=%d)"
+            " — safe to stop the process",
+            self._drain_info["durationSeconds"], inflight_ok, flushed_ok,
+            snapshotted)
+        return self.drain_status()
+
+    def drain_status(self) -> dict:
+        """The drain/* observability block (/debug/vars, /cluster/drain
+        responses, unconditional /metrics gauges)."""
+        out = {
+            "draining": self.draining,
+            "drained": self.drained,
+            "shedQueries": self.handler.drain_sheds,
+            "activeQueries": self.handler.active_queries,
+            "timeoutSeconds": self.drain_timeout,
+        }
+        out.update(self._drain_info)
+        return out
+
+    # -- read-fenced rejoin --------------------------------------------------
+
+    def _arm_read_fence(self) -> None:
+        """Fence every local fragment's (index, shard) at startup when
+        this node is (re)joining a multi-node cluster: the fragments may
+        have missed writes while the process was away, and a fenced read
+        routes to a peer replica until block checksums confirm parity
+        (or a scrub heals the divergence). Single-node clusters and empty
+        data dirs have nothing to fence."""
+        if not self.cluster_hosts and not self.join:
+            return
+        keys = {(iname, shard) for iname, _f, _v, shard, _frag
+                in self.holder.walk_fragments()}
+        if not keys:
+            return
+        n = self.executor.fence_reads(keys)
+        if not n:
+            return
+        self.stats.count("readFence/fenced", n)
+        self.logger.printf(
+            "rejoin: read-fenced %d shard(s) pending parity verification "
+            "(reads route to replicas until hints replay or a checksum "
+            "scrub confirms)", n)
+        self._start_fence_worker()
+
+    def _start_fence_worker(self) -> None:
+        self._fence_wake.set()
+        t = self._fence_thread
+        if t is not None and t.is_alive():
+            return
+        self._fence_thread = threading.Thread(
+            target=self._fence_worker, daemon=True, name="pilosa-fence")
+        self._fence_thread.start()
+
+    def _fence_worker(self) -> None:
+        deadline = time.monotonic() + self.rejoin_fence_timeout
+        while not self.closed and self.executor.read_fence:
+            try:
+                self._verify_fence_pass()
+            except Exception as e:  # noqa: BLE001 — a verify failure
+                # (peer mid-restart, transient RPC) retries next tick
+                self.logger.printf("rejoin: fence verify pass failed: %s", e)
+            if not self.executor.read_fence:
+                break
+            if time.monotonic() >= deadline:
+                # availability wins over an unverifiable fence (e.g. every
+                # replica stayed down): lift it LOUDLY — the anti-entropy
+                # scrubber remains the backstop for any real divergence
+                with self.executor._fence_lock:
+                    n = len(self.executor.read_fence)
+                    self.executor.read_fence.clear()
+                self.stats.count("readFence/expired", n)
+                self.logger.printf(
+                    "rejoin: fence expired after %.0fs with %d shard(s) "
+                    "unverified — serving local data; anti-entropy will "
+                    "heal any divergence", self.rejoin_fence_timeout, n)
+                break
+            self._fence_wake.wait(0.25)
+            self._fence_wake.clear()
+
+    def _verify_fence_pass(self) -> int:
+        """One pass over fenced shards: compare every local fragment's
+        block checksums with a live replica — parity lifts the fence;
+        divergence runs the block-majority scrub for that fragment first
+        (the 'block-checksum-verified scrub' of the rejoin contract).
+        Shards with no reachable replica stay fenced for the next pass."""
+        lifted = 0
+        fence = sorted(self.executor.read_fence)
+        for iname, shard in fence:
+            idx = self.holder.index(iname)
+            if idx is None:
+                self.executor.unfence_reads((iname, shard))
+                lifted += 1
+                continue
+            owners = self.cluster.shard_nodes(iname, shard)
+            # a draining peer still serves verification reads; only
+            # probe-dead peers are unusable
+            peers = [n for n in owners
+                     if n.id != self.node_id and n.uri
+                     and not self.cluster.is_down(n.id)]
+            if not peers:
+                if len(owners) <= 1 or all(n.id == self.node_id
+                                           for n in owners):
+                    # no replica configured for this shard: nothing to
+                    # verify against, and nobody else can serve it
+                    self.executor.unfence_reads((iname, shard))
+                    lifted += 1
+                continue
+            peer = peers[0]
+            verified = True
+            healed = False
+            for fname, field in idx.fields.items():
+                for vname, view in field.views.items():
+                    frag = view.fragment(shard)
+                    if frag is None:
+                        continue
+                    try:
+                        remote = {b["id"]: b["checksum"]
+                                  for b in self.client.fragment_blocks(
+                                      peer.uri, iname, fname, vname, shard)}
+                    except ClientError as e:
+                        if e.code == "fragment-not-found":
+                            remote = {}
+                        else:
+                            verified = False  # unreachable: retry later
+                            break
+                    local = {b: c.hex() for b, c in frag.blocks()}
+                    if local != remote:
+                        # diverged: heal NOW via the block-majority sync,
+                        # then the fence lifts on the healed state
+                        self._sync_fragment(iname, fname, vname, shard)
+                        healed = True
+                if not verified:
+                    break
+            if verified:
+                self.executor.unfence_reads((iname, shard))
+                lifted += 1
+                self.stats.count("readFence/verified")
+                if healed:
+                    self.stats.count("readFence/healed")
+        return lifted
+
+    # -- hint replay ---------------------------------------------------------
+
+    def _retry_pending_hints(self) -> None:
+        """Re-drive the return-heal for any LIVE member that still has a
+        queued hint log (a previous replay failed mid-stream). Runs on
+        the membership tick; single-flight per target via the
+        _return_sync_running guard inside _on_node_return."""
+        if not self.hints.pending_targets():
+            return
+        for n in list(self.cluster.nodes):
+            if (n.id != self.node_id and n.uri
+                    and not self.cluster.is_unavailable(n.id)
+                    and self.hints.pending(n.id)):
+                self._on_node_return(n)
+
+    def replay_hints(self, node) -> tuple[int, int, bool]:
+        """Stream queued hints to a returned peer in order, applying each
+        as the idempotent remote write it originally was. Returns
+        (replayed, dropped, complete) — see HintStore.replay."""
+        def apply(doc: dict) -> None:
+            self.client.query_proto(node.uri, doc["index"], doc["pql"],
+                                    shards=doc.get("shards"), remote=True)
+
+        replayed, dropped, complete = self.hints.replay(node.id, apply)
+        if replayed or dropped:
+            self.logger.printf(
+                "hints: replayed %d hint(s) to %s, %d dropped%s",
+                replayed, node.id, dropped,
+                "" if complete else " — anti-entropy will finish the heal")
+        return replayed, dropped, complete
 
     def close(self) -> None:
         self.closed = True
@@ -952,6 +1367,16 @@ class Server:
             self._handle_resize_complete(msg)
         elif mtype == "resize-abort":
             self._abort_request()
+        elif mtype == "node-state":
+            self._handle_node_state(msg)
+        elif mtype == "hints-replayed":
+            # a peer finished streaming its queued hints to us: wake the
+            # rejoin verifier so the read fence lifts as soon as block
+            # checksums confirm parity (instead of at the next poll tick)
+            if msg.get("target") == self.node_id:
+                self._fence_wake.set()
+                if self.executor.read_fence:
+                    self._start_fence_worker()
         elif mtype == "topology":
             self._apply_topology(msg["nodes"], msg.get("removed"))
         elif mtype == "cluster-state":
@@ -1015,6 +1440,34 @@ class Server:
             self.client.send_message(uri, msg)
         except ClientError:
             pass  # peers converge via anti-entropy
+
+    # budget for the pre-ack create-shard announcement of a shard-CREATING
+    # Set: healthy peers answer within ~1 RTT; a hung peer costs at most
+    # this (once per new shard — its daemon sender keeps trying after the
+    # ack, so delivery is attempted either way)
+    ANNOUNCE_SHARD_BUDGET_S = 0.5
+
+    def _announce_shard_bounded(self, iname: str, fname: str,
+                                shard: int) -> None:
+        """Concurrent create-shard broadcast with a bounded wait, run
+        BEFORE a shard-creating Set() acks: an immediately-following read
+        through any live node must not race the async announcement queue
+        (PR-1 made the per-write announcement async precisely so a hung
+        peer adds no write latency — that holds for the common case; only
+        the once-per-shard-lifetime CREATING write pays a bounded wait)."""
+        msg = {"type": "create-shard", "index": iname, "field": fname,
+               "shard": shard}
+        uris = self._peer_uris()
+        if not uris:
+            return
+        threads = [threading.Thread(target=self._send_quiet,
+                                    args=(u, msg), daemon=True)
+                   for u in uris]
+        for t in threads:
+            t.start()
+        deadline = time.monotonic() + self.ANNOUNCE_SHARD_BUDGET_S
+        for t in threads:
+            t.join(max(0.0, deadline - time.monotonic()))
 
     # per-peer async queue bound: a long-hung peer must not grow its queue
     # without limit — dropped messages converge via anti-entropy / the
@@ -1192,6 +1645,7 @@ class Server:
             # degraded removal (too few nodes to rebuild replicas) — the
             # membership already changed; converge peers now
             self._removed_ids.add(node_id)
+            self.hints.drop_target(node_id)  # never deliverable again
             self._broadcast_topology()
             # tell the victim it is out so it stops acting as a member
             if victim is not None and victim.uri:
@@ -1353,6 +1807,9 @@ class Server:
             return
         if self._resize_watchdog is not None:
             self._resize_watchdog.cancel()
+        if job.event == EVENT_LEAVE:
+            # the departed node's queued hints are never deliverable
+            self.hints.drop_target(job.node_id)
         self._broadcast_topology()
         # tell the departed node it is out so it stops acting as a member
         if job.event == EVENT_LEAVE and job.node is not None and job.node.uri:
@@ -1570,6 +2027,17 @@ class Server:
             else 1.0
         raw["hedges.fired"] = getattr(ex, "hedges_fired", 0)
         raw["hedges.won"] = getattr(ex, "hedges_won", 0)
+        # hinted handoff + drain lifecycle + rejoin read fence
+        hsnap = self.hints.snapshot()
+        g["hints.pending_bytes"] = float(hsnap["pendingBytes"])
+        g["hints.pending_targets"] = float(len(hsnap["pendingTargets"]))
+        raw["hints.queued"] = hsnap["queued"]
+        raw["hints.replayed"] = hsnap["replayed"]
+        raw["hints.dropped"] = hsnap["dropped"]
+        g["drain.draining"] = 1.0 if self.draining else 0.0
+        raw["drain.shed"] = self.handler.drain_sheds
+        g["fence.fenced_shards"] = float(
+            ex.fence_snapshot()["fencedShards"])
         wal_bytes = 0
         wal_ops = 0
         poisoned = 0
@@ -1649,6 +2117,10 @@ class Server:
         g["qos.admitted_per_s"] = rate("qos.admitted")
         g["qos.shed_per_s"] = rate("qos.shed")
         g["qos.throttled_per_s"] = rate("qos.throttled")
+        g["hints.queued_per_s"] = rate("hints.queued")
+        g["hints.replayed_per_s"] = rate("hints.replayed")
+        g["hints.dropped_per_s"] = rate("hints.dropped")
+        g["drain.shed_per_s"] = rate("drain.shed")
         g["hedges.fired_per_s"] = rate("hedges.fired")
         g["http.errors_per_s"] = rate("http.errors")
         g["xla.compiles_per_s"] = rate("xla.compiles")
@@ -1687,6 +2159,11 @@ class Server:
             "errorRate": latest.get("http.errors_per_s", 0.0),
             "queueSaturation": ps["queued"] / max(1, ps["size"]),
             "recompileStormActive": _telemetry.xla.storm_active(),
+            # lifecycle: a draining node is deliberately yellow (the
+            # federation renders the restart as in-progress, not broken),
+            # and unverified fenced shards keep the rejoin visible
+            "draining": self.draining,
+            "fencedShards": self.executor.fence_snapshot()["fencedShards"],
         }
         slo = getattr(self.api, "slo", None)
         if slo is not None:
@@ -1717,7 +2194,9 @@ class Server:
         return {
             "id": self.node_id,
             "uri": self.http.uri,
-            "state": self.cluster.state,
+            # a draining node reports DRAINING (the federation renders it
+            # yellow via the health inputs); otherwise the cluster state
+            "state": "DRAINING" if self.draining else self.cluster.state,
             "version": __version__,
             "uptimeSeconds": int(time.time() - self.api.start_time),
             "health": _telemetry.health_score(inputs),
@@ -1756,10 +2235,21 @@ class Server:
             if n.id == self.node_id:
                 continue
             if self.cluster.is_down(n.id):
-                entries[n.id] = {
-                    "id": n.id, "uri": n.uri, "state": "down",
-                    "health": {"score": "red", "reasons": [
-                        "node marked down (liveness)"]}}
+                if self.cluster.is_draining(n.id):
+                    # a drained node that went away is mid-restart, not
+                    # failed: yellow until it rejoins (or the drain mark
+                    # ages into a plain down if it never comes back —
+                    # probes clear the draining mark only via mark_up)
+                    entries[n.id] = {
+                        "id": n.id, "uri": n.uri, "state": "DRAINING",
+                        "health": {"score": "yellow", "reasons": [
+                            "node draining (rolling restart in "
+                            "progress)"]}}
+                else:
+                    entries[n.id] = {
+                        "id": n.id, "uri": n.uri, "state": "down",
+                        "health": {"score": "red", "reasons": [
+                            "node marked down (liveness)"]}}
                 continue
             if not n.uri:
                 entries[n.id] = {
@@ -1769,13 +2259,23 @@ class Server:
                 continue
 
             def fetch(node=n):
+                draining = self.cluster.is_draining(node.id)
                 try:
                     doc = self.client.node_stats(node.uri, timeout)
                     doc.setdefault("id", node.id)
                     doc.setdefault("uri", node.uri)
                     entries[node.id] = doc
                 except ClientError as e:
-                    if e.status == 404:
+                    if draining:
+                        # mid-restart: the drained process has stopped
+                        # answering but has NOT failed — yellow, not red
+                        entries[node.id] = {
+                            "id": node.id, "uri": node.uri,
+                            "state": "DRAINING",
+                            "health": {"score": "yellow", "reasons": [
+                                "node draining (rolling restart in "
+                                "progress)"]}}
+                    elif e.status == 404:
                         entries[node.id] = {
                             "id": node.id, "uri": node.uri, "state": "up",
                             "health": {"score": "legacy", "reasons": [

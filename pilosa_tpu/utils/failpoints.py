@@ -100,6 +100,12 @@ POINTS: dict[str, tuple[tuple[str, ...], str]] = {
     "server.scrub.fragment": (
         (RAISE, DELAY),
         "server.py Server._sync_fragment (per-fragment scrub)"),
+    "storage.hints.append": (
+        (RAISE, DELAY),
+        "storage/hints.py HintStore.append (pre-write)"),
+    "storage.hints.replay": (
+        (RAISE, DELAY),
+        "storage/hints.py HintStore.replay (per-record apply)"),
 }
 
 _mu = threading.RLock()

@@ -394,6 +394,8 @@ def health_score(inputs: dict) -> dict:
     the two surfaces can never disagree. Inputs (all optional, absent =
     healthy): walPoisoned, needsRebuild, damagedFragments, errorRate
     (5xx/s), queueSaturation (queued / pool size), recompileStormActive,
+    draining (graceful restart in progress — yellow, never red),
+    fencedShards (rejoin read fence awaiting parity verification),
     sloStatus/sloReason (the worst [slo] objective's multi-window
     burn-rate verdict, utils/accounting.py SLOTracker.worst()).
     Liveness is the federation layer's job (a down node never answers)."""
@@ -426,6 +428,14 @@ def health_score(inputs: dict) -> dict:
         worsen("yellow", f"fan-out queue saturated ({sat:.1f}x pool size)")
     if inputs.get("recompileStormActive"):
         worsen("yellow", "XLA recompile storm in progress")
+    if inputs.get("draining"):
+        # deliberate lifecycle state: yellow, never red — a rolling
+        # restart in progress must not page anyone or trip QoS healthRed
+        worsen("yellow", "node draining (graceful restart in progress)")
+    fenced = int(inputs.get("fencedShards") or 0)
+    if fenced:
+        worsen("yellow", f"{fenced} shard(s) read-fenced pending rejoin "
+                         "parity verification")
     slo_status = inputs.get("sloStatus")
     if slo_status in ("yellow", "red"):
         worsen(slo_status,

@@ -87,6 +87,13 @@ class ScriptedServer:
                 elif action == "503-no-retry-after":
                     body = b'{"error": "down"}'
                     status = b"503 Service Unavailable"
+                elif action == "503-draining":
+                    # the graceful-drain rejection (server.drain): carries
+                    # BOTH Retry-After and the shed-reason header
+                    body = b'{"error": "node is draining", "code": "shed"}'
+                    status = b"503 Service Unavailable"
+                    extra = (b"Retry-After: 1\r\n"
+                             b"X-Pilosa-Shed-Reason: draining\r\n")
                 elif action == "400":
                     body = b'{"error": "bad", "code": "ErrTest"}'
                     status = b"400 Bad Request"
@@ -243,6 +250,40 @@ def test_503_without_retry_after_is_not_retried():
         assert exc.value.status == 503
         assert exc.value.retry_after is None
         assert srv.requests == 1
+    finally:
+        srv.close()
+
+
+def test_503_draining_fails_over_immediately_no_backoff(monkeypatch):
+    # a 503 carrying X-Pilosa-Shed-Reason: draining means "this node is
+    # gracefully restarting — go to another replica": the client must
+    # surface it at once (no backoff sleep, no re-issue to the SAME
+    # node), even though Retry-After is present — unlike quota 429s,
+    # which keep the capped jittered backoff
+    import pilosa_tpu.net.client as client_mod
+    sleeps = []
+    monkeypatch.setattr(client_mod.time, "sleep", sleeps.append)
+    srv = ScriptedServer(["503-draining", "ok"])
+    try:
+        c = InternalClient(timeout=5)
+        with pytest.raises(ClientError) as exc:
+            c._json("POST", srv.uri, "/x", {})
+        assert exc.value.status == 503
+        assert exc.value.shed_reason == "draining"
+        assert exc.value.retry_after == 1.0  # parsed, surfaced to caller
+        assert srv.requests == 1  # never re-sent to the draining node
+        assert sleeps == []  # and never slept
+    finally:
+        srv.close()
+
+
+def test_shed_reason_absent_on_plain_errors():
+    srv = ScriptedServer(["400"])
+    try:
+        c = InternalClient(timeout=5)
+        with pytest.raises(ClientError) as exc:
+            c._json("POST", srv.uri, "/x", {})
+        assert exc.value.shed_reason == ""
     finally:
         srv.close()
 

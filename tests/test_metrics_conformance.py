@@ -356,6 +356,52 @@ def test_live_metrics_qos_families(pair):
     assert mode == 0.0
 
 
+def test_live_metrics_handoff_drain_and_fence_families(pair):
+    """Zero-downtime-operations PR satellite: the hinted-handoff
+    counters (writeHandoffs/{queued,replayed,dropped} — the previously
+    SILENT skipped-replica writes), the drain lifecycle gauges and the
+    rejoin read-fence counters are scrapeable, emitted unconditionally
+    (zeros included — this cluster never drained) so a hint-log-growth
+    alert can never race the first skipped write. The drain shed reason
+    also joins the QoS shed family keyspace."""
+    servers, uris = pair
+    with urllib.request.urlopen(uris[0] + "/metrics", timeout=10) as r:
+        text = r.read().decode()
+    types, samples = check_conformance(text)
+    assert types["pilosa_writeHandoffs_total"] == "counter"
+    hkeys = {l.get("key") for n, l, _ in samples
+             if n == "pilosa_writeHandoffs_total"}
+    assert {"queued", "replayed", "dropped", "replayFailures"} <= hkeys
+    assert types["pilosa_writeHandoffs"] == "gauge"
+    gkeys = {l.get("key") for n, l, _ in samples
+             if n == "pilosa_writeHandoffs"}
+    assert {"pendingBytes", "pendingTargets"} <= gkeys
+    # drain lifecycle: gauge 0 on a healthy node + the shed counter
+    assert types["pilosa_drain"] == "gauge"
+    dkeys = {l.get("key") for n, l, _ in samples if n == "pilosa_drain"}
+    assert {"draining", "activeQueries"} <= dkeys
+    draining = next(v for n, l, v in samples
+                    if n == "pilosa_drain" and l.get("key") == "draining")
+    assert draining == 0.0
+    assert types["pilosa_drain_total"] == "counter"
+    assert ("drain/shedQueries".split("/")[1] in
+            {l.get("key") for n, l, _ in samples
+             if n == "pilosa_drain_total"})
+    # rejoin read fence
+    assert types["pilosa_readFence_total"] == "counter"
+    fkeys = {l.get("key") for n, l, _ in samples
+             if n == "pilosa_readFence_total"}
+    assert {"rerouted", "refusedRemote", "servedStale"} <= fkeys
+    fenced = next(v for n, l, v in samples
+                  if n == "pilosa_readFence"
+                  and l.get("key") == "fencedShards")
+    assert fenced == 0.0
+    # "draining" is a first-class shed reason in the QoS glossary
+    assert ("shed", "draining") in {
+        (l.get("key"), l.get("reason")) for n, l, _ in samples
+        if n == "pilosa_qos_total"}
+
+
 def test_stats_registry_drift_guard(pair):
     """Tier-1 drift guard: every counter/gauge/timing name registered in
     the live StatsClient reaches the /metrics exposition — so a future PR
