@@ -21,3 +21,11 @@ Architecture (TPU-first, not a port):
 __version__ = "0.1.0"
 
 from pilosa_tpu.constants import SHARD_WIDTH, WORDS_PER_SHARD  # noqa: F401
+
+# runtime lock-order witness (pilosa_tpu/analysis/lockwitness.py): when
+# PILOSA_TPU_LOCKCHECK=1, instrument every Lock/RLock the package
+# constructs from here on — armed at package import so ANY entry point
+# (server CLI, tests, benches) honors the gate. Zero-cost otherwise.
+from pilosa_tpu.analysis import lockwitness as _lockwitness  # noqa: E402
+
+_lockwitness.maybe_install()

@@ -1,0 +1,35 @@
+"""pilosa-lint + runtime lock-order witness.
+
+The serving stack's correctness rests on disciplines that used to be
+hand-maintained: contextvars copied at every thread boundary (trace /
+principal / deadline attribution), `time.monotonic()` for every deadline
+or elapsed computation, no blocking I/O or RPC while holding a lock, every
+registered stat reaching `/metrics`, every `PILOSA_TPU_*` env gate and
+every config knob documented. This package encodes those invariants as
+mechanical checks:
+
+* `lint` — an AST-based static pass over the tree (`run_lint`), plus
+  inventory diffs of env gates and config knobs against
+  docs/operations.md (`inventories`). CLI: `python -m pilosa_tpu.analysis
+  [--check]`; `--check` exits non-zero on any finding not in the
+  committed baseline (pilosa_tpu/analysis/baseline.txt — kept EMPTY).
+* `lockwitness` — an instrumented Lock/RLock wrapper (env-gated
+  `PILOSA_TPU_LOCKCHECK=1`, zero-cost pass-through otherwise) recording
+  the per-thread lock acquisition graph: cycles (potential deadlock) and
+  locks held across RPC / device dispatch are reported with the stacks
+  that formed them. The tier-1 conftest enables it for the whole suite,
+  so every concurrency test doubles as a race regression test.
+
+See docs/operations.md "Static analysis and race detection".
+"""
+
+from pilosa_tpu.analysis.lint import Finding, run_lint  # noqa: F401
+from pilosa_tpu.analysis.inventories import (  # noqa: F401
+    config_knob_findings, env_gate_findings)
+
+
+def run_all(root: str) -> list:
+    """Every static finding over the tree rooted at `root` (repo root):
+    AST lint rules + env-gate / config-knob inventory diffs."""
+    return (run_lint(root) + env_gate_findings(root)
+            + config_knob_findings(root))

@@ -1,0 +1,77 @@
+"""CLI: `python -m pilosa_tpu.analysis [--check] [--root DIR]`.
+
+Prints every static finding as `path:line: rule: message`. With
+`--check`, exits non-zero if any finding is not covered by the baseline
+file (pilosa_tpu/analysis/baseline.txt by default) — the committed
+baseline is EMPTY and must stay so; it exists as the escape hatch for an
+incident branch, not as a suppression registry.
+
+Baseline format: one `path:rule` or `path:line: rule: message` prefix per
+line; `#` comments and blank lines ignored.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+
+def _load_baseline(path: str) -> list[str]:
+    if not os.path.exists(path):
+        return []
+    out = []
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if line and not line.startswith("#"):
+                out.append(line)
+    return out
+
+
+def _in_baseline(rendered: str, path: str, rule: str,
+                 baseline: list[str]) -> bool:
+    return any(rendered.startswith(b) or b == f"{path}:{rule}"
+               for b in baseline)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m pilosa_tpu.analysis",
+        description="pilosa-lint: static concurrency/observability "
+                    "invariant checks (docs/operations.md \"Static "
+                    "analysis and race detection\")")
+    parser.add_argument("--root", default=None,
+                        help="repo root (default: auto-detected from the "
+                             "installed package location)")
+    parser.add_argument("--baseline", default=None,
+                        help="baseline file (default: "
+                             "pilosa_tpu/analysis/baseline.txt)")
+    parser.add_argument("--check", action="store_true",
+                        help="exit 1 on any finding not in the baseline")
+    args = parser.parse_args(argv)
+
+    root = args.root or os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    baseline_path = args.baseline or os.path.join(
+        root, "pilosa_tpu", "analysis", "baseline.txt")
+
+    from pilosa_tpu.analysis import run_all
+
+    findings = run_all(root)
+    baseline = _load_baseline(baseline_path)
+    fresh = [f for f in findings
+             if not _in_baseline(f.render(), f.path, f.rule, baseline)]
+    for f in findings:
+        marker = "" if f in fresh else " (baselined)"
+        print(f.render() + marker)
+    n = len(findings)
+    print(f"pilosa-lint: {n} finding{'s' if n != 1 else ''}"
+          f" ({len(fresh)} outside baseline)")
+    if args.check and fresh:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

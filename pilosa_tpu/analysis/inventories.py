@@ -1,0 +1,107 @@
+"""Inventory diffs: env gates and config knobs vs docs/operations.md.
+
+Two drift guards that complement the stats-registry guard in
+tests/test_metrics_conformance.py:
+
+* env gates — every `PILOSA_TPU_*` name referenced anywhere under
+  pilosa_tpu/ must appear in docs/operations.md, so an operator reading
+  the env-var table sees the complete gate surface.
+* config knobs — every field of every `[section]` dataclass in
+  cli/config.py must appear (kebab-case) BOTH in docs/operations.md and
+  in `Config.to_toml()` (the serialization a knob must ride to be
+  wired cli→config→Server; a field missing there is a knob that cannot
+  round-trip through `pilosa-tpu config`).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import re
+from typing import Optional
+
+from pilosa_tpu.analysis.lint import Finding, iter_py_files
+
+_ENV_TOKEN = re.compile(r"PILOSA_TPU_[A-Z0-9_]*[A-Z0-9]")
+
+
+def _read(path: str) -> str:
+    with open(path, encoding="utf-8") as f:
+        return f.read()
+
+
+def env_gate_inventory(root: str) -> dict[str, tuple[str, int]]:
+    """{env name: (relpath, first line referencing it)} over pilosa_tpu/."""
+    out: dict[str, tuple[str, int]] = {}
+    for path in iter_py_files(root):
+        rel = os.path.relpath(path, root).replace(os.sep, "/")
+        for lineno, line in enumerate(_read(path).splitlines(), 1):
+            for m in _ENV_TOKEN.finditer(line):
+                out.setdefault(m.group(0), (rel, lineno))
+    return out
+
+
+def _read_docs(root: str) -> Optional[str]:
+    path = os.path.join(root, "docs", "operations.md")
+    if not os.path.exists(path):
+        return None
+    return _read(path)
+
+
+def env_gate_findings(root: str) -> list[Finding]:
+    docs = _read_docs(root)
+    if docs is None:
+        return [Finding("docs/operations.md", 0, "env-gate-docs",
+                        f"docs/operations.md not found under {root}; "
+                        "pass --root <repo root>")]
+    findings = []
+    for name, (rel, lineno) in sorted(env_gate_inventory(root).items()):
+        if name not in docs:
+            findings.append(Finding(
+                rel, lineno, "env-gate-docs",
+                f"env gate {name} is read in code but undocumented in "
+                "docs/operations.md"))
+    return findings
+
+
+def config_knob_inventory() -> list[tuple[str, str]]:
+    """[(section, kebab-knob)] from the Config dataclass tree; the
+    top-level scalars report section ""."""
+    from pilosa_tpu.cli.config import Config
+
+    knobs: list[tuple[str, str]] = []
+    cfg = Config()
+    for f in dataclasses.fields(Config):
+        sub = getattr(cfg, f.name)
+        if dataclasses.is_dataclass(sub):
+            section = f.name.replace("_", "-")
+            for sf in dataclasses.fields(type(sub)):
+                knobs.append((section, sf.name.replace("_", "-")))
+        else:
+            knobs.append(("", f.name.replace("_", "-")))
+    return knobs
+
+
+def config_knob_findings(root: str) -> list[Finding]:
+    from pilosa_tpu.cli.config import Config
+
+    docs = _read_docs(root)
+    if docs is None:
+        return [Finding("docs/operations.md", 0, "config-knob-docs",
+                        f"docs/operations.md not found under {root}; "
+                        "pass --root <repo root>")]
+    toml = Config().to_toml()
+    cfg_rel = "pilosa_tpu/cli/config.py"
+    findings = []
+    for section, knob in config_knob_inventory():
+        label = f"[{section}] {knob}" if section else knob
+        if knob not in docs:
+            findings.append(Finding(
+                cfg_rel, 0, "config-knob-docs",
+                f"knob {label} is undocumented in docs/operations.md"))
+        if knob not in toml:
+            findings.append(Finding(
+                cfg_rel, 0, "config-knob-wiring",
+                f"knob {label} missing from Config.to_toml() — it cannot "
+                "round-trip through `pilosa-tpu config`"))
+    return findings

@@ -1,0 +1,271 @@
+"""pilosa-lint: AST rules encoding the codebase's concurrency and
+observability disciplines.
+
+Scope: every `.py` under `pilosa_tpu/` (the serving tree — tests and
+benches may legitimately use raw threads, wall clocks and ad-hoc stats).
+Each rule emits `Finding(path, line, rule, msg)`; the committed baseline
+(baseline.txt) must stay empty, so every finding is fixed at the source,
+never suppressed.
+
+Rules (glossary also in docs/operations.md):
+
+ctx-thread      `threading.Thread(...)` / `threading.Timer(...)` outside
+                pilosa_tpu/utils/threads.py — a raw thread starts in an
+                EMPTY context, dropping trace/principal/deadline
+                attribution at the boundary. Route through
+                utils.threads.{spawn,ctx_thread,ctx_timer}.
+ctx-submit      `<pool>.submit(...)` on an executor-like receiver whose
+                first argument is not `contextvars.copy_context().run`
+                (use utils.threads.submit_ctx or the explicit form).
+wall-clock      `time.time()` without a `# wall-clock` annotation.
+                Deadline/elapsed arithmetic must use `time.monotonic()`
+                (wall time jumps under NTP step/slew); wall clock is
+                legitimate ONLY for serialized timestamps, and the
+                annotation marks that intent reviewably.
+bare-except     `except:` — swallows KeyboardInterrupt/SystemExit and
+                hides bugs; name the exception(s).
+swallowed-future  a discarded `<pool>.submit(...)` expression — the
+                Future's exception can never be observed.
+lock-blocking   blocking I/O (`fsync`, socket send/recv/connect/accept,
+                `urlopen`, `getresponse`, `query_proto`, `send_message`)
+                lexically inside a `with <lock>:` body — serializes every
+                sibling of that lock behind a syscall or an RPC.
+stats-registry  a StatsClient/StatsDClient/new_stats_client construction
+                outside utils/stats.py / server.py — counters registered
+                on a private client never reach the registry that feeds
+                `/metrics` (the drift guard in
+                tests/test_metrics_conformance.py checks the registry
+                side; this rule closes the other half).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass
+
+# the one module allowed to construct raw threads/timers
+THREAD_WRAPPER_MODULE = os.path.join("pilosa_tpu", "utils", "threads.py")
+# modules allowed to construct stats clients (the registry itself, and
+# the server wiring that feeds /metrics)
+STATS_FACTORY_MODULES = (
+    os.path.join("pilosa_tpu", "utils", "stats.py"),
+    os.path.join("pilosa_tpu", "server.py"),
+)
+
+# receiver names that identify a concurrent.futures-style executor
+_POOLISH = re.compile(r"(^|_)(pool|executor)s?$|pool$", re.IGNORECASE)
+
+# calls that block on a syscall / peer while a lock would be held
+_BLOCKING_CALLS = frozenset({
+    "fsync", "sendto", "sendall", "recv", "recvfrom", "connect", "accept",
+    "urlopen", "getresponse", "query_proto", "send_message",
+})
+
+# `with <name>:` context expressions that are lock-ish by naming
+# convention: `lock`, `_lock`, `mu`, `mutex`, `rlock`, `cond` (a
+# Condition wraps a lock)
+_LOCKISH = re.compile(r"(^|_)(r?lock|mu|mutex|cond)$", re.IGNORECASE)
+
+_WALL_OK = re.compile(r"#.*wall[- _]?clock", re.IGNORECASE)
+
+
+@dataclass(frozen=True)
+class Finding:
+    path: str  # repo-root-relative, forward slashes
+    line: int
+    rule: str
+    msg: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: {self.rule}: {self.msg}"
+
+
+def _last_name(node: ast.expr) -> str:
+    """Trailing identifier of a Name/Attribute chain ("self._fanout_pool"
+    -> "_fanout_pool"); "" for anything else."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return ""
+
+
+def _dotted(node: ast.expr) -> str:
+    """Best-effort dotted name ("threading.Thread"); "" when the chain
+    contains calls/subscripts."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _is_copy_context_run(node: ast.expr) -> bool:
+    """Matches `contextvars.copy_context().run` (the sanctioned explicit
+    pool-submit form)."""
+    return (isinstance(node, ast.Attribute) and node.attr == "run"
+            and isinstance(node.value, ast.Call)
+            and _last_name(node.value.func) == "copy_context")
+
+
+class _FileLinter(ast.NodeVisitor):
+    def __init__(self, relpath: str, source: str):
+        self.relpath = relpath
+        self.lines = source.splitlines()
+        self.findings: list[Finding] = []
+        # names bound by `from threading import Thread/Timer`
+        self.thread_aliases: set[str] = set()
+        self.is_wrapper = relpath.replace("/", os.sep).endswith(
+            THREAD_WRAPPER_MODULE)
+        self.is_stats_factory = any(
+            relpath.replace("/", os.sep).endswith(m)
+            for m in STATS_FACTORY_MODULES)
+
+    def _emit(self, node: ast.AST, rule: str, msg: str) -> None:
+        self.findings.append(
+            Finding(self.relpath, getattr(node, "lineno", 0), rule, msg))
+
+    def _line_has_wall_annotation(self, lineno: int) -> bool:
+        for ln in (lineno, lineno - 1):
+            if 1 <= ln <= len(self.lines) and _WALL_OK.search(
+                    self.lines[ln - 1]):
+                return True
+        return False
+
+    # -- rules ------------------------------------------------------------
+
+    def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
+        if node.module == "threading":
+            for alias in node.names:
+                if alias.name in ("Thread", "Timer"):
+                    self.thread_aliases.add(alias.asname or alias.name)
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        dotted = _dotted(node.func)
+        # ctx-thread
+        if not self.is_wrapper and (
+                dotted in ("threading.Thread", "threading.Timer")
+                or (isinstance(node.func, ast.Name)
+                    and node.func.id in self.thread_aliases)):
+            self._emit(node, "ctx-thread",
+                       f"raw {dotted or node.func.id}() starts its target "
+                       "in an empty context (trace/principal/deadline "
+                       "lost); use pilosa_tpu.utils.threads")
+        # ctx-submit / swallowed-future are handled at the statement level
+        if (isinstance(node.func, ast.Attribute)
+                and node.func.attr == "submit"
+                and _POOLISH.search(_last_name(node.func.value) or "")):
+            if not node.args or not (
+                    _is_copy_context_run(node.args[0])
+                    or _last_name(node.args[0]) == "run"):
+                self._emit(node, "ctx-submit",
+                           "pool submit without contextvars propagation; "
+                           "use utils.threads.submit_ctx or pass "
+                           "contextvars.copy_context().run")
+        # wall-clock
+        if dotted == "time.time" and not self._line_has_wall_annotation(
+                node.lineno):
+            self._emit(node, "wall-clock",
+                       "time.time() is only for serialized timestamps "
+                       "(annotate `# wall-clock`); deadlines/elapsed use "
+                       "time.monotonic()")
+        # stats-registry
+        if (not self.is_stats_factory
+                and _last_name(node.func) in ("StatsClient", "StatsDClient",
+                                              "new_stats_client")):
+            self._emit(node, "stats-registry",
+                       "stats client constructed outside the registry "
+                       "wiring (utils/stats.py, server.py); its metrics "
+                       "would never reach /metrics")
+        self.generic_visit(node)
+
+    def visit_Expr(self, node: ast.Expr) -> None:
+        call = node.value
+        if (isinstance(call, ast.Call)
+                and isinstance(call.func, ast.Attribute)
+                and call.func.attr == "submit"
+                and _POOLISH.search(_last_name(call.func.value) or "")):
+            self._emit(node, "swallowed-future",
+                       "discarded pool Future: its exception can never "
+                       "be observed; keep the Future (or handle errors "
+                       "in the task)")
+        self.generic_visit(node)
+
+    def visit_ExceptHandler(self, node: ast.ExceptHandler) -> None:
+        if node.type is None:
+            self._emit(node, "bare-except",
+                       "bare `except:` swallows KeyboardInterrupt/"
+                       "SystemExit; name the exception(s)")
+        self.generic_visit(node)
+
+    def visit_With(self, node: ast.With) -> None:
+        lockish = any(
+            _LOCKISH.search(_last_name(item.context_expr) or "")
+            for item in node.items)
+        if lockish:
+            for blocker in _blocking_calls_in(node.body):
+                self._emit(
+                    blocker, "lock-blocking",
+                    f"blocking call `{_last_name(blocker.func)}` inside a "
+                    "`with <lock>:` body; move the I/O outside the "
+                    "critical section")
+        self.generic_visit(node)
+
+
+def _blocking_calls_in(body: list) -> list:
+    """Blocking-call nodes lexically inside `body`, NOT descending into
+    nested function/lambda definitions (deferred execution runs outside
+    the lock) or nested `with` bodies (attributed to their own `with`)."""
+    out: list[ast.Call] = []
+    stack: list[ast.AST] = list(body)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        if isinstance(node, ast.Call) and _last_name(
+                node.func) in _BLOCKING_CALLS:
+            out.append(node)
+        if isinstance(node, ast.With):
+            # still scan its context expressions, skip its body
+            stack.extend(item.context_expr for item in node.items)
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+    return sorted(out, key=lambda n: (n.lineno, n.col_offset))
+
+
+def lint_source(relpath: str, source: str) -> list[Finding]:
+    """Lint one file's source; `relpath` is repo-root relative."""
+    try:
+        tree = ast.parse(source)
+    except SyntaxError as e:
+        return [Finding(relpath, e.lineno or 0, "syntax-error", str(e))]
+    linter = _FileLinter(relpath.replace(os.sep, "/"), source)
+    linter.visit(tree)
+    return linter.findings
+
+
+def iter_py_files(root: str):
+    """Every lint-scoped source file: pilosa_tpu/**/*.py, excluding the
+    generated protobuf module."""
+    pkg = os.path.join(root, "pilosa_tpu")
+    for dirpath, dirnames, filenames in os.walk(pkg):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fn in sorted(filenames):
+            if fn.endswith(".py") and not fn.endswith("_pb2.py"):
+                yield os.path.join(dirpath, fn)
+
+
+def run_lint(root: str) -> list[Finding]:
+    findings: list[Finding] = []
+    for path in iter_py_files(root):
+        with open(path, encoding="utf-8") as f:
+            source = f.read()
+        findings.extend(lint_source(os.path.relpath(path, root), source))
+    return sorted(findings, key=lambda f: (f.path, f.line, f.rule))

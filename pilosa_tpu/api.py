@@ -132,7 +132,9 @@ class API:
         self.health_fn = None
         self.node_stats_fn = None
         self.cluster_stats_fn = None
-        self.start_time = time.time()  # uptime_seconds on /status
+        # uptimeSeconds on /status is ELAPSED time: monotonic, so an NTP
+        # step can never report a negative or jumped uptime
+        self.start_time = time.monotonic()
         # per-principal resource accounting (utils/accounting.py): the
         # HTTP layer installs an Account per request against this ledger;
         # every charge site in the stack (batchers, residency, plan
@@ -865,7 +867,7 @@ class API:
                # load-balancer surface: uptime + version + the node's own
                # health score — the SAME health_score() the /cluster/stats
                # federation computes, so the two can never disagree
-               "uptimeSeconds": int(time.time() - self.start_time),
+               "uptimeSeconds": int(time.monotonic() - self.start_time),
                "version": __version__}
         if self.node_state_fn is not None:
             # lifecycle state of THIS node ("READY" | "DRAINING"): load

@@ -346,6 +346,7 @@ class Config:
             f'data-dir = "{self.data_dir}"',
             f'bind = "{self.bind}"',
             f"max-writes-per-request = {self.max_writes_per_request}",
+            f'log-path = "{self.log_path}"',
             f'log-format = "{self.log_format}"',
             f"verbose = {str(self.verbose).lower()}",
             "",
@@ -356,8 +357,14 @@ class Config:
             "",
             "[cluster]",
             f"disabled = {str(self.cluster.disabled).lower()}",
+            f"coordinator = {str(self.cluster.coordinator).lower()}",
             f"replicas = {self.cluster.replicas}",
             f"hosts = [{', '.join(repr(h) for h in self.cluster.hosts)}]",
+            f"long-query-time = {self.cluster.long_query_time}",
+            f"query-timeout = {self.cluster.query_timeout}",
+            f"liveness-threshold = {self.cluster.liveness_threshold}",
+            f"probe-timeout = {self.cluster.probe_timeout}",
+            f"membership-interval = {self.cluster.membership_interval}",
             f"fanout-pool-size = {self.cluster.fanout_pool_size}",
             f"fanout-coalesce-window = {self.cluster.fanout_coalesce_window}",
             f"fanout-coalesce-max-batch = {self.cluster.fanout_coalesce_max_batch}",

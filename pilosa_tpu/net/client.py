@@ -20,6 +20,7 @@ import urllib.parse
 from typing import Optional
 
 from pilosa_tpu import qos
+from pilosa_tpu.analysis import lockwitness
 from pilosa_tpu.utils import accounting, failpoints, qctx, tracing
 from pilosa_tpu.utils import profile as qprofile
 
@@ -117,6 +118,10 @@ class InternalClient:
         backing off never converts a rejection into a blown budget. Any
         other error propagates unchanged; so does the final rejection
         when the retries are spent (callers fail over per shard)."""
+        # lock-order witness choke point: an RPC issued while holding any
+        # witnessed lock serializes every sibling of that lock behind a
+        # peer's round trip (no-op unless PILOSA_TPU_LOCKCHECK=1)
+        lockwitness.note_blocking("rpc", f"{method} {path}")
         for bp_attempt in range(BACKPRESSURE_RETRIES + 1):
             try:
                 return self._request_once(method, uri, path, body=body,

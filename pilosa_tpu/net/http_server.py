@@ -14,6 +14,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Optional
 from urllib.parse import parse_qs, urlparse
 
+from pilosa_tpu.utils import threads as _threads
 from pilosa_tpu import qos
 from pilosa_tpu.api import API, ApiError
 from pilosa_tpu.encoding.protobuf import CONTENT_TYPE as PROTO_CONTENT_TYPE
@@ -1145,8 +1146,8 @@ class HTTPServer:
         return f"{self._scheme}://{host}:{self.port}"
 
     def serve_background(self) -> None:
-        self._thread = threading.Thread(target=self._srv.serve_forever, daemon=True)
-        self._thread.start()
+        self._thread = _threads.spawn(self._srv.serve_forever,
+                                      name="pilosa-http")
 
     def close(self) -> None:
         # flag FIRST: lingering per-connection threads must stop

@@ -52,6 +52,8 @@ import time
 from dataclasses import dataclass, field
 from typing import Callable, Optional
 
+from pilosa_tpu.utils import threads as _threads
+
 ALIVE = "alive"
 SUSPECT = "suspect"
 DEAD = "dead"
@@ -230,10 +232,8 @@ class Gossip:
         self._closed.clear()
         for target, name in ((self._recv_loop, "gossip-recv"),
                              (self._probe_loop, "gossip-probe")):
-            t = threading.Thread(target=target, name=f"{name}-{self.node_id}",
-                                 daemon=True)
-            t.start()
-            self._threads.append(t)
+            self._threads.append(_threads.spawn(
+                target, name=f"{name}-{self.node_id}"))
         self._sync_seeds()
 
     def _sync_seeds(self) -> None:
@@ -423,7 +423,7 @@ class Gossip:
                 with self._lock:
                     self._acks.pop(seq, None)
 
-        threading.Thread(target=run, daemon=True).start()
+        _threads.spawn(run)
 
     def _refresh_alive(self, node_id: Optional[str]) -> None:
         if not node_id:

@@ -32,6 +32,7 @@ from pilosa_tpu.ops.bitvector import (
     live_from_matrix,
     popcount,
 )
+from pilosa_tpu.analysis import lockwitness
 from pilosa_tpu.utils.telemetry import counted_jit, record_dispatch
 
 SHARD_AXIS = "shard"
@@ -409,6 +410,10 @@ class DeviceRunner:
         """Pad `shard_axis` to a multiple of the shard slots and place on
         device(s): that axis shards over the mesh, every other axis (and
         the replica axis) replicates."""
+        # lock-order witness choke point: a host->device upload while
+        # holding a witnessed lock stalls that lock's siblings behind the
+        # transfer (no-op unless PILOSA_TPU_LOCKCHECK=1)
+        lockwitness.note_blocking("dispatch", "put_shard_padded")
         pad = (-arr.shape[shard_axis]) % self.n_shard_slots
         if pad:
             widths = [(0, 0)] * arr.ndim

@@ -60,6 +60,8 @@ import time
 from concurrent.futures import Future
 from typing import Optional
 
+from pilosa_tpu.utils import threads as _threads
+
 PRIORITY_HEADER = "X-Pilosa-Priority"
 
 # priority name -> level; LOWER level = more urgent (sort order and
@@ -193,11 +195,13 @@ class PriorityPool:
             # grow like ThreadPoolExecutor: one worker per submit until
             # the cap; idle workers park on the queue forever after
             if len(self._threads) < self._max_workers:
-                t = threading.Thread(
-                    target=self._worker, daemon=True,
-                    name=f"{self._prefix}_{len(self._threads)}")
-                self._threads.append(t)
-                t.start()
+                # NOTE: worker threads deliberately copy the POOL's boot
+                # context, not the submitter's — per-task context rides
+                # each submit (utils.threads.submit_ctx / the explicit
+                # copy_context().run form, enforced by pilosa-lint)
+                self._threads.append(_threads.spawn(
+                    self._worker,
+                    name=f"{self._prefix}_{len(self._threads)}"))
         return fut
 
     def _worker(self) -> None:

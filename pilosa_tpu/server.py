@@ -28,6 +28,7 @@ from pilosa_tpu.parallel.cluster import (
     STATE_STARTING,
 )
 from pilosa_tpu.parallel.mesh import DeviceRunner
+from pilosa_tpu.utils import threads as _threads
 from pilosa_tpu.utils.translate import TranslateStore
 
 import os
@@ -510,9 +511,8 @@ class Server:
             self._schedule_anti_entropy()
         if self.cache_flush_interval > 0:
             self._schedule_cache_flush()
-        self._bcast_thread = threading.Thread(target=self._bcast_worker,
-                                              daemon=True)
-        self._bcast_thread.start()
+        self._bcast_thread = _threads.spawn(self._bcast_worker,
+                                            name="pilosa-bcast")
         self.runtime_monitor.start()
         self.diagnostics.start()
         # route recompile-storm warnings into the server log (process-
@@ -537,9 +537,8 @@ class Server:
     def _schedule_membership_refresh(self) -> None:
         if self.closed:
             return
-        self._member_timer = threading.Timer(self.membership_interval,
-                                             self._membership_tick)
-        self._member_timer.daemon = True
+        self._member_timer = _threads.ctx_timer(self.membership_interval,
+                                                self._membership_tick)
         self._member_timer.start()
 
     def _membership_tick(self) -> None:
@@ -717,11 +716,8 @@ class Server:
         results: dict[str, bool] = {}
         threads = []
         for node in peers:
-            t = threading.Thread(
-                target=lambda n=node: results.__setitem__(n.id, probe(n)),
-                daemon=True)
-            t.start()
-            threads.append(t)
+            threads.append(_threads.spawn(
+                lambda n=node: results.__setitem__(n.id, probe(n))))
         for t in threads:
             t.join(self.probe_timeout + 1.0)
         suspects: list = []
@@ -782,12 +778,10 @@ class Server:
         refuted: dict[str, bool] = {}
         checkers = []
         for node in suspects:
-            t = threading.Thread(
-                target=lambda nd=node: refuted.__setitem__(
-                    nd.id, self._indirect_confirms_alive(nd, peers, results)),
-                daemon=True)
-            t.start()
-            checkers.append(t)
+            checkers.append(_threads.spawn(
+                lambda nd=node: refuted.__setitem__(
+                    nd.id,
+                    self._indirect_confirms_alive(nd, peers, results))))
         deadline = 3 * self.probe_timeout + 3.0
         for t in checkers:
             t.join(deadline)
@@ -841,7 +835,7 @@ class Server:
                 done.set()
 
         for h in helpers:
-            threading.Thread(target=ask, args=(h,), daemon=True).start()
+            _threads.spawn(ask, h)
         # one vouch settles it — don't hold the membership tick hostage to
         # the slowest helper's full timeout (a recurring-suspect peer would
         # stall liveness detection for every OTHER peer each round)
@@ -939,7 +933,7 @@ class Server:
             finally:
                 self._return_sync_running.discard(node.id)
 
-        threading.Thread(target=heal, daemon=True).start()
+        _threads.spawn(heal)
 
     def _sync_with_node(self, node_id: str) -> int:
         """One anti-entropy pass scoped to fragments co-owned with one peer
@@ -1000,10 +994,8 @@ class Server:
         with self._drain_lock:
             if self._drain_thread is None or not self._drain_thread.is_alive():
                 self._drain_abort.clear()
-                self._drain_thread = threading.Thread(
-                    target=self.drain, args=(timeout,), daemon=True,
-                    name="pilosa-drain")
-                self._drain_thread.start()
+                self._drain_thread = _threads.spawn(
+                    self.drain, timeout, name="pilosa-drain")
         return self.drain_status()
 
     def abort_drain(self) -> None:
@@ -1157,9 +1149,8 @@ class Server:
         t = self._fence_thread
         if t is not None and t.is_alive():
             return
-        self._fence_thread = threading.Thread(
-            target=self._fence_worker, daemon=True, name="pilosa-fence")
-        self._fence_thread.start()
+        self._fence_thread = _threads.spawn(self._fence_worker,
+                                            name="pilosa-fence")
 
     def _fence_worker(self) -> None:
         deadline = time.monotonic() + self.rejoin_fence_timeout
@@ -1360,9 +1351,7 @@ class Server:
             # async: fetching fragments over HTTP must not block the
             # coordinator's send (followResizeInstruction runs in a
             # goroutine, cluster.go:1251)
-            t = threading.Thread(target=self.follow_resize_instruction,
-                                 args=(msg,), daemon=True)
-            t.start()
+            _threads.spawn(self.follow_resize_instruction, msg)
         elif mtype == "resize-complete":
             self._handle_resize_complete(msg)
         elif mtype == "resize-abort":
@@ -1427,11 +1416,8 @@ class Server:
             except ClientError:
                 pass
             return
-        threads = [threading.Thread(
-            target=self._send_quiet, args=(u, msg), daemon=True)
-            for u in uris]
-        for t in threads:
-            t.start()
+        threads = [_threads.spawn(self._send_quiet, u, msg)
+                   for u in uris]
         for t in threads:
             t.join()
 
@@ -1460,11 +1446,8 @@ class Server:
         uris = self._peer_uris()
         if not uris:
             return
-        threads = [threading.Thread(target=self._send_quiet,
-                                    args=(u, msg), daemon=True)
+        threads = [_threads.spawn(self._send_quiet, u, msg)
                    for u in uris]
-        for t in threads:
-            t.start()
         deadline = time.monotonic() + self.ANNOUNCE_SHARD_BUDGET_S
         for t in threads:
             t.join(max(0.0, deadline - time.monotonic()))
@@ -1523,8 +1506,7 @@ class Server:
                 q = peer_queues.get(uri)
                 if q is None:
                     q = peer_queues[uri] = _queue.Queue()
-                    threading.Thread(target=peer_sender, args=(uri, q),
-                                     daemon=True).start()
+                    _threads.spawn(peer_sender, uri, q)
                 if q.qsize() < self.BCAST_PEER_QUEUE_MAX:
                     q.put(msg)
                 else:
@@ -1690,9 +1672,7 @@ class Server:
                             for s in sources],
             }
             if target == self.node_id:
-                t = threading.Thread(target=self.follow_resize_instruction,
-                                     args=(msg,), daemon=True)
-                t.start()
+                _threads.spawn(self.follow_resize_instruction, msg)
             else:
                 try:
                     self.client.send_message(uri_by_id[target], msg)
@@ -1828,9 +1808,8 @@ class Server:
             self._resize_watchdog.cancel()
         if self.resize_timeout <= 0:
             return
-        t = threading.Timer(self.resize_timeout, self._watchdog_fire,
-                            args=(job_id,))
-        t.daemon = True
+        t = _threads.ctx_timer(self.resize_timeout, self._watchdog_fire,
+                               args=(job_id,))
         t.start()
         self._resize_watchdog = t
 
@@ -2198,7 +2177,7 @@ class Server:
             # yellow via the health inputs); otherwise the cluster state
             "state": "DRAINING" if self.draining else self.cluster.state,
             "version": __version__,
-            "uptimeSeconds": int(time.time() - self.api.start_time),
+            "uptimeSeconds": int(time.monotonic() - self.api.start_time),
             "health": _telemetry.health_score(inputs),
             "healthInputs": inputs,
             "damagedFragments": inputs["damagedFragments"],
@@ -2293,9 +2272,7 @@ class Server:
                             f"stats fetch failed: "
                             f"{type(e).__name__}: {e}"]}}
 
-            t = threading.Thread(target=fetch, daemon=True)
-            t.start()
-            fetchers.append((n, t))
+            fetchers.append((n, _threads.spawn(fetch)))
         for n, t in fetchers:
             t.join(timeout + 1.0)
             if n.id not in entries:
@@ -2357,9 +2334,7 @@ class Server:
                 except Exception:  # noqa: BLE001 — never fail the merge
                     entry["status"] = "error"
 
-            t = threading.Thread(target=fetch, daemon=True)
-            t.start()
-            fetchers.append((entry, t))
+            fetchers.append((entry, _threads.spawn(fetch)))
         for entry, t in fetchers:
             t.join(timeout + 1.0)
             if entry["status"] == "pending":
@@ -2401,9 +2376,8 @@ class Server:
             # instant turns anti-entropy into a cluster-wide load spike
             interval *= 1.0 + _random.uniform(-self.anti_entropy_jitter,
                                               self.anti_entropy_jitter)
-        self._ae_timer = threading.Timer(max(interval, 0.01),
-                                         self._anti_entropy_tick)
-        self._ae_timer.daemon = True
+        self._ae_timer = _threads.ctx_timer(max(interval, 0.01),
+                                            self._anti_entropy_tick)
         self._ae_timer.start()
 
     def _anti_entropy_tick(self) -> None:
@@ -2490,9 +2464,8 @@ class Server:
     def _schedule_cache_flush(self) -> None:
         if self.closed:
             return
-        self._cache_flush_timer = threading.Timer(self.cache_flush_interval,
-                                                  self._cache_flush_tick)
-        self._cache_flush_timer.daemon = True
+        self._cache_flush_timer = _threads.ctx_timer(
+            self.cache_flush_interval, self._cache_flush_tick)
         self._cache_flush_timer.start()
 
     def _cache_flush_tick(self) -> None:

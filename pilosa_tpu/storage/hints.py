@@ -179,7 +179,7 @@ class HintStore:
                     size = os.path.getsize(path)
                 except OSError:
                     size = 0
-                now = time.time()
+                now = time.time()  # wall-clock: persisted in the frame ts
                 if self.max_bytes > 0 and \
                         size + len(payload) + _FIXED > self.max_bytes:
                     # over budget: drop the write, record THAT durably (a
@@ -258,47 +258,69 @@ class HintStore:
             if not data:
                 return 0, 0, True
             records, valid_end, err = parse_hint_log(data)
-            dropped = 0
-            if err:
-                # torn tail / corruption: whatever followed the damage is
-                # unknown — that is a broken promise, like a drop marker
-                dropped += 1
-                if self.logger is not None:
-                    self.logger.printf(
-                        "hints: log for %s damaged at byte %d (%s): "
-                        "replaying the valid prefix, anti-entropy will "
-                        "finish the heal", node_id, valid_end, err)
-            now = time.time()
-            replayed = 0
+        dropped = 0
+        if err:
+            # torn tail / corruption: whatever followed the damage is
+            # unknown — that is a broken promise, like a drop marker
+            dropped += 1
+            if self.logger is not None:
+                self.logger.printf(
+                    "hints: log for %s damaged at byte %d (%s): "
+                    "replaying the valid prefix, anti-entropy will "
+                    "finish the heal", node_id, valid_end, err)
+        # hint ages compare against frame timestamps persisted by an
+        # EARLIER process — monotonic is meaningless across restarts
+        now = time.time()  # wall-clock: vs persisted frame ts
+        replayed = 0
+        # apply OUTSIDE the per-target lock: every hint is an RPC to the
+        # returned peer, and holding the lock across the round trips
+        # would stall the write path's hint appends behind the whole
+        # replay (surfaced by the lock-order witness). Appends that land
+        # while we apply go to the same file BEYOND the snapshot we
+        # read; the retire step below removes only the replayed prefix,
+        # so they survive for the next membership-tick replay.
+        try:
+            for ts, doc in records:
+                if "dropped" in doc:
+                    dropped += int(doc.get("dropped") or 1)
+                    continue
+                if self.max_age > 0 and now - ts > self.max_age:
+                    dropped += 1
+                    continue
+                failpoints.hit("storage.hints.replay")
+                apply_fn(doc)
+                replayed += 1
+        except Exception as e:  # noqa: BLE001 — ANY apply failure
+            # (peer flapped back down, injected fault) keeps the log
+            # for the next return-heal; nothing applied is lost and
+            # re-applying is idempotent
+            with self._meta_lock:
+                self.replayed += replayed
+                self.replay_failures += 1
+            if replayed:
+                self._count("replayed", replayed)
+            if self.logger is not None:
+                self.logger.printf(
+                    "hints: replay to %s failed after %d records "
+                    "(%s: %s) — will retry on its next return",
+                    node_id, replayed, type(e).__name__, e)
+            return replayed, 0, False
+        # full pass done: retire exactly the bytes we replayed
+        with self._lock_for(node_id):
             try:
-                for ts, doc in records:
-                    if "dropped" in doc:
-                        dropped += int(doc.get("dropped") or 1)
-                        continue
-                    if self.max_age > 0 and now - ts > self.max_age:
-                        dropped += 1
-                        continue
-                    failpoints.hit("storage.hints.replay")
-                    apply_fn(doc)
-                    replayed += 1
-            except Exception as e:  # noqa: BLE001 — ANY apply failure
-                # (peer flapped back down, injected fault) keeps the log
-                # for the next return-heal; nothing applied is lost and
-                # re-applying is idempotent
-                with self._meta_lock:
-                    self.replayed += replayed
-                    self.replay_failures += 1
-                if replayed:
-                    self._count("replayed", replayed)
-                if self.logger is not None:
-                    self.logger.printf(
-                        "hints: replay to %s failed after %d records "
-                        "(%s: %s) — will retry on its next return",
-                        node_id, replayed, type(e).__name__, e)
-                return replayed, 0, False
-            # full pass done: retire the log
-            try:
-                os.remove(path)
+                with open(path, "rb") as f:
+                    after = f.read()
+                if len(after) <= len(data):
+                    os.remove(path)
+                else:
+                    # concurrent appends while we were applying: keep
+                    # only the un-replayed suffix (record-aligned — the
+                    # snapshot ended on a frame boundary or at damage we
+                    # already counted as dropped)
+                    tmp = path + ".tmp"
+                    with open(tmp, "wb") as f:
+                        f.write(after[len(data):])
+                    os.replace(tmp, path)
             except OSError:
                 pass
         with self._meta_lock:

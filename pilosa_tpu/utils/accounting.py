@@ -171,7 +171,7 @@ class UsageLedger:
             e["queries"] += queries
             e["errors"] += errors
             e["planCacheHits"] += plan_cache_hits
-            e["lastChargeWall"] = time.time()
+            e["lastChargeWall"] = time.time()  # wall-clock: serialized
 
     def _spill_locked(self, newcomer: str) -> str:
         """At capacity: merge lowest-deviceMs tracked principals into the

@@ -20,6 +20,8 @@ import time
 import urllib.request
 from typing import Optional
 
+from pilosa_tpu.utils import threads
+
 
 class SystemInfo:
     """Host facts from /proc + platform (gopsutil/systeminfo.go:1-193)."""
@@ -122,7 +124,7 @@ class DiagnosticsCollector:
         self.cluster = cluster
         self.system_info = system_info or SystemInfo()
         self.logger = logger
-        self.start_time = time.time()
+        self.start_time = time.monotonic()  # Uptime is elapsed, not wall
         self._timer: Optional[threading.Timer] = None
         self.closed = False
 
@@ -132,7 +134,7 @@ class DiagnosticsCollector:
         si = self.system_info
         info = {
             "Version": self.version,
-            "Uptime": int(time.time() - self.start_time),
+            "Uptime": int(time.monotonic() - self.start_time),
             "OS": si.platform(),
             "Arch": si.family(),
             "OSVersion": si.os_version(),
@@ -190,8 +192,7 @@ class DiagnosticsCollector:
     def _schedule(self) -> None:
         if self.closed:
             return
-        self._timer = threading.Timer(self.interval, self._tick)
-        self._timer.daemon = True
+        self._timer = threads.ctx_timer(self.interval, self._tick)
         self._timer.start()
 
     def _tick(self) -> None:
@@ -231,8 +232,7 @@ class RuntimeMonitor:
     def _schedule(self) -> None:
         if self.closed:
             return
-        self._timer = threading.Timer(self.interval, self._tick)
-        self._timer.daemon = True
+        self._timer = threads.ctx_timer(self.interval, self._tick)
         self._timer.start()
 
     def _tick(self) -> None:

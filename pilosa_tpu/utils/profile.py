@@ -80,7 +80,7 @@ class QueryProfile:
         self.index = index
         self.pql = pql
         self.start = time.perf_counter()
-        self.start_wall = time.time()
+        self.start_wall = time.time()  # wall-clock: export timestamps
         self.elapsed_ms: float = 0.0
         self.calls: list[dict] = []        # [{call, ms}]
         self.fanout: list[dict] = []       # per-shard-group RPC records

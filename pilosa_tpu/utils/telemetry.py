@@ -25,6 +25,9 @@ import threading
 import time
 from typing import Callable, Optional
 
+from pilosa_tpu.analysis import lockwitness
+from pilosa_tpu.utils import threads
+
 
 def enabled() -> bool:
     """PILOSA_TPU_TELEMETRY=0 kills sampling AND dispatch counting (read
@@ -52,7 +55,7 @@ class Ring:
 
     def append(self, gauges: dict, ts: Optional[float] = None) -> int:
         if ts is None:
-            ts = time.time()
+            ts = time.time()  # wall-clock: sample ts on /debug/timeseries
         with self._lock:
             self._seq += 1
             self._buf.append((self._seq, ts, dict(gauges)))
@@ -149,9 +152,8 @@ class TelemetrySampler:
         with self._lock:
             if not self.running or self.closed or gen != self._gen:
                 return
-            self._timer = threading.Timer(self.interval, self._tick,
-                                          args=(gen,))
-            self._timer.daemon = True
+            self._timer = threads.ctx_timer(self.interval, self._tick,
+                                            args=(gen,))
             self._timer.start()
 
     def _tick(self, gen: int) -> None:
@@ -303,6 +305,7 @@ def dispatch_key(args: tuple, kwargs: Optional[dict] = None):
 def record_dispatch(family: str, *args) -> None:
     """Manual counting hook for dispatch sites that build their jitted
     callables dynamically (the mesh shard_map paths)."""
+    lockwitness.note_blocking("dispatch", family)
     if not enabled():
         return
     try:
@@ -328,6 +331,10 @@ def counted_jit(family: str, **jit_kwargs):
 
         @functools.wraps(fn)
         def call(*args, **kwargs):
+            # lock-order witness choke point: a device dispatch while
+            # holding a witnessed lock stalls every sibling of that lock
+            # behind the accelerator (no-op unless PILOSA_TPU_LOCKCHECK=1)
+            lockwitness.note_blocking("dispatch", family)
             if enabled():
                 try:
                     leaves, treedef = jax.tree_util.tree_flatten(

@@ -1,0 +1,66 @@
+"""Context-propagating thread primitives.
+
+Every piece of per-query attribution in this codebase — the active trace
+id (utils/tracing.py), the query profile (utils/profile.py), the usage
+account (utils/accounting.py), the deadline (utils/qctx.py), the QoS
+priority (qos.py) — rides a contextvar. A raw `threading.Thread` /
+`threading.Timer` starts its target in an EMPTY context, so any
+background hop (hint replay, fence worker, scrubber ticks, telemetry
+sampler, broadcast fan-out, stats federation fetches) silently drops the
+attribution of whatever request caused it.
+
+This module is the one sanctioned thread boundary: every helper copies
+the caller's context with `contextvars.copy_context()` and runs the
+target inside it. pilosa-lint (pilosa_tpu/analysis/lint.py, rule
+`ctx-thread`) flags any direct `threading.Thread(...)` /
+`threading.Timer(...)` construction outside this file, and rule
+`ctx-submit` flags pool submits that bypass the same discipline
+(`submit_ctx` below, or an explicit `contextvars.copy_context().run`
+first argument).
+"""
+
+from __future__ import annotations
+
+import contextvars
+import threading
+from typing import Callable, Optional
+
+
+def ctx_thread(target: Callable, args: tuple = (),
+               kwargs: Optional[dict] = None, *,
+               name: Optional[str] = None,
+               daemon: bool = True) -> threading.Thread:
+    """A not-yet-started Thread whose target runs in a copy of the
+    caller's context (trace/principal/deadline/priority survive)."""
+    ctx = contextvars.copy_context()
+    kw = kwargs or {}
+    return threading.Thread(
+        target=lambda: ctx.run(target, *args, **kw), name=name,
+        daemon=daemon)
+
+
+def spawn(target: Callable, *args, name: Optional[str] = None,
+          daemon: bool = True, **kwargs) -> threading.Thread:
+    """ctx_thread + start — the fire-and-forget form."""
+    t = ctx_thread(target, args=args, kwargs=kwargs, name=name,
+                   daemon=daemon)
+    t.start()
+    return t
+
+
+def ctx_timer(interval: float, fn: Callable, args: tuple = (),
+              kwargs: Optional[dict] = None) -> threading.Timer:
+    """A daemon threading.Timer whose callback runs in a copy of the
+    scheduling context. Self-rescheduling tick chains copy the TICK
+    thread's context at each reschedule, which is what they had anyway."""
+    ctx = contextvars.copy_context()
+    kw = kwargs or {}
+    t = threading.Timer(interval, lambda: ctx.run(fn, *args, **kw))
+    t.daemon = True
+    return t
+
+
+def submit_ctx(pool, fn: Callable, *args, **kwargs):
+    """pool.submit with the caller's context copied into the task —
+    equivalent to pool.submit(contextvars.copy_context().run, fn, ...)."""
+    return pool.submit(contextvars.copy_context().run, fn, *args, **kwargs)

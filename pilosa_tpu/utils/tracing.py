@@ -15,6 +15,8 @@ import threading
 import time
 from typing import Optional
 
+from pilosa_tpu.utils import threads
+
 TRACE_HEADER = "X-Pilosa-Trace-Id"
 
 # process-seeded PRNG for trace ids (see Tracer.start_span)
@@ -98,8 +100,7 @@ class SpanExporter:
     def _schedule(self) -> None:
         if self._closed or self.flush_interval <= 0:
             return
-        self._timer = threading.Timer(self.flush_interval, self._tick)
-        self._timer.daemon = True
+        self._timer = threads.ctx_timer(self.flush_interval, self._tick)
         self._timer.start()
 
     def _tick(self) -> None:
@@ -126,7 +127,7 @@ class SpanExporter:
             if spawn:
                 self._flush_pending = True
         if spawn:
-            threading.Thread(target=self._bg_flush, daemon=True).start()
+            threads.spawn(self._bg_flush)
 
     def _bg_flush(self) -> None:
         try:
@@ -393,15 +394,14 @@ class TraceExporter:
             if spawn:
                 self._flush_pending = True
         if spawn:
-            threading.Thread(target=self._bg_flush, daemon=True).start()
+            threads.spawn(self._bg_flush)
 
     # -- flushing -----------------------------------------------------------
 
     def _schedule(self) -> None:
         if self._closed or self.flush_interval <= 0:
             return
-        self._timer = threading.Timer(self.flush_interval, self._tick)
-        self._timer.daemon = True
+        self._timer = threads.ctx_timer(self.flush_interval, self._tick)
         self._timer.start()
 
     def _tick(self) -> None:

@@ -10,6 +10,23 @@ over env-var overrides, so the platform is forced via jax.config.update
 recipe lives in pilosa_tpu.parallel.mesh.force_platform.
 """
 
+import os
+
+# runtime lock-order witness ON for the whole suite (export
+# PILOSA_TPU_LOCKCHECK=0 to opt out): every concurrency test doubles as
+# a race regression test — the autouse guard below fails the test that
+# first forms a lock-order cycle or holds a lock across RPC/dispatch.
+# Armed by direct install() rather than by exporting the env var: the
+# subprocess clusters (clusterproc/chaos tests) would inherit the env
+# and pay witness overhead whose reports nothing ever reads — pure load
+# that erodes the SWIM-clock margins of the liveness tests. Installed
+# before the first pilosa_tpu.parallel import so every lock the package
+# constructs afterwards is wrapped.
+from pilosa_tpu.analysis import lockwitness
+
+if os.environ.get(lockwitness.ENV_GATE, "") != "0":
+    lockwitness.install()
+
 from pilosa_tpu.parallel.mesh import force_platform
 
 force_platform("cpu", host_devices=8)
@@ -21,6 +38,30 @@ import pytest  # noqa: E402
 def pytest_sessionstart(session):
     assert jax.devices()[0].platform == "cpu", jax.devices()
     assert len(jax.devices()) == 8, jax.devices()
+
+
+@pytest.fixture(autouse=True)
+def _lockwitness_guard():
+    """With the witness active, any lock-order cycle or held-across-
+    RPC/dispatch violation fails the test that formed it, with the
+    offending stacks."""
+    if not lockwitness.ACTIVE:
+        yield
+        return
+    before = lockwitness.violation_count()
+    yield
+    after = lockwitness.violation_count()
+    assert after == before, (
+        "lock-order witness recorded new violations during this test:\n"
+        + lockwitness.format_violations())
+
+
+def pytest_sessionfinish(session, exitstatus):
+    if lockwitness.ACTIVE:
+        rep = lockwitness.report()
+        print(f"\nlockwitness: {rep['edges']} lock-order edges, "
+              f"{len(rep['cycles'])} cycles, "
+              f"{len(rep['heldAcrossBlocking'])} held-across-blocking")
 
 
 @pytest.fixture(autouse=True)

@@ -432,6 +432,31 @@ def test_stats_registry_drift_guard(pair):
             f"registered set {key!r} missing from /metrics"
 
 
+def test_env_gate_inventory_drift_guard():
+    """Companion to the stats-registry guard: every PILOSA_TPU_* env
+    gate referenced anywhere under pilosa_tpu/ must appear in
+    docs/operations.md — a future PR cannot add a gate operators can't
+    discover."""
+    import os
+
+    from pilosa_tpu.analysis import env_gate_findings
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    findings = env_gate_findings(root)
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+def test_config_knob_inventory_drift_guard():
+    """Every [section] knob in cli/config.py must appear in
+    docs/operations.md AND round-trip through Config.to_toml() — the
+    wiring a knob needs to be settable cli→config→Server."""
+    import os
+
+    from pilosa_tpu.analysis import config_knob_findings
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    findings = config_knob_findings(root)
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
 def test_metrics_endpoint_without_stats_client(pair):
     """A handler with no stats wired still answers 200 with an empty
     (legal) exposition."""
