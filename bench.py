@@ -1093,6 +1093,197 @@ def bench_accounting(tmpdir) -> dict:
         srv.close()
 
 
+HEAT_CLIENTS = int(os.environ.get("PILOSA_BENCH_HEAT_CLIENTS", "16"))
+HEAT_QPC = int(os.environ.get("PILOSA_BENCH_HEAT_QPC", "6"))
+HEAT_ROUNDS = int(os.environ.get("PILOSA_BENCH_HEAT_ROUNDS", "3"))
+HEAT_ROWS = int(os.environ.get("PILOSA_BENCH_HEAT_ROWS", "96"))
+HEAT_ACCESSES = int(os.environ.get("PILOSA_BENCH_HEAT_ACCESSES", "900"))
+
+
+def bench_heat(tmpdir) -> dict:
+    """Fragment heat map A/B (utils/heat.py; docs/operations.md "Data
+    temperature and placement advice").
+
+    (a) tracking overhead: one server, HEAT_CLIENTS keep-alive clients
+        on the residency-hot Count(Intersect) workload, interleaved
+        tracker-disabled/enabled rounds. Headline = median-latency delta
+        of enabling heat tracking (budget <= 1%, the accounting-stage
+        methodology — the charge sites must be invisible).
+    (b) eviction steering: a local executor with a deliberately
+        constrained HBM residency budget (a quarter of the row working
+        set) serving a skewed zipfian row-read sequence; the SAME
+        sequence replays under eviction=lru and eviction=heat and the
+        stage reports the warm residency hit-rate delta — heat keeps the
+        zipf head resident through the long-tail scans that rotate it
+        out of LRU."""
+    import http.client
+    import statistics
+    import threading
+
+    from pilosa_tpu.executor import Executor
+    from pilosa_tpu.models import Holder
+    from pilosa_tpu.server import Server
+
+    srv = Server(os.path.join(tmpdir, "heat"), port=0).open()
+    try:
+        hostport = srv.uri.split("//", 1)[1]
+        _local = threading.local()
+
+        def post(path, body):
+            conn = getattr(_local, "conn", None)
+            if conn is None:
+                conn = _local.conn = http.client.HTTPConnection(
+                    hostport, timeout=60)
+            try:
+                conn.request("POST", path, body=body)
+                resp = conn.getresponse()
+                out = resp.read()
+            except (http.client.HTTPException, OSError):
+                conn.close()
+                conn = _local.conn = http.client.HTTPConnection(
+                    hostport, timeout=60)
+                conn.request("POST", path, body=body)
+                resp = conn.getresponse()
+                out = resp.read()
+            if resp.status != 200:
+                raise RuntimeError(f"{path}: {resp.status}: {out[:200]}")
+            return out
+
+        post("/index/ht", b"{}")
+        post("/index/ht/field/f", b"{}")
+        rng = np.random.default_rng(47)
+        cols = rng.choice(4 * SHARD_WIDTH, size=100_000, replace=False)
+        half = len(cols) // 2
+        post("/index/ht/field/f/import", json.dumps({
+            "rowIDs": [0] * half + [1] * (len(cols) - half),
+            "columnIDs": cols.tolist()}).encode())
+        q = b"Count(Intersect(Row(f=0), Row(f=1)))"
+        for _ in range(5):
+            post("/index/ht/query", q)  # warm residency + compile
+
+        tracker = srv.executor.heat
+
+        def run_round(heat_on: bool) -> float:
+            if tracker is not None:
+                tracker.enabled = heat_on
+            lats: list[float] = []
+            lat_lock = threading.Lock()
+            barrier = threading.Barrier(HEAT_CLIENTS)
+
+            def client(i):
+                mine = []
+                barrier.wait()
+                for _ in range(HEAT_QPC):
+                    t0 = time.perf_counter()
+                    post("/index/ht/query", q)
+                    mine.append((time.perf_counter() - t0) * 1e3)
+                with lat_lock:
+                    lats.extend(mine)
+
+            threads = [threading.Thread(target=client, args=(i,))
+                       for i in range(HEAT_CLIENTS)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            return statistics.median(lats)
+
+        rounds = []
+        for _ in range(HEAT_ROUNDS):
+            rnd = {"ms_off": round(run_round(False), 4),
+                   "ms_on": round(run_round(True), 4)}
+            rnd["overhead_pct"] = round(
+                100.0 * (rnd["ms_on"] / rnd["ms_off"] - 1.0), 2) \
+                if rnd["ms_off"] else 0.0
+            rounds.append(rnd)
+        if tracker is not None:
+            tracker.enabled = True
+        overheads = sorted(r["overhead_pct"] for r in rounds)
+        overhead_med = overheads[len(overheads) // 2]
+    finally:
+        srv.close()
+
+    # (b) heat-vs-LRU eviction under a skewed zipfian read workload at a
+    # constrained HBM budget — a local executor, no HTTP in the loop
+    holder = Holder(os.path.join(tmpdir, "heat-ev")).open()
+    try:
+        ex = Executor(holder)
+        # the measurement target is RESIDENCY eviction: the plan cache
+        # would absorb repeat Counts before they ever touch a leaf
+        ex.plan_cache = None
+        idx = holder.create_index("z")
+        # heat is FRAGMENT-granular (index, field, view, shard): the skew
+        # must live across fragments for the signal to differentiate
+        # occupants — one field per fragment, zipf-weighted access (hot
+        # dashboard fields vs a long tail), matching how placement will
+        # consume the same signal
+        for k in range(HEAT_ROWS):
+            idx.create_field(f"f{k}").import_bits(
+                [0] * 4, [k, k + 7, k + 101, k + 1013])
+        # one probe query sizes a row leaf on this backend
+        ex.execute("z", "Count(Row(f0=0))")
+        leaf_bytes = max(1, ex.residency.bytes)
+        res = ex.residency
+        res.budget = leaf_bytes * max(2, HEAT_ROWS // 4)
+        # skewed zipfian reads interleaved with sequential scan traffic
+        # (the dashboard + batch-export mix), fixed seed: identical under
+        # both modes. The scans are what separate the policies — a full
+        # sweep rotates the zipf head out of a 1/4-working-set LRU, while
+        # heat remembers the head's standing across the sweep.
+        weights = 1.0 / np.arange(1, HEAT_ROWS + 1) ** 1.3
+        weights /= weights.sum()
+        zipf = rng.choice(HEAT_ROWS, size=HEAT_ACCESSES, p=weights)
+        seq = []
+        scan_pos = 0
+        for i, r in enumerate(zipf):
+            if i % 3 == 0:
+                seq.append(scan_pos % HEAT_ROWS)
+                scan_pos += 1
+            else:
+                seq.append(int(r))
+
+        def run_eviction(mode: str) -> float:
+            res.eviction = mode
+            res.clear()
+            h0, m0 = res.hits, res.misses
+            for r in seq:
+                ex.execute("z", f"Count(Row(f{int(r)}=0))")
+            dh, dm = res.hits - h0, res.misses - m0
+            return dh / max(1, dh + dm)
+
+        # teach the tracker the skew once (also warms compiles), then
+        # replay the identical sequence under each policy
+        run_eviction("lru")
+        hit_lru = run_eviction("lru")
+        hit_heat = run_eviction("heat")
+        heat_evictions = res.heat_evictions
+    finally:
+        holder.close()
+
+    return {
+        "metric": "heat_overhead_pct",
+        "value": overhead_med,
+        "unit": "% (tracking on vs off, median latency at "
+                f"{HEAT_CLIENTS} clients; budget <= 1%)",
+        "rounds": rounds,
+        "eviction_ab": {
+            "rows": HEAT_ROWS,
+            "accesses": HEAT_ACCESSES,
+            "budget_leaves": max(2, HEAT_ROWS // 4),
+            "warm_hit_rate_lru": round(hit_lru, 4),
+            "warm_hit_rate_heat": round(hit_heat, 4),
+            "hit_rate_delta_pp": round(100 * (hit_heat - hit_lru), 2),
+            "heat_evictions": heat_evictions,
+        },
+        "vs_baseline": 0.0,
+        "path": f"{HEAT_CLIENTS} keep-alive clients x {HEAT_QPC} "
+                "Count(Intersect) each, interleaved tracker off/on "
+                f"rounds; then {HEAT_ACCESSES} zipf(1.3) row reads over "
+                f"{HEAT_ROWS} rows at a quarter-working-set HBM budget, "
+                "same sequence under eviction=lru and eviction=heat",
+    }
+
+
 QOS_CLIENTS = int(os.environ.get("PILOSA_BENCH_QOS_CLIENTS", "64"))
 QOS_QPC = int(os.environ.get("PILOSA_BENCH_QOS_QPC", "8"))
 QOS_ROUNDS = int(os.environ.get("PILOSA_BENCH_QOS_ROUNDS", "3"))
@@ -2042,6 +2233,7 @@ def worker() -> None:
         stage("profiler", bench_profiler, tmp)
         stage("telemetry", bench_telemetry, tmp)
         stage("accounting", bench_accounting, tmp)
+        stage("heat", bench_heat, tmp)
         stage("qos", bench_qos, tmp)
         stage("planner", bench_planner, tmp)
         stage("distributed", bench_distributed, tmp)

@@ -13,6 +13,10 @@ mechanical checks:
   docs/operations.md (`inventories`). CLI: `python -m pilosa_tpu.analysis
   [--check]`; `--check` exits non-zero on any finding not in the
   committed baseline (pilosa_tpu/analysis/baseline.txt — kept EMPTY).
+* `advisor` — the dry-run placement advisor over the fragment heat map
+  (utils/heat.py): deterministic HBM pin set / eviction candidates /
+  projected tier assignments, served at `GET /debug/heat?advice=true`
+  and by `pilosa-tpu advise`.
 * `lockwitness` — an instrumented Lock/RLock wrapper (env-gated
   `PILOSA_TPU_LOCKCHECK=1`, zero-cost pass-through otherwise) recording
   the per-thread lock acquisition graph: cycles (potential deadlock) and
@@ -26,6 +30,7 @@ See docs/operations.md "Static analysis and race detection".
 from pilosa_tpu.analysis.lint import Finding, run_lint  # noqa: F401
 from pilosa_tpu.analysis.inventories import (  # noqa: F401
     config_knob_findings, env_gate_findings)
+from pilosa_tpu.analysis.advisor import advise, render_advice  # noqa: F401
 
 
 def run_all(root: str) -> list:

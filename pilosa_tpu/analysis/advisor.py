@@ -1,0 +1,163 @@
+"""Placement advisor: dry-run recommendations from the fragment heat map.
+
+The exact input contract ROADMAP items 3 (elastic resize) and 4 (tiered
+storage) will execute against: given a HeatTracker snapshot (and
+optionally the residency occupancy and per-node federation summaries),
+emit deterministic, machine-readable placement recommendations —
+WITHOUT acting on any of them. Served at `GET /debug/heat?advice=true`
+and by `pilosa-tpu advise`.
+
+Determinism is the contract: `advise()` is a pure function of its input
+documents (no clock reads, no randomness), so replaying a recorded
+access trace through a tracker with pinned timestamps reproduces the
+recommendations byte-for-byte (tests/test_heat.py pins this). That is
+what makes the advisor reviewable before resize/tiering start obeying
+it: an operator can diff today's advice against yesterday's trace.
+
+Glossary (docs/operations.md "Data temperature and placement advice"):
+
+* `hbmPinSet` — the hottest fragments worth pinning HBM-resident; the
+  prefetch list a tier-up pass would load first.
+* `evictionCandidates` — tracked-but-cold fragments that have HBM
+  history (uploads > 0): residency budget they may still occupy is
+  better spent on the pin set.
+* `tiers` — projected tier assignment per fragment: `hbm` (score >=
+  HOT_SCORE), `host` (warm: touched but under the hot bar), `cold`
+  (no measurable heat); the item-4 placement contract.
+* `nodes` — per-node hot-fragment skew vs health (federation input):
+  a node whose skew is far above the fleet's while healthy is a
+  rebalancing candidate; an unhealthy hot node is a page.
+"""
+
+from __future__ import annotations
+
+from pilosa_tpu.utils.heat import HOT_SCORE
+
+# a node's skew this far above the fleet median flags it for rebalance
+NODE_SKEW_RATIO = 2.0
+# fleet-level skew worth calling out at all (1.0 = perfectly even)
+SKEW_ALERT = 4.0
+
+
+def _frag_id(e: dict) -> dict:
+    return {"index": e.get("index"), "field": e.get("field"),
+            "view": e.get("view"), "shard": int(e.get("shard", 0))}
+
+
+def advise(heat_doc: dict, residency: dict = None,
+           budget_bytes: int = 0, nodes: list = None,
+           top_k: int = 16) -> dict:
+    """Dry-run placement recommendations from a heat document (the
+    `snapshot(top=0)` form, so `hot` carries every tracked fragment).
+    `residency`/`budget_bytes` contextualize the pin set against actual
+    HBM occupancy; `nodes` is the federation's per-node summary list
+    ({id, skew, hotFragments, health}). Pure and deterministic."""
+    entries = list(heat_doc.get("hot") or [])
+    # defensive re-sort: advice must be deterministic even when fed a
+    # hand-assembled document (score desc, fragment coordinate asc)
+    entries.sort(key=lambda e: (-float(e.get("score", 0.0)),
+                                e.get("index") or "", e.get("field") or "",
+                                e.get("view") or "",
+                                int(e.get("shard", 0))))
+    hot = [e for e in entries if float(e.get("score", 0.0)) >= HOT_SCORE]
+    pin = [{**_frag_id(e), "score": e.get("score"),
+            "readsPerS": e.get("readsPerS"),
+            "h2dBytes": e.get("h2dBytes")} for e in hot[:top_k]]
+    evict = [{**_frag_id(e), "score": e.get("score"),
+              "uploads": e.get("uploads"), "evictions": e.get("evictions")}
+             for e in reversed(entries)
+             if float(e.get("score", 0.0)) < HOT_SCORE
+             and float(e.get("uploads", 0.0)) > 0][:top_k]
+    tiers = {"hbm": 0, "host": 0, "cold": 0}
+    assignments = []
+    for e in entries:
+        score = float(e.get("score", 0.0))
+        tier = ("hbm" if score >= HOT_SCORE
+                else "host" if score > 0.0 else "cold")
+        tiers[tier] += 1
+        if len(assignments) < 4 * top_k:
+            assignments.append({**_frag_id(e), "tier": tier,
+                                "score": e.get("score")})
+    skew = float(heat_doc.get("skew", 1.0))
+    skew_out = {
+        "fleet": skew,
+        "alert": skew >= SKEW_ALERT,
+    }
+    node_out = []
+    if nodes:
+        skews = sorted(float(n.get("skew", 1.0)) for n in nodes)
+        median = skews[len(skews) // 2]
+        for n in sorted(nodes, key=lambda n: str(n.get("id"))):
+            nskew = float(n.get("skew", 1.0))
+            health = ((n.get("health") or {}).get("score")
+                      if isinstance(n.get("health"), dict)
+                      else n.get("health")) or "unknown"
+            rec = "ok"
+            # relative trigger (far above the fleet median) OR absolute
+            # (a majority-hot fleet must not normalize its own skew away)
+            if (median > 0 and nskew >= NODE_SKEW_RATIO * median) \
+                    or nskew >= SKEW_ALERT:
+                # a healthy node running disproportionately hot is the
+                # elastic-resize trigger; an UNHEALTHY hot node needs an
+                # operator before any rebalance makes it worse
+                rec = ("rebalance-candidate" if health == "green"
+                       else "investigate-health")
+            node_out.append({"id": n.get("id"), "skew": nskew,
+                             "hotFragments": int(
+                                 n.get("hotFragments", 0)),
+                             "health": health,
+                             "recommendation": rec})
+    out = {
+        "dryRun": True,  # the advisor NEVER acts; items 3/4 will
+        "hbmPinSet": pin,
+        "evictionCandidates": evict,
+        "tiers": {**tiers, "assignments": assignments},
+        "skew": skew_out,
+        "inputs": {
+            "trackedFragments": int(heat_doc.get("trackedFragments", 0)),
+            "spilledFragments": int(heat_doc.get("spilledFragments", 0)),
+            "hotFragments": int(heat_doc.get("hotFragments", 0)),
+        },
+    }
+    if nodes:
+        out["nodes"] = node_out
+    if residency is not None:
+        out["residency"] = {
+            "bytes": int(residency.get("bytes", 0)),
+            "budget": int(budget_bytes or 0),
+            "entries": int(residency.get("entries", 0)),
+            "evictions": int(residency.get("evictions", 0)),
+        }
+    return out
+
+
+def render_advice(advice: dict) -> str:
+    """Human-readable advice for the `pilosa-tpu advise` CLI."""
+    lines = ["placement advice (dry run — nothing is acted on)"]
+    pin = advice.get("hbmPinSet") or []
+    lines.append(f"  HBM pin set ({len(pin)}):")
+    for e in pin:
+        lines.append(
+            f"    {e['index']}/{e['field']}/{e['view']}/{e['shard']}"
+            f"  score={e.get('score')} reads/s={e.get('readsPerS')}")
+    ev = advice.get("evictionCandidates") or []
+    lines.append(f"  eviction candidates ({len(ev)}):")
+    for e in ev:
+        lines.append(
+            f"    {e['index']}/{e['field']}/{e['view']}/{e['shard']}"
+            f"  score={e.get('score')}")
+    tiers = advice.get("tiers") or {}
+    lines.append(
+        f"  projected tiers: hbm={tiers.get('hbm', 0)} "
+        f"host={tiers.get('host', 0)} cold={tiers.get('cold', 0)}")
+    skew = advice.get("skew") or {}
+    lines.append(
+        f"  skew: fleet={skew.get('fleet')}"
+        + (" ALERT (one fragment set dominates)"
+           if skew.get("alert") else ""))
+    for n in advice.get("nodes") or []:
+        lines.append(
+            f"  node {n['id']}: skew={n['skew']} "
+            f"hot={n['hotFragments']} health={n['health']} -> "
+            f"{n['recommendation']}")
+    return "\n".join(lines)

@@ -153,6 +153,10 @@ class API:
         self.trace_exporter = None
         # federation hook for GET /cluster/usage (Server.cluster_usage)
         self.cluster_usage_fn = None
+        # federation hook for GET /cluster/heat (Server.cluster_heat):
+        # the fleet's merged fragment heat map, same degradation
+        # contract (404 peers are "legacy", never an error)
+        self.cluster_heat_fn = None
         # multi-tenant QoS plane (pilosa_tpu/qos.py QosPlane); set by
         # Server. The HTTP layer runs admission against it; here it
         # collects execution-boundary sheds (expired deadlines — local

@@ -129,8 +129,13 @@ class StorageConfig:
     (fsync per acked op: survives power loss, ~100x write cost).
     Precedence: the PILOSA_TPU_WAL_FSYNC env var, when set, overrides this
     setting per fragment (kept as the emergency toggle that needs no
-    config rollout); unset env → this knob; neither → off."""
+    config rollout); unset env → this knob; neither → off.
+
+    eviction: HBM residency victim selection — "lru" (default) or "heat"
+    (evict coldest by the fragment heat map, utils/heat.py; requires
+    heat tracking, so PILOSA_TPU_HEAT=0 forces lru regardless)."""
     wal_fsync: str = "off"
+    eviction: str = "lru"
 
 
 @dataclass
@@ -411,6 +416,7 @@ class Config:
             "",
             "[storage]",
             f'wal-fsync = "{self.storage.wal_fsync}"',
+            f'eviction = "{self.storage.eviction}"',
             "",
             "[anti-entropy]",
             f"interval = {self.anti_entropy.interval}",

@@ -1,5 +1,5 @@
 """`pilosa-tpu` command family: server / import / export / inspect / check /
-config / generate-config.
+config / generate-config / advise.
 
 Reference: cmd/*.go (cobra subcommands), ctl/*.go (implementations).
 """
@@ -85,6 +85,14 @@ def build_parser() -> argparse.ArgumentParser:
     cfgp.add_argument("--config", help="TOML config file")
 
     sub.add_parser("generate-config", help="print default TOML config")
+
+    ap = sub.add_parser(
+        "advise", help="fetch the fragment heat map and print the "
+                       "placement advisor's dry-run recommendations")
+    ap.add_argument("--host", default="http://localhost:10101")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="print the raw advice document instead of the "
+                         "rendered report")
     return p
 
 
@@ -144,6 +152,7 @@ def cmd_server(args) -> int:
         anti_entropy_pace=cfg.anti_entropy.pace,
         anti_entropy_max_blocks=cfg.anti_entropy.max_blocks,
         wal_fsync=cfg.storage.wal_fsync,
+        eviction=cfg.storage.eviction,
         join=getattr(args, "join", False),
         long_query_time=cfg.cluster.long_query_time,
         query_timeout=cfg.cluster.query_timeout,
@@ -384,6 +393,29 @@ def cmd_generate_config(_args) -> int:
     return 0
 
 
+def cmd_advise(args) -> int:
+    """`pilosa-tpu advise`: the node's fragment heat map run through the
+    placement advisor (GET /debug/heat?advice=true) — the same dry-run
+    recommendations /debug/heat serves, rendered for a terminal."""
+    from pilosa_tpu.analysis.advisor import render_advice
+    url = args.host + "/debug/heat?advice=true&top=0"
+    try:
+        with urllib.request.urlopen(url, timeout=30) as resp:
+            doc = json.loads(resp.read())
+    except (OSError, ValueError) as e:
+        raise SystemExit(f"error: fetching {url}: {e}")
+    if not doc.get("enabled", False) and not doc.get("trackedFragments"):
+        print("heat tracking is disabled or has no data yet "
+              "(PILOSA_TPU_HEAT=0, or no traffic)")
+        return 1
+    advice = doc.get("advice") or {}
+    if args.as_json:
+        print(json.dumps(advice, indent=2, sort_keys=True))
+    else:
+        print(render_advice(advice))
+    return 0
+
+
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     handler = {
@@ -394,6 +426,7 @@ def main(argv=None) -> int:
         "check": cmd_check,
         "config": cmd_config,
         "generate-config": cmd_generate_config,
+        "advise": cmd_advise,
     }[args.command]
     return handler(args)
 

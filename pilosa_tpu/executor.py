@@ -142,6 +142,15 @@ class Executor:
         # transfers (parallel/residency.py)
         from pilosa_tpu.parallel.residency import DeviceResidency
         self.residency = DeviceResidency(self.runner)
+        # fragment heat map (utils/heat.py): per-(index, field, view,
+        # shard) access temperature charged by the row-leaf reads, the
+        # write path, plan-cache hits and the residency transitions; the
+        # placement advisor and `[storage] eviction = heat` consume it.
+        # PILOSA_TPU_HEAT=0 builds no tracker — every charge site is one
+        # None check and residency eviction stays lru.
+        from pilosa_tpu.utils import heat as _heat
+        self.heat = _heat.HeatTracker() if _heat.enabled() else None
+        self.residency.heat = self.heat
         # continuous batching of concurrent simple Counts into single
         # device dispatches (parallel/batcher.py); PILOSA_TPU_BATCH=0
         # falls back to one dispatch per query
@@ -543,6 +552,14 @@ class Executor:
                                    row_id)
         key = ("row", index.name, field_name, view_name, row_id,
                tuple(shards), gens)
+        tracker = self.heat
+        if tracker is not None and tracker.enabled:
+            # read heat at the fragment coordinate, one lock round trip
+            # for the whole shard set (every consumer of row leaves —
+            # bitmap programs, BSI planes, TopN recounts, GroupBy slabs —
+            # funnels through here, so this is THE read charge site)
+            tracker.touch_many([(index.name, field_name, view_name, s)
+                                for s in shards], reads=1)
         return self.residency.leaf(key, lambda: np.stack([
             self._cached_row(index, field_name, view_name, s, row_id)
             for s in shards]))
@@ -673,25 +690,79 @@ class Executor:
                 and call.name in _planner.BITMAP_CALLS
                 and not _planner.is_empty_call(call)):
             key = _planner.subtree_cache_key(self, index, call, shards)
+        heat_on = self.heat is not None and self.heat.enabled
         epoch = 0
         if key is not None:
             epoch = pc.epoch
             hit = pc.get(key)
             _planner.record_cache_event(call, hit is not None)
             if hit is not None:
+                if heat_on:
+                    # a cached read still HEATS its operands: the hit
+                    # never reaches _row_leaf_dev, but the caller wanted
+                    # exactly these fragments hot — reuse is the
+                    # strongest pin signal the advisor has
+                    self._heat_call_touch(index, call, shards, reads=1)
                 return hit
         acct = accounting.current_account.get()
-        t0 = _time.perf_counter() if acct is not None else 0.0
+        t0 = _time.perf_counter() if (acct is not None or heat_on) else 0.0
         program, leaves = self._compile(index, call, shards)
         dev = self.runner.row_leaves_dev(leaves, program)
-        if acct is not None:
+        if acct is not None or heat_on:
             # the composed-subtree evaluation is per-query device work the
             # batchers never see — charged as wall time of the compile +
             # dispatch (the attribution available without a device sync)
-            acct.charge(device_ms=(_time.perf_counter() - t0) * 1e3)
+            elapsed_ms = (_time.perf_counter() - t0) * 1e3
+            if acct is not None:
+                acct.charge(device_ms=elapsed_ms)
+            if heat_on:
+                # attributed device-ms per fragment (split evenly across
+                # the operand coordinates — the dispatch-share convention)
+                self._heat_call_touch(index, call, shards,
+                                      device_ms=elapsed_ms)
         if key is not None:
             pc.put(key, dev, dev.nbytes, epoch=epoch)
         return dev
+
+    def _heat_call_touch(self, index: Index, call: Call, shards,
+                         reads: int = 0, device_ms: float = 0.0) -> None:
+        """Charge a bitmap call tree's operand fragments (the plan-cache
+        hit path and the composed-dispatch device-ms attribution). The
+        walk mirrors _compile's leaf discovery at fragment granularity:
+        Row -> standard view, BSI Range -> the bsig_ view, time Range
+        approximated at the standard view (the per-quantum expansion is
+        not worth a second full walk on a hit path), Not -> existence."""
+        from pilosa_tpu.constants import EXISTENCE_FIELD_NAME
+        tracker = self.heat
+        if tracker is None or not tracker.enabled:
+            return
+        pairs: list[tuple] = []
+
+        def walk(c: Call) -> None:
+            if c.name == "Row":
+                pairs.append((c.field_arg(), VIEW_STANDARD))
+            elif c.name == "Range":
+                cond_field = None
+                for k, v in c.args.items():
+                    if isinstance(v, Condition):
+                        cond_field = k
+                if cond_field is not None:
+                    pairs.append((cond_field, "bsig_" + cond_field))
+                else:
+                    fa = c.field_arg()
+                    if fa:
+                        pairs.append((fa, VIEW_STANDARD))
+            elif c.name == "Not":
+                pairs.append((EXISTENCE_FIELD_NAME, VIEW_STANDARD))
+            for ch in c.children:
+                walk(ch)
+
+        walk(call)
+        if not pairs:
+            return
+        tracker.touch_many(
+            [(index.name, f, v, s) for f, v in pairs for s in shards],
+            reads=reads, device_ms=device_ms)
 
     def _execute_bitmap_call(self, index: Index, call: Call, shards) -> Row:
         from pilosa_tpu import planner as _planner
@@ -751,6 +822,9 @@ class Executor:
                 cached = pc.get(key)
                 _planner.record_cache_event(child, cached is not None)
                 if cached is not None:
+                    # cached Counts heat their operands too (see
+                    # _composed_row_dev: reuse is still access)
+                    self._heat_call_touch(index, child, shards, reads=1)
                     self._record_actual(cached)
                     return cached
         n = self._count_device(index, child, shards)
@@ -787,8 +861,11 @@ class Executor:
                     and leaves[0].shape == leaves[1].shape):
                 return self.batcher.count(program[0], leaves[0], leaves[1])
         # un-batched dispatches are this query's alone: charge full wall
+        # (batched counts above are smeared across co-batched queries —
+        # their heat was already charged per leaf in _row_leaf_dev)
         acct = accounting.current_account.get()
-        t0 = _time.perf_counter() if acct is not None else 0.0
+        heat_on = self.heat is not None and self.heat.enabled
+        t0 = _time.perf_counter() if (acct is not None or heat_on) else 0.0
         if (isinstance(program, tuple) and len(program) > 3
                 and program[0] == "and"
                 and all(p == ("leaf", i) for i, p in enumerate(program[1:]))
@@ -802,8 +879,13 @@ class Executor:
             n = int(intersect_chain_count_total(tuple(leaves)))
         else:
             n = self.runner.count_total_leaves(leaves, program)
-        if acct is not None:
-            acct.charge(device_ms=(_time.perf_counter() - t0) * 1e3)
+        if acct is not None or heat_on:
+            elapsed_ms = (_time.perf_counter() - t0) * 1e3
+            if acct is not None:
+                acct.charge(device_ms=elapsed_ms)
+            if heat_on:
+                self._heat_call_touch(index, child, shards,
+                                      device_ms=elapsed_ms)
         return n
 
     # ------------------------------------------------- leaf materialization
@@ -1677,7 +1759,24 @@ class Executor:
             ts = call.args.get("_timestamp")
             changed = f.set_bit(row_id, col, timestamp=ts)
         index.mark_exists(col)
+        # write heat on the replica that APPLIED the mutation: the
+        # distributed write path executes this call on every live owner
+        # (locally or via remote=True fan-out), so each node's tracker is
+        # charged for the fragments it owns — never the coordinator's
+        self._heat_write(index, f, col)
         return changed
+
+    def _heat_write(self, index: Index, f, col: int,
+                    view_name: str = None) -> None:
+        tracker = self.heat
+        if tracker is None or not tracker.enabled:
+            return
+        if view_name is None:
+            view_name = (f.bsi_view_name
+                         if f.options.type == FieldType.INT
+                         else VIEW_STANDARD)
+        tracker.touch(index.name, f.name, view_name, col // SHARD_WIDTH,
+                      writes=1)
 
     def _execute_clear(self, index: Index, call: Call, shards) -> bool:
         col = self._translate_col(index, call.args["_col"], create=False)
@@ -1688,11 +1787,17 @@ class Executor:
         if col is None:
             return False  # unknown column key: nothing to clear
         if f.options.type == FieldType.INT:
-            return f.clear_value(col)
+            changed = f.clear_value(col)
+            if changed:
+                self._heat_write(index, f, col)
+            return changed
         row_id = self._translate_row(index, f, call.args[field_name], create=False)
         if row_id is None:
             return False
-        return f.clear_bit(row_id, col)
+        changed = f.clear_bit(row_id, col)
+        if changed:
+            self._heat_write(index, f, col)
+        return changed
 
     def _execute_clear_row(self, index: Index, call: Call, shards) -> bool:
         field_name = call.field_arg()
@@ -1703,11 +1808,15 @@ class Executor:
         if row_id is None:
             return False
         changed = False
+        tracker = self.heat
         for v in f.views.values():
             if v.name.startswith("bsig_"):
                 continue
             for s in list(v.fragments):
-                changed |= v.fragments[s].clear_row(row_id) > 0
+                frag_changed = v.fragments[s].clear_row(row_id) > 0
+                changed |= frag_changed
+                if frag_changed and tracker is not None and tracker.enabled:
+                    tracker.touch(index.name, f.name, v.name, s, writes=1)
         return changed
 
     def _execute_store(self, index: Index, call: Call, shards) -> bool:
@@ -1721,6 +1830,10 @@ class Executor:
         row = self._execute_bitmap_call(index, call.children[0], shards)
         view = f.create_view_if_not_exists(VIEW_STANDARD)
         qshards = self._query_shards(index, shards)
+        tracker = self.heat
+        if tracker is not None and tracker.enabled:
+            tracker.touch_many([(index.name, f.name, VIEW_STANDARD, s)
+                                for s in qshards], writes=1)
         for s in qshards:
             frag = view.create_fragment_if_not_exists(s)
             seg = row.segments.get(s)

@@ -460,6 +460,15 @@ class InternalClient:
         out = self._request("GET", uri, "/debug/usage", timeout=timeout)
         return json.loads(out) if out else {}
 
+    def debug_heat(self, uri: str, timeout: Optional[float] = None) -> dict:
+        """One peer's fragment heat document (GET /debug/heat?top=0 —
+        the full tracked table, what the /cluster/heat merge needs).
+        Same legacy contract as node_stats: a peer predating the route
+        404s and the caller degrades it."""
+        out = self._request("GET", uri, "/debug/heat?top=0",
+                            timeout=timeout)
+        return json.loads(out) if out else {}
+
     def translate_keys(self, uri: str, index: str, field: Optional[str],
                        keys: list[str], create: bool = True) -> list:
         out = self._json("POST", uri, "/internal/translate/keys",

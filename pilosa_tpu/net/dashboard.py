@@ -5,9 +5,10 @@ sparklines — so it works air-gapped from any node's port with nothing but
 the node itself (pinned by the tier-1 no-external-URLs test in
 tests/test_telemetry.py). Data comes from the same JSON surfaces
 operators script against: `/cluster/stats` (fleet table + per-node
-time-series tails, fetched once per refresh) and `/debug/timeseries`
+time-series tails, fetched once per refresh), `/debug/timeseries`
 (the serving node's full-resolution rings, fetched incrementally with
-the `since` cursor so each sample crosses the wire once).
+the `since` cursor so each sample crosses the wire once), `/debug/usage`
+(top principals + SLO burn) and `/debug/heat` (the fragment heat grid).
 """
 
 from __future__ import annotations
@@ -72,6 +73,9 @@ svg.spark line.base { stroke: var(--grid); stroke-width: 1; }
   min-width: 230px; }
 .tile .name { color: var(--text-2); font-size: 11px; }
 .tile .val { font-size: 18px; font-weight: 600; margin: 2px 0 6px; }
+.heatgrid { display: flex; flex-wrap: wrap; gap: 3px; max-width: 860px; }
+.heatgrid .cell { width: 34px; height: 22px; border-radius: 3px;
+  background: var(--series); }
 #err { color: var(--bad); }
 a { color: var(--series); }
 </style>
@@ -91,6 +95,10 @@ a { color: var(--series); }
   <th class="num">queries</th><th class="num">errors</th>
   <th class="num">cache hits</th>
 </tr></thead><tbody></tbody></table>
+
+<h2>Fragment heat</h2>
+<div class="sub" id="heatmeta"></div>
+<div id="heatgrid" class="heatgrid"></div>
 
 <h2>Fleet</h2>
 <table id="fleet"><thead><tr>
@@ -118,6 +126,8 @@ const LOCAL_SERIES = [
   ["batcher.queue_depth", "batcher queue depth", fmtNum],
   ["batcher.avg_wait_ms", "batch wait ms (window)", fmtNum],
   ["plancache.hit_rate", "plan-cache hit rate (window)", fmtRatio],
+  ["heat.skew", "fragment heat skew (hottest / mean)", fmtNum],
+  ["heat.hot_fragments", "hot fragments", fmtNum],
   ["planner.reorders_per_s", "planner reorders / s", fmtNum],
   ["usage.queries_per_s", "accounted queries / s", fmtNum],
   ["qos.admitted_per_s", "QoS admitted / s", fmtNum],
@@ -319,6 +329,33 @@ function renderUsage(doc) {
   }
 }
 
+// fragment heat grid (GET /debug/heat): one cell per hot fragment,
+// intensity = score relative to the hottest — the at-a-glance "is one
+// fragment set carrying the node" panel; hover for the coordinate
+function renderHeat(doc) {
+  const meta = document.getElementById("heatmeta");
+  meta.textContent = (doc.trackedFragments || 0) + " tracked · " +
+    (doc.hotFragments || 0) + " hot · skew " + fmtNum(doc.skew || 1) +
+    "x · " + (doc.spilledFragments || 0) + " spilled" +
+    (doc.enabled === false ? " · TRACKING OFF" : "");
+  const grid = document.getElementById("heatgrid");
+  grid.textContent = "";
+  const entries = (doc.hot || []).slice(0, 48);
+  const max = entries.length ? entries[0].score || 0 : 0;
+  for (const e of entries) {
+    const cell = document.createElement("div");
+    cell.className = "cell";
+    const rel = max > 0 ? (e.score || 0) / max : 0;
+    cell.style.opacity = (0.15 + 0.85 * rel).toFixed(2);
+    cell.title = e.index + "/" + e.field + "/" + e.view + "/" + e.shard +
+      "  score=" + e.score + "  reads/s=" + e.readsPerS;
+    grid.appendChild(cell);
+  }
+  if (!entries.length) {
+    grid.textContent = "no heated fragments yet";
+  }
+}
+
 async function refresh() {
   const err = document.getElementById("err");
   try {
@@ -330,6 +367,8 @@ async function refresh() {
     renderLocal();
     const us = await (await fetch("/debug/usage?top=12")).json();
     renderUsage(us);
+    const ht = await (await fetch("/debug/heat?top=48")).json();
+    renderHeat(ht);
     const cs = await (await fetch("/cluster/stats")).json();
     renderFleet(cs);
     err.textContent = "";

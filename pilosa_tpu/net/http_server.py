@@ -46,8 +46,10 @@ ROUTES: list[tuple[str, re.Pattern, str]] = [
     ("GET", re.compile(r"^/version$"), "get_version"),
     ("GET", re.compile(r"^/cluster/stats$"), "get_cluster_stats"),
     ("GET", re.compile(r"^/cluster/usage$"), "get_cluster_usage"),
+    ("GET", re.compile(r"^/cluster/heat$"), "get_cluster_heat"),
     ("GET", re.compile(r"^/debug/vars$"), "get_debug_vars"),
     ("GET", re.compile(r"^/debug/usage$"), "get_debug_usage"),
+    ("GET", re.compile(r"^/debug/heat$"), "get_debug_heat"),
     ("GET", re.compile(r"^/debug/query-history$"), "get_query_history"),
     ("GET", re.compile(r"^/debug/timeseries$"), "get_debug_timeseries"),
     ("GET", re.compile(r"^/debug/dashboard$"), "get_debug_dashboard"),
@@ -92,6 +94,7 @@ ALLOWED_QUERY_ARGS: dict[str, frozenset] = {
     "get_debug_pprof": frozenset({"seconds"}),
     "get_debug_timeseries": frozenset({"since", "limit"}),
     "get_debug_usage": frozenset({"since", "limit", "top"}),
+    "get_debug_heat": frozenset({"since", "limit", "top", "advice"}),
 }
 
 
@@ -249,6 +252,8 @@ class Handler:
                             self.drain_sheds += 1
                         if self.qos is not None:
                             self.qos.record_drain_shed()
+                        self._record_shed(match, body, principal,
+                                          "draining", 503)
                         st, ct, payload = self._error(
                             503, "node is draining (graceful restart): "
                                  "retry against another replica",
@@ -279,6 +284,8 @@ class Handler:
                             qctx.remaining())
                     if rej is not None:
                         qos_rejected = True
+                        self._record_shed(match, body, principal,
+                                          rej.reason, rej.status)
                         st, ct, payload = self._error(
                             rej.status, rej.message,
                             code=("quota-exhausted" if rej.status == 429
@@ -339,6 +346,33 @@ class Handler:
         h = headers if headers is not None and hasattr(headers, "get") \
             else {}
         return bool(h.get(accounting.PRINCIPAL_HEADER))
+
+    def _record_shed(self, match, body: bytes, principal, reason: str,
+                     status: int) -> None:
+        """Rejected queries (QoS quota/deadline/health sheds, drain
+        sheds) used to VANISH: /debug/query-history recorded only
+        executed queries, so an operator reconstructing an incident saw
+        the latency tail but never WHAT was rejected. Shed requests land
+        in the same ring, marked by a `shed` reason, carrying the
+        principal and priority the admission decision was made against
+        and the (truncated) PQL that never ran."""
+        hist = getattr(self.api, "query_history", None)
+        if hist is None:
+            return
+        from datetime import datetime, timezone
+        from pilosa_tpu.utils import profile as qprofile
+        hist.append({
+            "time": datetime.now(timezone.utc).isoformat(),
+            "index": (match.groupdict() or {}).get("index", ""),
+            "pql": qprofile.truncate_pql(
+                body.decode("utf-8", "replace") if body else ""),
+            "shed": reason,
+            "status": status,
+            "principal": principal or "anonymous",
+            "priority": qos.current_priority.get() if qos.enabled()
+            else None,
+            "traceId": tracing.current_trace_id.get() or "-",
+        })
 
     def _error(self, status: int, msg: str, code: str = ""):
         """Protobuf clients get errors as QueryResponse{Err} so they can
@@ -575,6 +609,11 @@ class Handler:
             pc = getattr(ex, "plan_cache", None)
             if pc is not None:
                 snap["planCache"] = pc.snapshot()
+            # fragment heat map (utils/heat.py): top hot/cold fragments,
+            # totals, skew — the expvar mirror of GET /debug/heat
+            tracker = getattr(ex, "heat", None)
+            if tracker is not None:
+                snap["heat"] = tracker.snapshot(top=10)
             snap["hedges"] = {
                 "hedgesFired": getattr(ex, "hedges_fired", 0),
                 "hedgesWon": getattr(ex, "hedges_won", 0),
@@ -697,6 +736,51 @@ class Handler:
             out["slo"] = slo.evaluate()
         return self._json(out)
 
+    def get_debug_heat(self, params, query, body):
+        """Fragment heat map (utils/heat.py HeatTracker): top-K hot and
+        cold fragment lists with scores and charge fields, exact totals,
+        the score distribution and the skew gauge, plus the since-cursor
+        summary ring (`?since=` — the /debug/timeseries contract).
+        `?advice=true` appends the placement advisor's dry-run
+        recommendations (analysis/advisor.py)."""
+        from pilosa_tpu.utils import heat as _heat
+        ex = getattr(self.api, "executor", None)
+        tracker = getattr(ex, "heat", None) if ex is not None else None
+        try:
+            since = int(self._arg(query, "since", "0"))
+            limit = int(self._arg(query, "limit", "0"))
+            top = int(self._arg(query, "top", "20"))
+        except ValueError:
+            raise ApiError("since, limit and top must be integers")
+        if tracker is None:
+            # kill switch (PILOSA_TPU_HEAT=0) or a bare API: the surface
+            # answers with an empty document, never a 404 — pollers and
+            # the dashboard degrade instead of erroring
+            return self._json({"enabled": False, "hot": [], "cold": [],
+                               "totals": {}, "trackedFragments": 0,
+                               "spilledFragments": 0, "hotFragments": 0,
+                               "skew": 1.0, "seq": 0, "samples": []})
+        out = tracker.snapshot(top=top)
+        out.update(tracker.since(since, limit))
+        out["enabled"] = tracker.enabled and _heat.enabled()
+        if self._arg(query, "advice") in ("1", "true"):
+            from pilosa_tpu.analysis.advisor import advise
+            res = getattr(ex, "residency", None)
+            out["advice"] = advise(
+                tracker.snapshot(top=0),
+                residency=res.snapshot() if res is not None else None,
+                budget_bytes=res.budget if res is not None else 0)
+        return self._json(out)
+
+    def get_cluster_heat(self, params, query, body):
+        """The fleet's merged fragment heat map: every live peer's
+        /debug/heat document collected over the persistent fan-out pool
+        and merged per fragment (Server.cluster_heat — legacy peers that
+        404 the route degrade, never an error)."""
+        if self.api.cluster_heat_fn is None:
+            raise ApiError("cluster heat not supported", status=501)
+        return self._json(self.api.cluster_heat_fn())
+
     def get_cluster_usage(self, params, query, body):
         """The fleet's merged per-principal usage: every live peer's
         ledger collected and summed per principal (Server.cluster_usage —
@@ -759,6 +843,7 @@ class Handler:
             counts["residency/hits"] = rs["hits"]
             counts["residency/misses"] = rs["misses"]
             counts["residency/evictions"] = rs["evictions"]
+            counts["residency/heatEvictions"] = rs["heatEvictions"]
         if ex is not None:
             for attr, kind in (("batcher", "count"),
                                ("sum_batcher", "planeSum"),
@@ -812,6 +897,27 @@ class Handler:
             counts["readFence/refusedRemote"] = fence["refusedRemote"]
             counts["readFence/servedStale"] = fence["servedStale"]
             gauges["readFence/fencedShards"] = fence["fencedShards"]
+            # fragment heat families (utils/heat.py): aggregate-only —
+            # per-fragment cardinality lives behind /debug/heat, the
+            # scrape stays bounded regardless of fragment count. Emitted
+            # unconditionally while a tracker exists (zeros included)
+            # like every family above, so "fleet went cold" / "skew
+            # spiked" alerts never race the first access. The score
+            # distribution rides cumulative le labels (a histogram
+            # SNAPSHOT: gauge semantics, since scores decay).
+            tracker = getattr(ex, "heat", None)
+            if tracker is not None:
+                hsnap2 = tracker.snapshot(top=0)
+                for f, v in hsnap2["totals"].items():
+                    counts[f"heat/{f}"] = round(v, 3)
+                gauges["heat/trackedFragments"] = \
+                    hsnap2["trackedFragments"]
+                gauges["heat/spilledFragments"] = \
+                    hsnap2["spilledFragments"]
+                gauges["heat/hotFragments"] = hsnap2["hotFragments"]
+                gauges["heat/skew"] = hsnap2["skew"]
+                for le, n in hsnap2["distribution"].items():
+                    gauges[f"heatDistribution/score,le:{le}"] = float(n)
         holder = getattr(self.api, "holder", None)
         if holder is not None:
             damaged = holder.damaged_fragments()

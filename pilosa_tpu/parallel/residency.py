@@ -12,7 +12,10 @@ with natural invalidation, never a source of truth.
 
 Eviction is LRU by byte budget, the analog of the reference's bounded row
 cache (lru/ + fragment.go rowCache); freed jax.Arrays release their HBM when
-the last reference drops.
+the last reference drops. With `[storage] eviction = heat` the victim is
+instead the coldest occupant by the fragment heat map (utils/heat.py) —
+the hot/cold-separation decision applied to HBM residency, and the proof
+that the heat signal is load-bearing before tiering starts steering by it.
 """
 
 from __future__ import annotations
@@ -41,6 +44,15 @@ class DeviceResidency:
         self.misses = 0
         self.evictions = 0
         self.epoch = 0  # bumped by clear(); fences in-flight misses
+        # fragment heat map (utils/heat.py HeatTracker, set by the
+        # Executor; None = untracked): uploads/evictions and h2d reload
+        # bytes are charged per fragment coordinate, and `eviction =
+        # "heat"` ranks victims coldest-first by it instead of LRU.
+        # The env kill switch wins structurally: with PILOSA_TPU_HEAT=0
+        # no tracker exists, so eviction falls back to lru.
+        self.heat = None
+        self.eviction = "lru"  # [storage] eviction: lru | heat
+        self.heat_evictions = 0  # victims chosen by heat (not LRU order)
 
     def leaf(self, key: tuple, make: Callable[[], np.ndarray]) -> jax.Array:
         """Return the device array for `key`, uploading via `make()` on miss.
@@ -81,6 +93,17 @@ class DeviceResidency:
             acct = accounting.current_account.get()
             if acct is not None:
                 acct.charge(hbm_bytes=arr.nbytes)
+            # fragment heat: h2d reload bytes + an upload transition per
+            # covered fragment (slab bytes split evenly across shards —
+            # the per-seat attribution convention). Outside the LRU lock
+            # like the profiler hook: the tracker has its own lock.
+            tracker = self.heat
+            if tracker is not None and tracker.enabled:
+                from pilosa_tpu.utils import heat as _heat
+                fkeys = _heat.leaf_frag_keys(key)
+                if fkeys:
+                    tracker.touch_many(fkeys, h2d_bytes=arr.nbytes,
+                                       uploads=1)
         with self._lock:
             self.misses += 1
             if self.epoch != epoch:
@@ -96,11 +119,48 @@ class DeviceResidency:
                 self.bytes -= displaced.nbytes
             self._lru[key] = arr
             self.bytes += arr.nbytes
-            while self.bytes > self.budget and len(self._lru) > 1:
-                _, old = self._lru.popitem(last=False)
-                self.bytes -= old.nbytes
-                self.evictions += 1
+            self._evict_over_budget_locked(key)
         return arr
+
+    def _evict_over_budget_locked(self, protect: tuple) -> None:
+        """Evict until under budget. `lru` mode pops the least-recently-
+        used entry; `heat` mode ranks every occupant by the summed heat
+        of the fragments it covers and evicts the coldest (ties fall
+        back to LRU order), never the just-inserted `protect` entry.
+        Heat eviction only engages while a tracker exists AND is enabled
+        AND the env gate is on — any kill switch forces plain lru."""
+        from pilosa_tpu.utils import heat as _heat
+        tracker = self.heat
+        by_heat = (self.eviction == "heat" and tracker is not None
+                   and tracker.enabled and _heat.enabled())
+        while self.bytes > self.budget and len(self._lru) > 1:
+            victim_key = None
+            if by_heat:
+                candidates = [k for k in self._lru if k != protect]
+                flat: list = []
+                spans: list[tuple[int, int]] = []
+                for k in candidates:
+                    fkeys = _heat.leaf_frag_keys(k)
+                    spans.append((len(flat), len(fkeys)))
+                    flat.extend(fkeys)
+                scores = tracker.scores_for(flat)
+                best = None
+                for k, (off, n) in zip(candidates, spans):
+                    s = sum(scores[off:off + n])
+                    if best is None or s < best:
+                        victim_key, best = k, s
+            if victim_key is not None:
+                old = self._lru.pop(victim_key)
+                self.heat_evictions += 1
+            else:
+                victim_key, old = self._lru.popitem(last=False)
+            self.bytes -= old.nbytes
+            self.evictions += 1
+            if tracker is not None and tracker.enabled:
+                fkeys = _heat.leaf_frag_keys(victim_key)
+                if fkeys:
+                    # residency-transition history: the fragment left HBM
+                    tracker.touch_many(fkeys, evictions=1)
 
     def clear(self) -> None:
         with self._lock:
@@ -122,7 +182,9 @@ class DeviceResidency:
                 k["bytes"] += arr.nbytes
             return {"entries": len(self._lru), "bytes": self.bytes,
                     "hits": self.hits, "misses": self.misses,
-                    "evictions": self.evictions, "by_kind": by_kind}
+                    "evictions": self.evictions,
+                    "heatEvictions": self.heat_evictions,
+                    "eviction": self.eviction, "by_kind": by_kind}
 
 
 class PlanCache:

@@ -111,7 +111,8 @@ class Server:
                  gossip_secret: str = "",
                  hint_max_bytes: int = 64 << 20,
                  hint_max_age: float = 3600.0,
-                 drain_timeout: float = 30.0):
+                 drain_timeout: float = 30.0,
+                 eviction: str = "lru"):
         self.data_dir = data_dir
         # [storage] wal-fsync, plumbed down the model tree to every
         # Fragment (PILOSA_TPU_WAL_FSYNC env overrides per fragment —
@@ -124,6 +125,10 @@ class Server:
             # a typo'd mode must fail the boot, not silently act as "on"
             raise ValueError(
                 f"invalid [query] plan {plan!r} (expected on | off)")
+        if eviction not in ("lru", "heat"):
+            raise ValueError(
+                f"invalid [storage] eviction {eviction!r} "
+                "(expected lru | heat)")
         self.wal_fsync = wal_fsync
         self.holder = Holder(data_dir, wal_fsync=(wal_fsync == "always"))
         self.node_id = node_id or self._load_or_create_id()
@@ -188,6 +193,12 @@ class Server:
             self.executor.coalescer.admission_s = fanout_coalesce_window
             self.executor.coalescer.max_batch = max(
                 1, fanout_coalesce_max_batch)
+        # [storage] eviction = lru|heat: heat steers DeviceResidency to
+        # evict coldest-by-fragment-heat instead of LRU (utils/heat.py).
+        # The PILOSA_TPU_HEAT=0 kill switch wins structurally: with it
+        # set the Executor built no tracker and the residency manager
+        # falls back to lru regardless of this knob.
+        self.executor.residency.eviction = eviction
         # durable hinted handoff (storage/hints.py): replica writes
         # skipped because the target is down/draining append here and
         # replay in order when the target returns ([cluster]
@@ -307,6 +318,7 @@ class Server:
         self.api.node_stats_fn = self.node_stats
         self.api.cluster_stats_fn = self.cluster_stats
         self.api.cluster_usage_fn = self.cluster_usage
+        self.api.cluster_heat_fn = self.cluster_heat
         # multi-tenant QoS plane (pilosa_tpu/qos.py): per-principal quota
         # buckets refilled against the usage ledger, priority classes the
         # batchers/pools order by, deadline-aware admission + shedding.
@@ -1951,6 +1963,16 @@ class Server:
             raw["planner.reorders"] = ps["reorders"]
             raw["planner.pushdowns"] = ps["pushdowns"]
             raw["planner.short_circuits"] = ps["shortCircuits"]
+        # fragment heat map: tick the tracker's summary ring (the
+        # /debug/heat since-cursor feed rides the sampler's clock) and
+        # publish the aggregate temperature gauges the dashboard's
+        # skew sparkline reads
+        tracker = getattr(ex, "heat", None)
+        if tracker is not None:
+            hsum = tracker.sample_tick()
+            g["heat.hot_fragments"] = float(hsum["hotFragments"])
+            g["heat.skew"] = float(hsum["skew"])
+            g["heat.tracker_entries"] = float(hsum["trackerEntries"])
         # per-principal usage ledger: tick its delta ring (the
         # /debug/usage since-cursor feed rides the sampler's clock) and
         # sample fleet-level gauges; SLO burn rates per objective
@@ -2363,6 +2385,70 @@ class Server:
             "generatedBy": self.node_id,
             "asOf": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
         }
+
+    def cluster_heat(self) -> dict:
+        """The fleet's merged fragment heat map (GET /cluster/heat):
+        every live peer's /debug/heat document collected concurrently
+        and merged per fragment coordinate (utils/heat.py
+        merge_heat_docs — replica heat SUMS: two nodes serving a
+        fragment's reads make it twice as hot fleet-wide, the signal
+        rebalancing ranks by). Same degradation contract as
+        cluster_stats/cluster_usage: peers that 404 the route are
+        "legacy" (never an error), down peers are skipped without an
+        RPC, transient failures leave the merge partial-but-honest.
+        Per-node skew/health summaries ride along — the placement
+        advisor's node-level input."""
+        from pilosa_tpu.utils import heat as _heat
+
+        docs: dict[str, dict] = {}
+        nodes: list[dict] = []
+        timeout = max(2.0, self.probe_timeout)
+        fetchers: list[tuple] = []
+        for n in list(self.cluster.nodes):
+            if n.id == self.node_id:
+                tracker = getattr(self.executor, "heat", None)
+                docs[n.id] = (tracker.snapshot(top=0)
+                              if tracker is not None else {})
+                nodes.append({"id": n.id, "uri": self.uri,
+                              "status": "ok"})
+                continue
+            if self.cluster.is_down(n.id) or not n.uri:
+                nodes.append({"id": n.id, "uri": n.uri or "",
+                              "status": "down"})
+                continue
+            entry = {"id": n.id, "uri": n.uri, "status": "pending"}
+            nodes.append(entry)
+
+            def fetch(node=n, entry=entry):
+                try:
+                    docs[node.id] = self.client.debug_heat(node.uri,
+                                                           timeout)
+                    entry["status"] = "ok"
+                except ClientError as e:
+                    entry["status"] = ("legacy" if e.status == 404
+                                       else "error")
+                except Exception:  # noqa: BLE001 — never fail the merge
+                    entry["status"] = "error"
+
+            fetchers.append((entry, _threads.spawn(fetch)))
+        for entry, t in fetchers:
+            t.join(timeout + 1.0)
+            if entry["status"] == "pending":
+                entry["status"] = "error"
+        out = _heat.merge_heat_docs(docs)
+        for entry in nodes:
+            doc = docs.get(entry["id"])
+            if doc:
+                # node-level temperature summary: the advisor's
+                # per-node hot-shard skew vs health input
+                entry["skew"] = doc.get("skew", 1.0)
+                entry["hotFragments"] = doc.get("hotFragments", 0)
+                entry["trackedFragments"] = doc.get(
+                    "trackedFragments", 0)
+        out["nodes"] = nodes
+        out["generatedBy"] = self.node_id
+        out["asOf"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+        return out
 
     # -- anti-entropy scrubber (server.go:430-483; fragment.go:2170) --------
 

@@ -402,6 +402,55 @@ def test_live_metrics_handoff_drain_and_fence_families(pair):
         if n == "pilosa_qos_total"}
 
 
+def test_live_metrics_heat_families(pair):
+    """Heat PR satellite: the fragment-temperature families — aggregate
+    heat counters (reads/writes/deviceMs/h2dBytes/uploads/evictions),
+    the tracked/spilled/hot/skew gauges, the score-distribution snapshot
+    (cumulative le labels, bounded regardless of fragment count), and
+    the residency heat-eviction counter — are scrapeable, emitted
+    unconditionally while a tracker exists (zeros included) so a
+    "fleet went cold" alert never races the first access, and conform
+    like everything else. Per-fragment cardinality deliberately stays
+    behind /debug/heat: the scrape's label space is bounded."""
+    from pilosa_tpu.utils.heat import DISTRIBUTION_BOUNDS
+    servers, uris = pair
+    # the fixture's queries already heated fragments on this node
+    with urllib.request.urlopen(uris[0] + "/metrics", timeout=10) as r:
+        text = r.read().decode()
+    types, samples = check_conformance(text)
+    assert types["pilosa_heat_total"] == "counter"
+    hkeys = {l.get("key") for n, l, _ in samples
+             if n == "pilosa_heat_total"}
+    assert {"reads", "writes", "deviceMs", "h2dBytes", "uploads",
+            "evictions"} <= hkeys
+    reads = next(v for n, l, v in samples
+                 if n == "pilosa_heat_total" and l.get("key") == "reads")
+    assert reads >= 1  # real traffic heated real fragments
+    assert types["pilosa_heat"] == "gauge"
+    gkeys = {l.get("key") for n, l, _ in samples if n == "pilosa_heat"}
+    assert {"trackedFragments", "spilledFragments", "hotFragments",
+            "skew"} <= gkeys
+    tracked = next(v for n, l, v in samples
+                   if n == "pilosa_heat"
+                   and l.get("key") == "trackedFragments")
+    assert tracked >= 1
+    # the distribution snapshot: one series per bound plus +Inf,
+    # cumulative (a histogram SNAPSHOT of decaying scores, typed gauge)
+    assert types["pilosa_heatDistribution"] == "gauge"
+    dist = sorted(
+        ((l.get("le"), v) for n, l, v in samples
+         if n == "pilosa_heatDistribution"),
+        key=lambda t: float("inf") if t[0] == "+Inf" else float(t[0]))
+    assert len(dist) == len(DISTRIBUTION_BOUNDS) + 1
+    vals = [v for _, v in dist]
+    assert vals == sorted(vals)  # cumulative
+    assert dist[-1] == ("+Inf", tracked)
+    # residency heat-eviction counter joins the residency family
+    assert "heatEvictions" in {
+        l.get("key") for n, l, _ in samples
+        if n == "pilosa_residency_total"}
+
+
 def test_stats_registry_drift_guard(pair):
     """Tier-1 drift guard: every counter/gauge/timing name registered in
     the live StatsClient reaches the /metrics exposition — so a future PR
