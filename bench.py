@@ -1918,6 +1918,179 @@ def bench_distributed(tmpdir) -> dict:
             s.close()
 
 
+ICI_NODES = int(os.environ.get("PILOSA_BENCH_ICI_NODES", "3"))
+ICI_SHARDS = int(os.environ.get("PILOSA_BENCH_ICI_SHARDS", "8"))
+ICI_QUERIES = int(os.environ.get("PILOSA_BENCH_ICI_QUERIES", "48"))
+ICI_AB_ROUNDS = int(os.environ.get("PILOSA_BENCH_ICI_AB_ROUNDS", "3"))
+
+
+def bench_ici(tmpdir) -> dict:
+    """ICI-native slice-local serving A/B (docs "ICI-native serving"): a
+    3-node replica-3 cluster — every node co-resides the full shard set —
+    serving the distributed Count and GroupBy workloads with ici-serving
+    interleaved on/off. With routing ON the coordinator answers each query
+    as ONE local sharded program (zero /internal/query-batch envelopes,
+    asserted from the netCoalesce counters); OFF is the coalesced HTTP
+    scatter-gather plane. Reported: warm p50/p99 per mode, the RTTs
+    removed per query (envelopes the off-path needed), and whether the
+    slice-local warm p50 beat the HTTP path's observed 1-RTT floor (the
+    best single off-mode sample — the bound BENCH_NOTES_r06 showed warm
+    GroupBy parked at). Single closed-loop client: per-query latency is
+    the honest RTT comparison, not a queueing artifact."""
+    import http.client
+    import urllib.request
+
+    from pilosa_tpu.server import Server
+
+    servers = [Server(os.path.join(tmpdir, f"ici{i}"), port=0,
+                      replica_n=ICI_NODES, ici_serving="on").open()
+               for i in range(ICI_NODES)]
+    try:
+        uris = [s.uri for s in servers]
+        for s in servers:
+            s.cluster_hosts = uris
+            s.refresh_membership()
+
+        def post(uri, path, body):
+            req = urllib.request.Request(uri + path, data=body,
+                                         method="POST")
+            with urllib.request.urlopen(req, timeout=60) as r:
+                return json.loads(r.read())
+
+        post(uris[0], "/index/ici", b"{}")
+        post(uris[0], "/index/ici/field/f", b"{}")
+        post(uris[0], "/index/ici/field/g", b"{}")
+        rng = np.random.default_rng(31)
+        n_per = int(SHARD_WIDTH * 0.005)
+        sets = {}
+        row_ids, col_ids = [], []
+        g_rows, g_cols = [], []
+        for shard in range(ICI_SHARDS):
+            for row in (0, 1):
+                cols = (rng.choice(SHARD_WIDTH, size=n_per, replace=False)
+                        .astype(np.int64) + shard * SHARD_WIDTH)
+                sets[(row, shard)] = cols
+                row_ids += [row] * n_per
+                col_ids += cols.tolist()
+            for row in range(4):
+                cols = (rng.choice(SHARD_WIDTH, size=n_per // 2,
+                                   replace=False)
+                        .astype(np.int64) + shard * SHARD_WIDTH)
+                g_rows += [row] * len(cols)
+                g_cols += cols.tolist()
+        post(uris[0], "/index/ici/field/f/import", json.dumps({
+            "rowIDs": row_ids, "columnIDs": col_ids}).encode())
+        post(uris[0], "/index/ici/field/g/import", json.dumps({
+            "rowIDs": g_rows, "columnIDs": g_cols}).encode())
+
+        count_q = b"Count(Intersect(Row(f=0), Row(f=1)))"
+        groupby_q = b"GroupBy(Rows(field=g))"
+        expect = sum(np.intersect1d(sets[(0, s)], sets[(1, s)]).size
+                     for s in range(ICI_SHARDS))
+        out = post(uris[0], "/index/ici/query", count_q)
+        assert out["results"][0] == expect, (out, expect)
+
+        ex = servers[0].executor
+        coal = ex.coalescer
+        host = uris[0].split("//", 1)[1]
+
+        def lat_series(q: bytes, n: int) -> list:
+            """Per-query wall seconds over one keep-alive connection."""
+            conn = http.client.HTTPConnection(host, timeout=60)
+            lats = []
+            try:
+                for _ in range(n):
+                    t0 = time.perf_counter()
+                    conn.request("POST", "/index/ici/query", body=q)
+                    resp = conn.getresponse()
+                    out = json.loads(resp.read())
+                    lats.append(time.perf_counter() - t0)
+                    assert "results" in out, out
+            finally:
+                conn.close()
+            return sorted(lats)
+
+        def pctl(lats: list, p: float) -> float:
+            return lats[min(len(lats) - 1, int(p * len(lats)))]
+
+        # warm both modes: compile caches, residency, coalescer routes
+        for mode in ("off", "on"):
+            ex.ici_mode = mode
+            lat_series(count_q, 4)
+            lat_series(groupby_q, 4)
+
+        rounds = []
+        floor_off = float("inf")
+        for _ in range(ICI_AB_ROUNDS):
+            rnd = {}
+            for mode in ("off", "on"):
+                ex.ici_mode = mode
+                snap0 = coal.snapshot() if coal is not None else {}
+                local0 = ex.ici_slice_local
+                for name, q in (("count", count_q), ("groupby", groupby_q)):
+                    lats = lat_series(q, ICI_QUERIES)
+                    rnd[f"{name}_p50_ms_{mode}"] = round(
+                        pctl(lats, 0.5) * 1e3, 3)
+                    rnd[f"{name}_p99_ms_{mode}"] = round(
+                        pctl(lats, 0.99) * 1e3, 3)
+                    if mode == "off":
+                        floor_off = min(floor_off, lats[0])
+                snap1 = coal.snapshot() if coal is not None else {}
+                env = (snap1.get("batches", 0) - snap0.get("batches", 0)
+                       + snap1.get("fallback_queries", 0)
+                       - snap0.get("fallback_queries", 0))
+                rnd[f"envelopes_{mode}"] = env
+                if mode == "on":
+                    rnd["slice_local"] = ex.ici_slice_local - local0
+            rnd["count_speedup"] = (
+                round(rnd["count_p50_ms_off"] / rnd["count_p50_ms_on"], 2)
+                if rnd["count_p50_ms_on"] else 0.0)
+            rnd["groupby_speedup"] = (
+                round(rnd["groupby_p50_ms_off"]
+                      / rnd["groupby_p50_ms_on"], 2)
+                if rnd["groupby_p50_ms_on"] else 0.0)
+            rounds.append(rnd)
+        ex.ici_mode = "on"
+        n_q = 2 * ICI_QUERIES  # count + groupby per mode per round
+        env_off = sum(r["envelopes_off"] for r in rounds)
+        env_on = sum(r["envelopes_on"] for r in rounds)
+        speedups = sorted(r["count_speedup"] for r in rounds)
+        g_speedups = sorted(r["groupby_speedup"] for r in rounds)
+        p50_on = sorted(r["count_p50_ms_on"] for r in rounds)[
+            len(rounds) // 2]
+        out = {
+            "metric": f"ici_slice_local_count_p50_speedup_{ICI_NODES}node",
+            "value": speedups[len(speedups) // 2],
+            "unit": "x vs http scatter-gather",
+            "rounds": rounds,
+            "median_count_speedup": speedups[len(speedups) // 2],
+            "median_groupby_speedup": g_speedups[len(g_speedups) // 2],
+            "envelopes_per_query_off": round(
+                env_off / (len(rounds) * n_q), 3),
+            "envelopes_per_query_on": round(
+                env_on / (len(rounds) * n_q), 3),
+            "rtts_removed_per_query": round(
+                (env_off - env_on) / (len(rounds) * n_q), 3),
+            "http_1rtt_floor_ms": round(floor_off * 1e3, 3),
+            "slice_local_warm_p50_ms": p50_on,
+            "slice_local_below_http_floor": bool(
+                p50_on < floor_off * 1e3),
+            "path": f"{ICI_NODES}-node replica-{ICI_NODES} cluster, every "
+                    "shard co-resident on the coordinator: ici-serving=on "
+                    "answers as ONE local sharded program (zero internal "
+                    "envelopes), off rides the coalesced HTTP plane; "
+                    "interleaved keep-alive single-client rounds",
+        }
+        if env_on != 0:
+            out["note"] = ("WARNING: slice-local rounds produced internal "
+                           "envelopes — routing did not fully engage")
+        out["vs_baseline"] = out["value"]
+        return out
+    finally:
+        for s in servers:
+            s.close()
+
+
 ROLLING_CLIENTS = int(os.environ.get("PILOSA_BENCH_ROLLING_CLIENTS", "256"))
 ROLLING_STEADY_S = float(os.environ.get("PILOSA_BENCH_ROLLING_STEADY_S",
                                         "3.0"))
@@ -2237,6 +2410,7 @@ def worker() -> None:
         stage("qos", bench_qos, tmp)
         stage("planner", bench_planner, tmp)
         stage("distributed", bench_distributed, tmp)
+        stage("ici", bench_ici, tmp)
         stage("rolling_restart", bench_rolling_restart, tmp)
     finally:
         shutil.rmtree(tmp, ignore_errors=True)

@@ -60,6 +60,13 @@ class ClusterConfig:
     fanout_coalesce_window: float = 0.002
     fanout_coalesce_max_batch: int = 64
     hedge_delay: float = 0.0
+    # ICI-native slice-local serving (docs/operations.md "ICI-native
+    # serving"): "auto" (default) serves a query as ONE sharded program
+    # over the local mesh when this node holds a live replica of every
+    # query shard; "on" routes slice-local even on a single-device runner
+    # (still removes the fan-out RTTs); "off" always scatter-gathers.
+    # PILOSA_TPU_ICI=0 is the env kill switch over any mode.
+    ici_serving: str = "auto"
     # distributed query profiler (utils/profile.py): "off" never profiles,
     # "auto" (default) profiles when a request asks (?profile=true) or
     # when long-query-time is set (so /debug/query-history carries full
@@ -374,6 +381,7 @@ class Config:
             f"fanout-coalesce-window = {self.cluster.fanout_coalesce_window}",
             f"fanout-coalesce-max-batch = {self.cluster.fanout_coalesce_max_batch}",
             f"hedge-delay = {self.cluster.hedge_delay}",
+            f'ici-serving = "{self.cluster.ici_serving}"',
             f'profile = "{self.cluster.profile}"',
             f"query-history-size = {self.cluster.query_history_size}",
             f"hint-max-bytes = {self.cluster.hint_max_bytes}",

@@ -160,6 +160,7 @@ def cmd_server(args) -> int:
         fanout_coalesce_window=cfg.cluster.fanout_coalesce_window,
         fanout_coalesce_max_batch=cfg.cluster.fanout_coalesce_max_batch,
         hedge_delay=cfg.cluster.hedge_delay,
+        ici_serving=cfg.cluster.ici_serving,
         profile_mode=cfg.cluster.profile,
         query_history_size=cfg.cluster.query_history_size,
         hint_max_bytes=cfg.cluster.hint_max_bytes,

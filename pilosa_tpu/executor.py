@@ -196,6 +196,28 @@ class Executor:
                 "PILOSA_TPU_NET_COALESCE", "1") != "0":
             from pilosa_tpu.net.coalesce import NodeCoalescer
             self.coalescer = NodeCoalescer(client)
+        # ---- ICI-native slice-local serving (ROADMAP item 1) ----
+        # When a query's full shard set is co-resident on this node's
+        # multi-chip slice (this node holds a live, un-fenced replica of
+        # every shard), the query executes as ONE sharded program over the
+        # mesh — shard_map + lax.psum on the interconnect
+        # (parallel/mesh.py eval_count_mesh/eval_row_mesh) — instead of
+        # HTTP scatter-gather. Modes: "off" never routes slice-local;
+        # "auto" (default) routes when the runner has a mesh; "on" routes
+        # whenever co-residency holds, mesh or not (a single-device node
+        # still saves the fan-out RTTs). PILOSA_TPU_ICI=0 kills it.
+        self.ici_mode = "auto"
+        self._ici_env = os.environ.get("PILOSA_TPU_ICI", "1") != "0"
+        self._ici_lock = _threading.Lock()
+        self.ici_slice_local = 0   # queries served as one sharded program
+        self.ici_cross_slice = 0   # shard set not co-resident: HTTP plane
+        self.ici_fallback = 0      # disabled / write / unroutable shape
+        # co-residency memo: (index, shard tuple) -> bool under one
+        # topology fingerprint; any membership/liveness change flushes it
+        # (the generation-keying discipline applied to cluster state)
+        self._ici_route_memo: collections.OrderedDict = \
+            collections.OrderedDict()
+        self._ici_topo_fp = None
         # cost-based query planner (pilosa_tpu/planner.py): cardinality
         # reorders, empty-branch short-circuits, Count/TopN pushdown
         # marking; PILOSA_TPU_PLANNER=0 / [query] plan=off fall back to
@@ -1864,6 +1886,111 @@ class Executor:
     WRITE_CALLS = frozenset({"Set", "Clear", "ClearRow", "Store",
                              "SetRowAttrs", "SetColumnAttrs"})
 
+    # ---------------------------------------- ICI slice-local routing
+    # Route labels (the /metrics pilosa_iciServing_total{route=} keyspace)
+    ROUTE_SLICE_LOCAL = "slice_local"
+    ROUTE_CROSS_SLICE = "cross_slice"
+    ROUTE_FALLBACK = "fallback"
+
+    def ici_enabled(self) -> bool:
+        return self._ici_env and self.ici_mode != "off"
+
+    def _ici_topo_fingerprint(self) -> tuple:
+        """Cheap cluster-state version for the co-residency memo: any
+        membership, liveness or drain change produces a new fingerprint,
+        flushing stale routing decisions (O(nodes), nodes are few)."""
+        c = self.cluster
+        return (tuple(n.id for n in c.nodes), c.replica_n,
+                frozenset(c.down_ids), frozenset(c.draining_ids))
+
+    def _ici_co_resident(self, index: Index, qshards: list[int]) -> bool:
+        """True when this node owns a replica of EVERY query shard —
+        memoized per (index, shard tuple) under one topology fingerprint."""
+        fp = self._ici_topo_fingerprint()
+        key = (index.name, tuple(qshards))
+        with self._ici_lock:
+            if fp != self._ici_topo_fp:
+                self._ici_route_memo.clear()
+                self._ici_topo_fp = fp
+            hit = self._ici_route_memo.get(key)
+            if hit is not None:
+                self._ici_route_memo.move_to_end(key)
+                return hit
+        local = self.cluster.local_id
+        ok = all(
+            any(n.id == local
+                for n in self.cluster.shard_nodes(index.name, s))
+            for s in qshards)
+        with self._ici_lock:
+            if fp == self._ici_topo_fp:
+                self._ici_route_memo[key] = ok
+                while len(self._ici_route_memo) > 512:
+                    self._ici_route_memo.popitem(last=False)
+        return ok
+
+    def _ici_route(self, index: Index, call: Call,
+                   qshards: list[int]) -> tuple[str, str]:
+        """(route, reason) for one distributed read. slice_local = the
+        whole shard set is co-resident on this node's slice: execute as
+        one sharded program, zero internal HTTP envelopes. cross_slice =
+        routable but not co-resident: the coalesced HTTP plane serves it
+        bit-identically. fallback = routing doesn't apply (disabled,
+        write, or nothing to route)."""
+        if not self.ici_enabled():
+            return self.ROUTE_FALLBACK, "disabled"
+        if self._call_has_write(call):
+            # writes fan out to every replica by design — a slice-local
+            # write would silently drop replication
+            return self.ROUTE_FALLBACK, "write"
+        if not qshards:
+            return self.ROUTE_FALLBACK, "no shards"
+        if self.ici_mode == "auto" and self.runner.mesh is None:
+            # a single-device runner is not a slice; "on" overrides (the
+            # fan-out RTTs are worth removing even without ICI)
+            return self.ROUTE_CROSS_SLICE, "no mesh"
+        if not self._ici_co_resident(index, qshards):
+            return self.ROUTE_CROSS_SLICE, "shards not co-resident"
+        if self.read_fence:
+            with self._fence_lock:
+                fenced = any((index.name, s) in self.read_fence
+                             for s in qshards)
+            if fenced:
+                # a fenced local shard may be stale: let the HTTP plane's
+                # fence re-routing serve the verified replica
+                return self.ROUTE_CROSS_SLICE, "read-fenced"
+        return self.ROUTE_SLICE_LOCAL, "co-resident"
+
+    def _record_route(self, route: str, reason: str, call: Call,
+                      n_shards: int) -> dict:
+        with self._ici_lock:
+            if route == self.ROUTE_SLICE_LOCAL:
+                self.ici_slice_local += 1
+            elif route == self.ROUTE_CROSS_SLICE:
+                self.ici_cross_slice += 1
+            else:
+                self.ici_fallback += 1
+        info = {"route": route, "reason": reason, "call": call.name,
+                "shards": n_shards}
+        prof = qprofile.current_profile.get()
+        if prof is not None:
+            prof.record_route(info)
+        return info
+
+    def ici_snapshot(self) -> dict:
+        """The iciServing observability block (/debug/vars, /metrics,
+        telemetry rings): route decision counters + the serving-mode
+        program-cache economics."""
+        from pilosa_tpu.parallel.mesh import ici_program_cache_stats
+        with self._ici_lock:
+            out = {
+                "mode": self.ici_mode if self._ici_env else "off",
+                "sliceLocal": self.ici_slice_local,
+                "crossSlice": self.ici_cross_slice,
+                "fallback": self.ici_fallback,
+            }
+        out["programCache"] = ici_program_cache_stats()
+        return out
+
     def _execute_distributed(self, index: Index, call: Call, shards):
         # Unwrap Options() BEFORE fan-out — the wrapper is not an associative
         # reduce; its shards= / excludeColumns apply around the inner call.
@@ -1880,13 +2007,34 @@ class Executor:
             return result
         if call.name in self.WRITE_CALLS:
             return self._execute_write_distributed(index, call, shards)
+        qshards = self._query_shards(index, shards)
+        from pilosa_tpu import planner as _planner
+        route, reason = self._ici_route(index, call, qshards)
+        route_info = self._record_route(route, reason, call, len(qshards))
+        route_tok = _planner.current_route.set(route_info)
+        try:
+            if route == self.ROUTE_SLICE_LOCAL:
+                # the whole shard set is co-resident on this node's
+                # slice: ONE sharded program over the mesh (shard_map +
+                # psum on ICI), zero /internal/query-batch envelopes —
+                # the paper's pjit-over-the-pod form replacing the
+                # reference's HTTP mapReduce (executor.go:2183-2321)
+                return self._execute_call(index, call, qshards)
+            return self._execute_cross_slice(index, call, shards, qshards)
+        finally:
+            _planner.current_route.reset(route_tok)
+
+    def _execute_cross_slice(self, index: Index, call: Call, shards,
+                             qshards: list[int]):
+        """The coalesced HTTP scatter-gather plane — bit-identical to the
+        slice-local path, taken when the shard set spans slices (or ICI
+        serving is off)."""
         fan_call = call
         if call.name == "GroupBy" and call.uint_arg("limit") is not None:
             # per-node truncation breaks the merge; limit applies post-reduce
             fan_call = Call(call.name,
                             {k: v for k, v in call.args.items() if k != "limit"},
                             call.children)
-        qshards = self._query_shards(index, shards)
         groups = self._fanout_groups(index, qshards)
         if len(groups) <= 1:
             partials = []

@@ -129,6 +129,8 @@ const LOCAL_SERIES = [
   ["heat.skew", "fragment heat skew (hottest / mean)", fmtNum],
   ["heat.hot_fragments", "hot fragments", fmtNum],
   ["planner.reorders_per_s", "planner reorders / s", fmtNum],
+  ["ici.slice_local_share", "ICI slice-local share (window)", fmtRatio],
+  ["ici.slice_local_per_s", "ICI slice-local / s", fmtNum],
   ["usage.queries_per_s", "accounted queries / s", fmtNum],
   ["qos.admitted_per_s", "QoS admitted / s", fmtNum],
   ["qos.shed_per_s", "QoS shed / s", fmtNum],

@@ -619,6 +619,11 @@ class Handler:
                 "hedgesWon": getattr(ex, "hedges_won", 0),
                 "hedgesCancelled": getattr(ex, "hedges_cancelled", 0),
             }
+            # ICI slice-local serving (executor._ici_route): route
+            # decision counters + the shard_map serving-mode program
+            # cache — the dashboard's slice-local-share sparkline source
+            if hasattr(ex, "ici_snapshot"):
+                snap["iciServing"] = ex.ici_snapshot()
             # durable hinted handoff (storage/hints.py): queued/replayed/
             # dropped totals + per-target pending bytes — the previously
             # silent skipped-replica writes, now an operator surface
@@ -858,6 +863,22 @@ class Handler:
             counts["hedges/fired"] = getattr(ex, "hedges_fired", 0)
             counts["hedges/won"] = getattr(ex, "hedges_won", 0)
             counts["hedges/cancelled"] = getattr(ex, "hedges_cancelled", 0)
+            # ICI slice-local routing: the full route keyspace emitted
+            # unconditionally (zeros included) like the planner families,
+            # so a "slice-local share collapsed" alert never races the
+            # first routed query for the family to exist
+            if hasattr(ex, "ici_snapshot"):
+                isnap = ex.ici_snapshot()
+                counts["iciServing,route:slice_local"] = isnap["sliceLocal"]
+                counts["iciServing,route:cross_slice"] = isnap["crossSlice"]
+                counts["iciServing,route:fallback"] = isnap["fallback"]
+                ipc = isnap["programCache"]
+                counts["iciProgramCache/hits"] = ipc["hits"]
+                counts["iciProgramCache/misses"] = ipc["misses"]
+                gauges["iciProgramCache/programs"] = ipc["programs"]
+                gauges["iciServing/mode"] = {
+                    "off": 0.0, "auto": 1.0, "on": 2.0}.get(
+                        isnap["mode"], 1.0)
             # query planner + plan cache: emitted unconditionally (zeros
             # included) so scrapers can alert on "planner stopped
             # reordering" / "cache hit rate collapsed" without a
@@ -1172,6 +1193,13 @@ class Handler:
 
 class _RequestHandler(BaseHTTPRequestHandler):
     protocol_version = "HTTP/1.1"
+    # TCP_NODELAY, like the Go reference's net/http listener: _handle
+    # writes every response in two segments (header block, then payload),
+    # and with Nagle on, the payload write stalls behind the client's
+    # delayed ACK of the header segment on keep-alive connections — a
+    # ~40ms floor per request (measured on loopback) that dwarfs every
+    # network RTT the coalescer/ICI layers exist to remove.
+    disable_nagle_algorithm = True
     handler: Handler = None  # injected by server factory
 
     def _handle(self, method: str):

@@ -18,6 +18,7 @@ from __future__ import annotations
 import functools
 import os
 import re
+import threading
 from typing import Optional, Sequence
 
 import jax
@@ -96,22 +97,31 @@ def make_multislice_mesh(devices: Optional[Sequence] = None) -> Mesh:
         warnings.warn(
             f"multislice mesh: uneven slices truncated to {per} devices "
             f"each; {dropped} of {len(devices)} devices left idle")
-    try:
-        from jax.experimental import mesh_utils
-
-        arr = mesh_utils.create_hybrid_device_mesh(
-            mesh_shape=(1, per), dcn_mesh_shape=(len(slices), 1),
-            devices=[d for s in slices for d in s[:per]])
-    except Exception as e:  # noqa: BLE001 — CPU/virtual backends raise
-        # various errors for missing slice topology; on real multi-slice
-        # hardware a failure here degrades ICI ordering, so say so
-        import warnings
-
-        warnings.warn(
-            "multislice mesh: create_hybrid_device_mesh failed "
-            f"({type(e).__name__}: {e}); using slice-bucketed device order "
-            "(collectives may not follow the physical ICI topology)")
+    if not all(hasattr(d, "slice_index") for d in devices):
+        # CPU/virtual devices carry no slice topology, so the hybrid-mesh
+        # builder is GUARANTEED to fail ("... does not have attribute
+        # slice_index") — multiple buckets here only ever mean a
+        # substituted bucketer (dryrun/tests). Skip the doomed attempt
+        # instead of warning on every mesh build; the warning below stays
+        # reserved for real hardware whose topology query fails.
         arr = np.array([s[:per] for s in slices])
+    else:
+        try:
+            from jax.experimental import mesh_utils
+
+            arr = mesh_utils.create_hybrid_device_mesh(
+                mesh_shape=(1, per), dcn_mesh_shape=(len(slices), 1),
+                devices=[d for s in slices for d in s[:per]])
+        except Exception as e:  # noqa: BLE001 — on real multi-slice
+            # hardware a failure here degrades ICI ordering, so say so
+            import warnings
+
+            warnings.warn(
+                "multislice mesh: create_hybrid_device_mesh failed "
+                f"({type(e).__name__}: {e}); using slice-bucketed device "
+                "order (collectives may not follow the physical ICI "
+                "topology)")
+            arr = np.array([s[:per] for s in slices])
     return Mesh(np.asarray(arr).reshape(len(slices), per),
                 (REPLICA_AXIS, SHARD_AXIS))
 
@@ -217,6 +227,101 @@ def eval_count_total(leaves: jax.Array, program) -> jax.Array:
     """[L, S, W] -> scalar total count. Under a sharded input GSPMD lowers the
     sum to an ICI all-reduce — the Count() reduce (executor.go:1521,2209)."""
     return jnp.sum(popcount(_eval(leaves, program)))
+
+
+# -- ICI-native serving program cache ----------------------------------------
+# The general serving-mode forms of the per-query kernels: the pair-stream
+# and GroupBy kernels above proved the shard_map + lax.psum shape (per-device
+# partials over the local shard slice, ONE collective on the interconnect);
+# these extend that exact shape to arbitrary bitmap programs so the executor
+# can serve any co-resident shard group as a single sharded program instead
+# of HTTP scatter-gather (executor._ici_route). Programs are static and
+# hashable, so the cache holds one compiled callable per
+# (kind, mesh, program, n_leaves) — the per-mesh discipline of
+# _pair_stream_fn, with hit/miss counters surfaced at /debug/vars
+# `iciServing.programCache` (a cold cache on a hot path is the recompile
+# storm the telemetry exists to catch).
+
+_ici_programs: dict = {}
+_ici_lock = threading.Lock()
+_ici_stats = {"hits": 0, "misses": 0}
+
+
+def ici_program_cache_stats() -> dict:
+    with _ici_lock:
+        return {"hits": _ici_stats["hits"], "misses": _ici_stats["misses"],
+                "programs": len(_ici_programs)}
+
+
+def _ici_cached(key, build):
+    with _ici_lock:
+        fn = _ici_programs.get(key)
+        if fn is not None:
+            _ici_stats["hits"] += 1
+            return fn
+    fn = build()  # trace/compile happens at first call, outside the lock
+    with _ici_lock:
+        _ici_stats["misses"] += 1
+        return _ici_programs.setdefault(key, fn)
+
+
+def _build_count_mesh(mesh: Mesh, program, n_leaves: int):
+    from jax.experimental.shard_map import shard_map
+
+    spec = P(SHARD_AXIS, None)
+
+    @jax.jit
+    @functools.partial(
+        shard_map, mesh=mesh,
+        in_specs=(tuple(spec for _ in range(n_leaves)),),
+        out_specs=P(), check_rep=False)
+    def run(leaves):
+        # per-device partial over the local shard slice, one ICI
+        # all-reduce — the explicit form of eval_count_total's GSPMD
+        # lowering (executor.go:1521,2209's channel reduce)
+        local = jnp.sum(popcount(_eval(leaves, program)))
+        return jax.lax.psum(local, SHARD_AXIS)
+
+    return run
+
+
+def _build_row_mesh(mesh: Mesh, program, n_leaves: int):
+    from jax.experimental.shard_map import shard_map
+
+    spec = P(SHARD_AXIS, None)
+
+    @jax.jit
+    @functools.partial(
+        shard_map, mesh=mesh,
+        in_specs=(tuple(spec for _ in range(n_leaves)),),
+        out_specs=spec, check_rep=False)
+    def run(leaves):
+        # purely elementwise: zero collectives, the result stays sharded
+        # in HBM for further composition (BSI filters, TopN sources,
+        # GroupBy filter folds — the "Row composition" serving form)
+        return _eval(leaves, program)
+
+    return run
+
+
+def eval_count_mesh(mesh: Mesh, leaves: tuple, program) -> jax.Array:
+    """[L x [S', W]] -> scalar total count as ONE sharded program with an
+    explicit psum over the shard axis (ICI)."""
+    fn = _ici_cached(("count", mesh, program, len(leaves)),
+                     lambda: _build_count_mesh(mesh, program, len(leaves)))
+    record_dispatch("ici_program", mesh, "count", program, len(leaves))
+    return fn(tuple(leaves))
+
+
+def eval_row_mesh(mesh: Mesh, leaves: tuple, program) -> jax.Array:
+    """[L x [S', W]] -> [S', W] dense result, sharded across the slice
+    (never per-device-replicated: each device holds only its shard
+    slots' words, exactly like the resident leaves it was computed
+    from)."""
+    fn = _ici_cached(("row", mesh, program, len(leaves)),
+                     lambda: _build_row_mesh(mesh, program, len(leaves)))
+    record_dispatch("ici_program", mesh, "row", program, len(leaves))
+    return fn(tuple(leaves))
 
 
 @counted_jit("stream")
@@ -381,7 +486,8 @@ class DeviceRunner:
     """
 
     def __init__(self, mesh: Optional[Mesh] = None,
-                 use_pallas: Optional[bool] = None):
+                 use_pallas: Optional[bool] = None,
+                 ici_serving: Optional[bool] = None):
         self.mesh = mesh
         if use_pallas is None:
             use_pallas = os.environ.get("PILOSA_TPU_PALLAS", "").lower() in (
@@ -390,6 +496,15 @@ class DeviceRunner:
         # blocks over its local shards, partials psum on ICI — see
         # pallas_kernels.program_count_mesh)
         self.use_pallas = bool(use_pallas)
+        # ICI-native serving kernels: general bitmap programs run as
+        # explicit shard_map + psum programs from the per-mesh program
+        # cache (eval_count_mesh / eval_row_mesh) instead of relying on
+        # GSPMD's lowering of the jit forms. Only meaningful with a mesh;
+        # PILOSA_TPU_ICI=0 is the kill switch ([cluster] ici-serving=off
+        # reaches here through the Server wiring).
+        if ici_serving is None:
+            ici_serving = os.environ.get("PILOSA_TPU_ICI", "1") != "0"
+        self.ici_serving = bool(ici_serving) and mesh is not None
 
     @property
     def n_devices(self) -> int:
@@ -445,12 +560,16 @@ class DeviceRunner:
     # cached leaves stay in HBM and only the compiled program runs per query.
 
     def row_leaves(self, leaves: list, program, n_shards: int) -> np.ndarray:
-        out = np.asarray(eval_row(tuple(leaves), program))
+        out = np.asarray(self.row_leaves_dev(leaves, program))
         return out[:n_shards]
 
     def row_leaves_dev(self, leaves: list, program) -> jax.Array:
         """Dense result as a device array [S(padded), W] — stays in HBM for
-        further device-side composition (BSI filters, TopN sources)."""
+        further device-side composition (BSI filters, TopN sources). In
+        ICI serving mode the program runs as an explicit shard_map and the
+        result lands SHARDED across the slice, like its input leaves."""
+        if self.mesh is not None and self.ici_serving:
+            return eval_row_mesh(self.mesh, tuple(leaves), program)
         return eval_row(tuple(leaves), program)
 
     def count_total_leaves(self, leaves: list, program) -> int:
@@ -472,6 +591,10 @@ class DeviceRunner:
                 return int(program_count_mesh(self.mesh, tuple(leaves),
                                               program))
             return int(jnp.sum(program_count(tuple(leaves), program)))
+        if self.mesh is not None and self.ici_serving:
+            # explicit shard_map + psum serving form: per-device partial
+            # counts over the local shard slice, one ICI all-reduce
+            return int(eval_count_mesh(self.mesh, tuple(leaves), program))
         return int(eval_count_total(tuple(leaves), program))
 
     # -- GroupBy cross-count dispatch (XLA / Pallas / mesh routing) --------

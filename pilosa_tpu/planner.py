@@ -68,6 +68,14 @@ from pilosa_tpu.utils.profile import truncate_pql
 current_plan: contextvars.ContextVar[Optional[dict]] = \
     contextvars.ContextVar("pilosa_current_plan", default=None)
 
+# the ICI routing decision of the distributed call currently executing
+# (executor._execute_distributed sets it around BOTH branches): plan_call
+# copies it into the plan node, so ?profile=true and /debug/query-history
+# show slice_local vs cross_slice alongside the operand order — and the
+# fan-out pool's copied contexts propagate it to per-node planning.
+current_route: contextvars.ContextVar[Optional[dict]] = \
+    contextvars.ContextVar("pilosa_current_route", default=None)
+
 BITMAP_CALLS = {"Row", "Union", "Intersect", "Difference", "Xor", "Not",
                 "Range"}
 COMMUTATIVE = ("Intersect", "Union", "Xor")
@@ -145,6 +153,14 @@ class QueryPlanner:
         info = {"call": call.name, "reorders": 0, "shortCircuits": 0,
                 "pushdown": False, "order": None, "estimates": [],
                 "cache": [], "hostRowBitmapBytes": 0}
+        route = current_route.get()
+        if route is not None:
+            # the ICI slice-local-vs-cross-slice decision rides the plan
+            # node (the `route` entry on ?profile=true); the evaluated
+            # subexpressions themselves stay cached under the existing
+            # generation-keyed plan-cache keys regardless of route, so a
+            # query flipping between routes reuses one cache
+            info["route"] = dict(route)
         if not self.enabled:
             return call, info
         from pilosa_tpu.executor import ExecutionError

@@ -75,6 +75,7 @@ class Server:
                  fanout_coalesce_window: float = 0.002,
                  fanout_coalesce_max_batch: int = 64,
                  hedge_delay: float = 0.0,
+                 ici_serving: str = "auto",
                  profile_mode: str = "auto",
                  query_history_size: int = 100,
                  telemetry_interval: float = 5.0,
@@ -129,6 +130,11 @@ class Server:
             raise ValueError(
                 f"invalid [storage] eviction {eviction!r} "
                 "(expected lru | heat)")
+        if ici_serving not in ("off", "auto", "on"):
+            # a typo'd mode must fail the boot, not silently act as "auto"
+            raise ValueError(
+                f"invalid [cluster] ici-serving {ici_serving!r} "
+                "(expected off | auto | on)")
         self.wal_fsync = wal_fsync
         self.holder = Holder(data_dir, wal_fsync=(wal_fsync == "always"))
         self.node_id = node_id or self._load_or_create_id()
@@ -179,6 +185,15 @@ class Server:
         # envelope cap, hedged-read delay (0 disables hedging)
         self.executor.fanout_pool_size = fanout_pool_size
         self.executor.hedge_delay = hedge_delay
+        # [cluster] ici-serving: slice-local routing mode (docs
+        # "ICI-native serving"). The PILOSA_TPU_ICI=0 env kill switch
+        # (read at Executor/DeviceRunner construction) wins over config —
+        # the emergency toggle needs no rollout. ici-serving=off also
+        # keeps the runner on the GSPMD jit kernels (no shard_map
+        # serving-mode programs), so off truly is the pre-ICI engine.
+        self.executor.ici_mode = ici_serving
+        if ici_serving == "off":
+            self.runner.ici_serving = False
         # [query] planner + plan-cache knobs (docs/operations.md "Query
         # planning"). The env kill switches (PILOSA_TPU_PLANNER=0 /
         # PILOSA_TPU_PLAN_CACHE=0, read at Executor construction) win over
@@ -314,6 +329,7 @@ class Server:
         self._telemetry_prev: tuple = (None, 0.0)
         self._last_hit_rate = 1.0  # carried through zero-lookup windows
         self._last_plan_hit_rate = 0.0  # plan cache starts cold
+        self._last_ici_share = 0.0  # slice-local share of routed reads
         self.api.health_fn = self.node_health
         self.api.node_stats_fn = self.node_stats
         self.api.cluster_stats_fn = self.cluster_stats
@@ -2028,6 +2044,15 @@ class Server:
             else 1.0
         raw["hedges.fired"] = getattr(ex, "hedges_fired", 0)
         raw["hedges.won"] = getattr(ex, "hedges_won", 0)
+        # ICI slice-local serving: route decision rates + the windowed
+        # slice-local share (the dashboard's sparkline of how much of the
+        # distributed read mix is escaping the HTTP plane)
+        isnap = ex.ici_snapshot()
+        raw["ici.slice_local"] = isnap["sliceLocal"]
+        raw["ici.cross_slice"] = isnap["crossSlice"]
+        raw["ici.fallback"] = isnap["fallback"]
+        raw["ici.routed"] = (isnap["sliceLocal"] + isnap["crossSlice"]
+                             + isnap["fallback"])
         # hinted handoff + drain lifecycle + rejoin read fence
         hsnap = self.hints.snapshot()
         g["hints.pending_bytes"] = float(hsnap["pendingBytes"])
@@ -2123,6 +2148,15 @@ class Server:
         g["hints.dropped_per_s"] = rate("hints.dropped")
         g["drain.shed_per_s"] = rate("drain.shed")
         g["hedges.fired_per_s"] = rate("hedges.fired")
+        g["ici.slice_local_per_s"] = rate("ici.slice_local")
+        g["ici.cross_slice_per_s"] = rate("ici.cross_slice")
+        if prev is not None:
+            drouted = raw["ici.routed"] - prev.get("ici.routed", 0)
+            dlocal = raw["ici.slice_local"] - prev.get(
+                "ici.slice_local", 0)
+            if drouted > 0:
+                self._last_ici_share = max(0.0, dlocal) / drouted
+        g["ici.slice_local_share"] = self._last_ici_share
         g["http.errors_per_s"] = rate("http.errors")
         g["xla.compiles_per_s"] = rate("xla.compiles")
         g["usage.queries_per_s"] = rate("usage.queries")

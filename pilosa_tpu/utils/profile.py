@@ -66,7 +66,7 @@ class QueryProfile:
     __slots__ = ("trace_id", "node_id", "index", "pql", "start",
                  "start_wall", "elapsed_ms", "calls", "fanout", "dispatches",
                  "residency_hits", "residency_misses", "h2d_bytes",
-                 "remotes", "plans", "qos", "_lock", "_sealed",
+                 "remotes", "plans", "routes", "qos", "_lock", "_sealed",
                  "_cached_dict")
 
     def __init__(self, trace_id: str = "", node_id: str = "",
@@ -90,6 +90,7 @@ class QueryProfile:
         self.h2d_bytes = 0                 # host->device upload bytes
         self.remotes: list[dict] = []      # [{node, profile}] child trees
         self.plans: list[dict] = []        # planner decisions per call
+        self.routes: list[dict] = []       # ICI routing decisions per call
         # QoS admission context (pilosa_tpu/qos.py): priority class,
         # deadline budget and the admission-time wait estimate — set once
         # by api.query_results when a plane is wired, None otherwise
@@ -164,6 +165,16 @@ class QueryProfile:
                 return
             self.plans.append(plan)
 
+    def record_route(self, info: dict) -> None:
+        """One ICI routing decision (executor._ici_route): slice_local =
+        served as a single sharded program over the local slice (zero
+        internal HTTP envelopes), cross_slice = coalesced HTTP
+        scatter-gather, fallback = routing didn't apply."""
+        with self._lock:
+            if self._sealed:
+                return
+            self.routes.append(dict(info))
+
     def record_residency(self, hit: bool, nbytes: int = 0) -> None:
         with self._lock:
             if self._sealed:
@@ -212,6 +223,7 @@ class QueryProfile:
                               "misses": self.residency_misses,
                               "hostToDeviceBytes": self.h2d_bytes},
                 "plan": [dict(p) for p in self.plans],
+                "route": [dict(r) for r in self.routes],
                 "remoteProfiles": list(self.remotes),
             }
             if self.qos is not None:

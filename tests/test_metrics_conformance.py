@@ -284,6 +284,39 @@ def test_live_metrics_planner_and_plan_cache_series(pair):
     assert {"bytes", "entries"} <= gkeys
 
 
+def test_live_metrics_ici_families(pair):
+    """ICI serving PR satellite: the slice-local routing decision
+    counters and the serving-mode program-cache economics are scrapeable
+    — the full route keyspace emitted unconditionally (zeros included)
+    so a "slice-local share collapsed" alert never races the first
+    routed query — and conform like everything else."""
+    servers, uris = pair
+    # the fixture's distributed Counts were routed (no mesh on these
+    # nodes, so auto sends them down the cross_slice/HTTP plane)
+    with urllib.request.urlopen(uris[0] + "/metrics", timeout=10) as r:
+        text = r.read().decode()
+    types, samples = check_conformance(text)
+    assert types["pilosa_iciServing_total"] == "counter"
+    routes = {l.get("route") for n, l, _ in samples
+              if n == "pilosa_iciServing_total"}
+    assert {"slice_local", "cross_slice", "fallback"} <= routes
+    crossed = next(v for n, l, v in samples
+                   if n == "pilosa_iciServing_total"
+                   and l.get("route") == "cross_slice")
+    assert crossed >= 1  # real distributed traffic was routed
+    assert types["pilosa_iciProgramCache_total"] == "counter"
+    ckeys = {l.get("key") for n, l, _ in samples
+             if n == "pilosa_iciProgramCache_total"}
+    assert {"hits", "misses"} <= ckeys
+    gkeys = {l.get("key") for n, l, _ in samples
+             if n == "pilosa_iciProgramCache"}
+    assert "programs" in gkeys
+    # mode gauge: 0 off / 1 auto / 2 on — these servers run the default
+    mode = next(v for n, l, v in samples
+                if n == "pilosa_iciServing" and l.get("key") == "mode")
+    assert mode == 1.0
+
+
 def test_live_metrics_usage_and_slo_families(pair):
     """Accounting PR satellite: the per-principal usage counters and the
     SLO burn-rate gauges are scrapeable — emitted unconditionally (zeros
