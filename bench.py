@@ -1093,6 +1093,144 @@ def bench_accounting(tmpdir) -> dict:
         srv.close()
 
 
+EVENTS_CLIENTS = int(os.environ.get("PILOSA_BENCH_EVENTS_CLIENTS", "256"))
+EVENTS_QPC = int(os.environ.get("PILOSA_BENCH_EVENTS_QPC", "4"))
+EVENTS_ROUNDS = int(os.environ.get("PILOSA_BENCH_EVENTS_ROUNDS", "3"))
+
+
+def bench_events(tmpdir) -> dict:
+    """Flight-recorder overhead A/B (budget: <= 1%): one server,
+    EVENTS_CLIENTS keep-alive clients of warm Counts, interleaved
+    PILOSA_TPU_EVENTS=0/1 rounds (the documented kill switch, read per
+    emit). The off side still stamps the HLC response header — a mixed
+    on/off cluster must stay causally ordered — so the measured delta is
+    the recording path itself: the enabled() checks at every choke
+    point, context auto-attach, and journal appends for whatever state
+    transitions the workload trips."""
+    import http.client
+    import statistics
+    import threading
+
+    from pilosa_tpu.server import Server
+
+    srv = Server(os.path.join(tmpdir, "events"), port=0).open()
+    prev_env = os.environ.get("PILOSA_TPU_EVENTS")
+    try:
+        hostport = srv.uri.split("//", 1)[1]
+        _local = threading.local()
+
+        def post(path, body):
+            conn = getattr(_local, "conn", None)
+            if conn is None:
+                conn = _local.conn = http.client.HTTPConnection(
+                    hostport, timeout=60)
+            try:
+                conn.request("POST", path, body=body)
+                resp = conn.getresponse()
+                out = resp.read()
+            except (http.client.HTTPException, OSError):
+                conn.close()
+                conn = _local.conn = http.client.HTTPConnection(
+                    hostport, timeout=60)
+                conn.request("POST", path, body=body)
+                resp = conn.getresponse()
+                out = resp.read()
+            if resp.status != 200:
+                raise RuntimeError(f"{path}: {resp.status}: {out[:200]}")
+            return out
+
+        post("/index/ev", b"{}")
+        post("/index/ev/field/f", b"{}")
+        rng = np.random.default_rng(37)
+        cols = rng.choice(4 * SHARD_WIDTH, size=100_000, replace=False)
+        half = len(cols) // 2
+        post("/index/ev/field/f/import", json.dumps({
+            "rowIDs": [0] * half + [1] * (len(cols) - half),
+            "columnIDs": cols.tolist()}).encode())
+        q = b"Count(Intersect(Row(f=0), Row(f=1)))"
+        for _ in range(5):
+            post("/index/ev/query", q)  # warm residency + compile
+
+        def run_round(recorder_on: bool) -> list:
+            os.environ["PILOSA_TPU_EVENTS"] = "1" if recorder_on else "0"
+            lats: list[float] = []
+            lat_lock = threading.Lock()
+            barrier = threading.Barrier(EVENTS_CLIENTS)
+
+            def client(i):
+                mine = []
+                barrier.wait()
+                for _ in range(EVENTS_QPC):
+                    t0 = time.perf_counter()
+                    post("/index/ev/query", q)
+                    mine.append((time.perf_counter() - t0) * 1e3)
+                with lat_lock:
+                    lats.extend(mine)
+
+            threads = [threading.Thread(target=client, args=(i,))
+                       for i in range(EVENTS_CLIENTS)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            return lats
+
+        rounds = []
+        all_off: list[float] = []
+        all_on: list[float] = []
+        for i in range(EVENTS_ROUNDS):
+            # alternate which side runs first: within-round warmup drift
+            # (thread spawn, connection setup, frequency scaling) is
+            # bigger than the effect measured, and a fixed order would
+            # book all of it to one side
+            if i % 2 == 0:
+                off, on = run_round(False), run_round(True)
+            else:
+                on, off = run_round(True), run_round(False)
+            all_off.extend(off)
+            all_on.extend(on)
+            rnd = {"ms_off": round(statistics.median(off), 4),
+                   "ms_on": round(statistics.median(on), 4)}
+            rnd["overhead_pct"] = round(
+                100.0 * (rnd["ms_on"] / rnd["ms_off"] - 1.0), 2) \
+                if rnd["ms_off"] else 0.0
+            rounds.append(rnd)
+        snap = srv.events.snapshot()
+        # headline = POOLED medians across every round: per-round medians
+        # at this sample count swing ±15% on a shared host while the true
+        # delta is ~0 (the hot read path contains no emit site — on/off
+        # run identical per-request code), and the interleaved pooled
+        # estimator averages the scheduler noise out
+        med_off = statistics.median(all_off)
+        med_on = statistics.median(all_on)
+        pooled = round(100.0 * (med_on / med_off - 1.0), 2) \
+            if med_off else 0.0
+        return {
+            "metric": "events_overhead_pct",
+            "value": pooled,
+            "unit": "% (flight recorder on vs PILOSA_TPU_EVENTS=0, "
+                    f"pooled median latency at {EVENTS_CLIENTS} clients; "
+                    "budget <= 1%)",
+            "rounds": rounds,
+            "pooled_ms_off": round(med_off, 4),
+            "pooled_ms_on": round(med_on, 4),
+            "samples_per_side": len(all_off),
+            "events_emitted": snap["emitted"],
+            "events_dropped_disabled": snap["droppedDisabled"],
+            "vs_baseline": 0.0,
+            "path": f"{EVENTS_CLIENTS} keep-alive clients x "
+                    f"{EVENTS_QPC} Count(Intersect) each, interleaved "
+                    "recorder off/on rounds via the env kill switch "
+                    "(HLC response stamping identical on both sides)",
+        }
+    finally:
+        if prev_env is None:
+            os.environ.pop("PILOSA_TPU_EVENTS", None)
+        else:
+            os.environ["PILOSA_TPU_EVENTS"] = prev_env
+        srv.close()
+
+
 HEAT_CLIENTS = int(os.environ.get("PILOSA_BENCH_HEAT_CLIENTS", "16"))
 HEAT_QPC = int(os.environ.get("PILOSA_BENCH_HEAT_QPC", "6"))
 HEAT_ROUNDS = int(os.environ.get("PILOSA_BENCH_HEAT_ROUNDS", "3"))
@@ -2406,6 +2544,7 @@ def worker() -> None:
         stage("profiler", bench_profiler, tmp)
         stage("telemetry", bench_telemetry, tmp)
         stage("accounting", bench_accounting, tmp)
+        stage("events", bench_events, tmp)
         stage("heat", bench_heat, tmp)
         stage("qos", bench_qos, tmp)
         stage("planner", bench_planner, tmp)
@@ -2568,6 +2707,7 @@ def _emit_from_committed(error: str) -> bool:
               f"({prov['device']}, {prov['checkpoint_captured_at']})",
               file=sys.stderr)
         print(json.dumps(result))
+        _write_bench_artifact(result)
         return True
     return False
 
@@ -2609,6 +2749,7 @@ def _emit_from_checkpoint(error: str) -> bool:
           f"{len(metrics)} stages incl. headline; emitting partial result",
           file=sys.stderr)
     print(json.dumps(result))
+    _write_bench_artifact(result)
     return True
 
 
@@ -2635,10 +2776,200 @@ def _emit_failure(error: str) -> None:
         detail["baseline_shards_measured"] = small_shards
     except Exception as e:  # pragma: no cover
         detail["baseline_error"] = f"{type(e).__name__}: {e}"
-    print(json.dumps({
+    result = {
         "metric": METRIC, "value": 0.0, "unit": "queries/s/chip",
         "vs_baseline": 0.0, "detail": detail,
-    }))
+    }
+    print(json.dumps(result))
+    _write_bench_artifact(result)
+
+
+# ---------------------------------------------------------------------------
+# Machine-readable bench artifact + regression compare
+# ---------------------------------------------------------------------------
+
+BENCH_ROUND = os.environ.get("PILOSA_BENCH_ROUND", "r08")
+ARTIFACT_PATH = os.environ.get("PILOSA_BENCH_ARTIFACT") or os.path.join(
+    os.path.dirname(os.path.abspath(__file__)),
+    f"BENCH_{BENCH_ROUND}.json")
+
+# stage acceptance criteria (metric regex -> check): the prose "budget
+# <= 1%" notes, machine-readable so the artifact can say pass/fail
+_CRITERIA = [
+    (r"^profiler_overhead_pct$",
+     lambda m: (m["value"] <= 5.0, "median overhead <= 5%")),
+    (r"^telemetry_overhead_pct$",
+     lambda m: (m["value"] <= 1.0, "median overhead <= 1%")),
+    (r"^accounting_overhead_pct$",
+     lambda m: (m["value"] <= 1.0, "median overhead <= 1%")),
+    (r"^events_overhead_pct$",
+     lambda m: (m["value"] <= 1.0, "median overhead <= 1%")),
+    (r"^heat_overhead_pct$",
+     lambda m: (m["value"] <= 1.0, "median overhead <= 1%")),
+    (r"^qos_p99_delta_pct$",
+     lambda m: (m["value"] <= 15.0, "well-behaved p99 delta <= 15%")),
+    (r"^planner_dashboard_speedup$",
+     lambda m: (m["value"] >= 1.3, "cache-on p50 speedup >= 1.3x")),
+    (r"^ici_slice_local_count_p50_speedup",
+     lambda m: (m["value"] >= 1.0, "slice-local no slower than HTTP")),
+    (r"^rolling_restart_failed_requests$",
+     lambda m: (m["value"] == 0 and not m.get("acked_write_loss"),
+                "0 failed requests and 0 lost acked writes")),
+]
+
+# headline stages for `--compare` and the regression direction of their
+# `value` ("lower" = a latency, "higher" = a rate/speedup); the warm-p50
+# regression gate applies to whichever of these both artifacts carry
+_HEADLINE_COMPARE = [
+    (r"^kernel_intersect_count_qps", "higher"),
+    (r"^executor_intersect_count_qps", "higher"),
+    (r"^topn1000_p50_ms$", "lower"),
+    (r"^groupby_\d+x\d+_p50_ms$", "lower"),
+    (r"^bsi_range_sum_p50_ms$", "lower"),
+    (r"^http_count_qps$", "higher"),
+    (r"^distributed_count_qps_16shard", "higher"),
+]
+
+COMPARE_REGRESSION_PCT = float(os.environ.get(
+    "PILOSA_BENCH_COMPARE_PCT", "15"))
+
+
+def _stage_entry(m: dict) -> dict:
+    """Normalize one stage's metric dict for the artifact: headline
+    value/unit, every cold/warm/p50/p99 latency field it reported,
+    provenance when it was back-filled from a checkpoint, criterion
+    verdict when one applies, and the raw dict for everything else."""
+    import re as _re
+
+    entry = {"value": m.get("value"), "unit": m.get("unit", "")}
+    lat = {k: v for k, v in m.items()
+           if isinstance(v, (int, float))
+           and _re.search(r"p50|p99|cold|warm", k)}
+    if lat:
+        entry["latency"] = lat
+    if m.get("error"):
+        entry["error"] = m["error"]
+    if m.get("source"):
+        entry["provenance"] = {
+            k: m[k] for k in ("source", "checkpoint_file",
+                              "checkpoint_captured_at", "device")
+            if k in m}
+    for pat, check in _CRITERIA:
+        if _re.match(pat, m.get("metric", "")):
+            try:
+                ok, text = check(m)
+            except (KeyError, TypeError):
+                ok, text = False, "criterion inputs missing"
+            entry["criterion"] = {"pass": bool(ok), "text": text}
+            break
+    entry["raw"] = m
+    return entry
+
+
+def _write_bench_artifact(result: dict) -> None:
+    """BENCH_<round>.json: the machine-readable bench trajectory record —
+    stage -> value/latency/criterion with provenance. Written by the
+    PARENT on every emit path (live, checkpoint salvage, committed
+    fallback, failure), so the trajectory is never empty again. Never
+    raises: a broken artifact write must not fail the bench run."""
+    try:
+        detail = result.get("detail") or {}
+        metrics = [m for m in (detail.get("metrics") or [])
+                   if isinstance(m, dict) and m.get("metric")]
+        stages = {m["metric"]: _stage_entry(m) for m in metrics}
+        criteria = {name: e["criterion"] for name, e in stages.items()
+                    if "criterion" in e}
+        art = {
+            "schema": "pilosa-tpu-bench/v1",
+            "round": BENCH_ROUND,
+            "generated_at": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                          time.gmtime()),
+            "headline": {k: result.get(k) for k in
+                         ("metric", "value", "unit", "vs_baseline")},
+            "provenance": {
+                "device": (detail.get("device")
+                           or result.get("device", "unknown")),
+                "source": result.get("source", "live"),
+                "live_error": detail.get("live_error")
+                or detail.get("partial_error") or detail.get("error"),
+            },
+            "criteria": {
+                "pass": all(c["pass"] for c in criteria.values()),
+                "stages": criteria,
+            },
+            "stages": stages,
+        }
+        with open(ARTIFACT_PATH, "w") as f:
+            json.dump(art, f, indent=2, sort_keys=True)
+            f.write("\n")
+        print(f"[bench] artifact: {ARTIFACT_PATH} ({len(stages)} stages, "
+              f"criteria {'PASS' if art['criteria']['pass'] else 'FAIL'})",
+              file=sys.stderr)
+    except Exception as e:  # noqa: BLE001 — artifact is best-effort
+        print(f"[bench] artifact write failed: {e}", file=sys.stderr)
+
+
+def compare_artifacts(new: dict, prior: dict,
+                      threshold_pct: float = COMPARE_REGRESSION_PCT
+                      ) -> tuple[bool, list[str]]:
+    """Regression gate between two BENCH_*.json artifacts: for every
+    headline stage present in BOTH, a warm-p50-equivalent move worse
+    than threshold_pct (latency up / rate down) is a regression.
+    Returns (regressed, report lines)."""
+    import re as _re
+
+    lines: list[str] = []
+    regressed = False
+    new_stages = new.get("stages") or {}
+    old_stages = prior.get("stages") or {}
+    for pat, direction in _HEADLINE_COMPARE:
+        for name, entry in sorted(new_stages.items()):
+            if not _re.match(pat, name):
+                continue
+            old = old_stages.get(name)
+            nv, ov = entry.get("value"), (old or {}).get("value")
+            if not old or not nv or not ov:
+                lines.append(f"  skip {name}: missing from one side")
+                continue
+            if direction == "lower":
+                delta_pct = 100.0 * (nv / ov - 1.0)
+            else:
+                delta_pct = 100.0 * (ov / nv - 1.0)
+            verdict = "ok"
+            if delta_pct > threshold_pct:
+                verdict = "REGRESSION"
+                regressed = True
+            lines.append(
+                f"  {verdict:>10} {name}: {ov} -> {nv} "
+                f"({'+' if delta_pct >= 0 else ''}{delta_pct:.1f}% "
+                f"{'slower' if direction == 'lower' else 'rate change'}"
+                f", gate {threshold_pct:.0f}%)")
+    return regressed, lines
+
+
+def _maybe_compare() -> None:
+    """`--compare <prior.json>`: gate the artifact just written against
+    a prior round's; exit 1 on any headline warm-p50 regression."""
+    if "--compare" not in sys.argv:
+        return
+    prior_path = sys.argv[sys.argv.index("--compare") + 1]
+    try:
+        with open(ARTIFACT_PATH) as f:
+            new = json.load(f)
+        with open(prior_path) as f:
+            prior = json.load(f)
+    except (OSError, ValueError) as e:
+        print(f"[bench] compare failed: {e}", file=sys.stderr)
+        sys.exit(1)
+    regressed, lines = compare_artifacts(new, prior)
+    print(f"[bench] compare vs {prior_path} "
+          f"(gate {COMPARE_REGRESSION_PCT:.0f}% on headline warm p50):",
+          file=sys.stderr)
+    for line in lines:
+        print(line, file=sys.stderr)
+    if regressed:
+        print("[bench] REGRESSION detected — failing", file=sys.stderr)
+        sys.exit(1)
 
 
 def main() -> None:
@@ -2692,6 +3023,8 @@ def main() -> None:
                 continue
             sys.stderr.write(proc.stderr[-3000:])
             print(lines[-1])
+            _write_bench_artifact(json.loads(lines[-1]))
+            _maybe_compare()
             return
         tail = (proc.stderr or proc.stdout or "").strip().splitlines()
         last_err = f"WorkerFailed(rc={proc.returncode}): " + \
@@ -2700,6 +3033,7 @@ def main() -> None:
     if not _emit_from_checkpoint(last_err) and \
             not _emit_from_committed(last_err):
         _emit_failure(last_err)
+    _maybe_compare()
 
 
 if __name__ == "__main__":

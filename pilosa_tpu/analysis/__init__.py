@@ -29,12 +29,13 @@ See docs/operations.md "Static analysis and race detection".
 
 from pilosa_tpu.analysis.lint import Finding, run_lint  # noqa: F401
 from pilosa_tpu.analysis.inventories import (  # noqa: F401
-    config_knob_findings, env_gate_findings)
+    config_knob_findings, env_gate_findings, event_type_findings)
 from pilosa_tpu.analysis.advisor import advise, render_advice  # noqa: F401
 
 
 def run_all(root: str) -> list:
     """Every static finding over the tree rooted at `root` (repo root):
-    AST lint rules + env-gate / config-knob inventory diffs."""
+    AST lint rules + env-gate / config-knob / event-type inventory
+    diffs."""
     return (run_lint(root) + env_gate_findings(root)
-            + config_knob_findings(root))
+            + config_knob_findings(root) + event_type_findings(root))

@@ -1,6 +1,7 @@
-"""Inventory diffs: env gates and config knobs vs docs/operations.md.
+"""Inventory diffs: env gates, config knobs and event types vs
+docs/operations.md.
 
-Two drift guards that complement the stats-registry guard in
+Three drift guards that complement the stats-registry guard in
 tests/test_metrics_conformance.py:
 
 * env gates — every `PILOSA_TPU_*` name referenced anywhere under
@@ -11,16 +12,27 @@ tests/test_metrics_conformance.py:
   in `Config.to_toml()` (the serialization a knob must ride to be
   wired cli→config→Server; a field missing there is a knob that cannot
   round-trip through `pilosa-tpu config`).
+* event types — every string-literal type passed to a flight-recorder
+  `journal.emit(...)` must be registered in utils/events.py EVENT_TYPES
+  (it would raise at runtime otherwise — this catches it statically),
+  and every REGISTERED type must appear in the docs/operations.md event
+  glossary, so the timeline an operator reads is fully documented. The
+  literal-only half is the `event-registry` lint rule.
 """
 
 from __future__ import annotations
 
+import ast
 import dataclasses
 import os
 import re
 from typing import Optional
 
-from pilosa_tpu.analysis.lint import Finding, iter_py_files
+from pilosa_tpu.analysis.lint import (
+    Finding,
+    _is_event_emit_call,
+    iter_py_files,
+)
 
 _ENV_TOKEN = re.compile(r"PILOSA_TPU_[A-Z0-9_]*[A-Z0-9]")
 
@@ -80,6 +92,57 @@ def config_knob_inventory() -> list[tuple[str, str]]:
         else:
             knobs.append(("", f.name.replace("_", "-")))
     return knobs
+
+
+def event_type_inventory(root: str) -> dict[str, tuple[str, int]]:
+    """{event type literal: (relpath, first emitting line)} collected
+    from every `<journal|events>.emit("<literal>", ...)` call (and the
+    `._journal_emit` forwarding shims) under pilosa_tpu/ — the
+    event-registry lint rule guarantees literals."""
+    out: dict[str, tuple[str, int]] = {}
+    for path in iter_py_files(root):
+        rel = os.path.relpath(path, root).replace(os.sep, "/")
+        try:
+            tree = ast.parse(_read(path))
+        except SyntaxError:
+            continue  # the lint pass reports this
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call)
+                    and _is_event_emit_call(node)):
+                continue
+            first = node.args[0] if node.args else None
+            if isinstance(first, ast.Constant) \
+                    and isinstance(first.value, str):
+                out.setdefault(first.value, (rel, node.lineno))
+    return out
+
+
+def event_type_findings(root: str) -> list[Finding]:
+    """The event-registry inventory diff: emitted-but-unregistered types
+    (a runtime ValueError waiting to fire) and registered-but-
+    undocumented types (a timeline the operator can't decode)."""
+    from pilosa_tpu.utils.events import EVENT_TYPES
+
+    docs = _read_docs(root)
+    if docs is None:
+        return [Finding("docs/operations.md", 0, "event-registry-docs",
+                        f"docs/operations.md not found under {root}; "
+                        "pass --root <repo root>")]
+    findings = []
+    used = event_type_inventory(root)
+    for name, (rel, lineno) in sorted(used.items()):
+        if name not in EVENT_TYPES:
+            findings.append(Finding(
+                rel, lineno, "event-registry",
+                f"event type {name!r} is emitted but not registered in "
+                "utils/events.py EVENT_TYPES (emit() will raise)"))
+    for name in sorted(EVENT_TYPES):
+        if name not in docs:
+            findings.append(Finding(
+                "pilosa_tpu/utils/events.py", 0, "event-registry-docs",
+                f"registered event type {name} is missing from the "
+                "docs/operations.md event glossary"))
+    return findings
 
 
 def config_knob_findings(root: str) -> list[Finding]:

@@ -36,6 +36,13 @@ stats-registry  a StatsClient/StatsDClient/new_stats_client construction
                 `/metrics` (the drift guard in
                 tests/test_metrics_conformance.py checks the registry
                 side; this rule closes the other half).
+event-registry  a `.emit(...)` call on a flight-recorder journal
+                (receiver named `journal`/`events`) whose event type is
+                not a string LITERAL — the typed registry
+                (utils/events.py EVENT_TYPES) can only be diffed against
+                call sites and the docs glossary when every type is
+                statically visible (the inventory half lives in
+                analysis/inventories.py event_type_findings).
 """
 
 from __future__ import annotations
@@ -62,6 +69,14 @@ _BLOCKING_CALLS = frozenset({
     "fsync", "sendto", "sendall", "recv", "recvfrom", "connect", "accept",
     "urlopen", "getresponse", "query_proto", "send_message",
 })
+
+# receiver names that identify a flight-recorder journal (the
+# `event-registry` rule's scope): `journal`, `events`, `_journal`, ...
+_JOURNALISH = re.compile(r"(^|_)(journal|events)$", re.IGNORECASE)
+# sanctioned forwarding shims: a method named `_journal_emit` (or the
+# journal's own `emit`) may pass its parameter through to `.emit`; its
+# CALLERS are held to the literal rule instead
+_EMIT_FORWARDERS = frozenset({"emit", "_journal_emit"})
 
 # `with <name>:` context expressions that are lock-ish by naming
 # convention: `lock`, `_lock`, `mu`, `mutex`, `rlock`, `cond` (a
@@ -105,6 +120,18 @@ def _dotted(node: ast.expr) -> str:
     return ""
 
 
+def _is_event_emit_call(node: ast.Call) -> bool:
+    """True for flight-recorder emit sites: `<journal|events>.emit(...)`
+    or any `._journal_emit(...)` forwarding shim call."""
+    if not isinstance(node.func, ast.Attribute):
+        return False
+    if node.func.attr == "_journal_emit":
+        return True
+    return (node.func.attr == "emit"
+            and bool(_JOURNALISH.search(_last_name(node.func.value)
+                                        or "")))
+
+
 def _is_copy_context_run(node: ast.expr) -> bool:
     """Matches `contextvars.copy_context().run` (the sanctioned explicit
     pool-submit form)."""
@@ -120,6 +147,8 @@ class _FileLinter(ast.NodeVisitor):
         self.findings: list[Finding] = []
         # names bound by `from threading import Thread/Timer`
         self.thread_aliases: set[str] = set()
+        # enclosing-function names (the event-registry forwarder exempt)
+        self._func_stack: list[str] = []
         self.is_wrapper = relpath.replace("/", os.sep).endswith(
             THREAD_WRAPPER_MODULE)
         self.is_stats_factory = any(
@@ -138,6 +167,16 @@ class _FileLinter(ast.NodeVisitor):
         return False
 
     # -- rules ------------------------------------------------------------
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self._func_stack.append(node.name)
+        self.generic_visit(node)
+        self._func_stack.pop()
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef) -> None:
+        self._func_stack.append(node.name)
+        self.generic_visit(node)
+        self._func_stack.pop()
 
     def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
         if node.module == "threading":
@@ -175,6 +214,22 @@ class _FileLinter(ast.NodeVisitor):
                        "time.time() is only for serialized timestamps "
                        "(annotate `# wall-clock`); deadlines/elapsed use "
                        "time.monotonic()")
+        # event-registry: flight-recorder emits must pass a string
+        # LITERAL type so the inventory diff (inventories.py) can verify
+        # it against EVENT_TYPES and the docs glossary statically.
+        # `_journal_emit` wrappers (the None-guarded forwarding shims)
+        # are held to the same rule at THEIR call sites; the forwarding
+        # call inside such a shim is exempt.
+        if _is_event_emit_call(node) \
+                and not (self._func_stack
+                         and self._func_stack[-1] in _EMIT_FORWARDERS):
+            first = node.args[0] if node.args else None
+            if not (isinstance(first, ast.Constant)
+                    and isinstance(first.value, str)):
+                self._emit(node, "event-registry",
+                           "journal emit with a non-literal event "
+                           "type; pass a string literal registered in "
+                           "utils/events.py EVENT_TYPES")
         # stats-registry
         if (not self.is_stats_factory
                 and _last_name(node.func) in ("StatsClient", "StatsDClient",

@@ -157,6 +157,10 @@ class API:
         # the fleet's merged fragment heat map, same degradation
         # contract (404 peers are "legacy", never an error)
         self.cluster_heat_fn = None
+        # federation hook for GET /cluster/events (Server.cluster_events):
+        # the merged HLC-sorted cluster timeline, same degradation
+        # contract (404 peers are "legacy", never an error)
+        self.cluster_events_fn = None
         # multi-tenant QoS plane (pilosa_tpu/qos.py QosPlane); set by
         # Server. The HTTP layer runs admission against it; here it
         # collects execution-boundary sheds (expired deadlines — local

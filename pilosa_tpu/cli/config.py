@@ -185,6 +185,14 @@ class MetricConfig:
     trace_export_endpoint: str = ""
     trace_export_format: str = "jaeger"  # jaeger | otlp
     trace_export_sample: float = 1.0
+    # cluster flight recorder (utils/events.py; GET /debug/events and
+    # the /cluster/events merged timeline): events-ring bounds the
+    # in-memory lifecycle lane (the log lane gets a quarter of it);
+    # events-spool > 0 additionally appends every event to a durable
+    # <data-dir>/events.spool.jsonl capped at that many bytes (one
+    # rotation kept). PILOSA_TPU_EVENTS=0 is the env kill switch.
+    events_ring: int = 2048
+    events_spool: int = 0
 
 
 @dataclass
@@ -445,6 +453,8 @@ class Config:
             f'trace-export-endpoint = "{self.metric.trace_export_endpoint}"',
             f'trace-export-format = "{self.metric.trace_export_format}"',
             f"trace-export-sample = {self.metric.trace_export_sample}",
+            f"events-ring = {self.metric.events_ring}",
+            f"events-spool = {self.metric.events_spool}",
             "",
             "[diagnostics]",
             f'url = "{self.diagnostics.url}"',

@@ -93,6 +93,21 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--json", action="store_true", dest="as_json",
                     help="print the raw advice document instead of the "
                          "rendered report")
+
+    tl = sub.add_parser(
+        "timeline", help="fetch the merged cluster event timeline "
+                         "(GET /cluster/events) and render it as an "
+                         "incident timeline with health-transition "
+                         "annotations")
+    tl.add_argument("--host", default="http://localhost:10101")
+    tl.add_argument("--limit", type=int, default=0,
+                    help="newest N events only (0 = everything retained)")
+    tl.add_argument("--type", dest="etype",
+                    help="only events of this registered type")
+    tl.add_argument("--node", help="only events recorded by this node id")
+    tl.add_argument("--json", action="store_true", dest="as_json",
+                    help="print the raw merged document instead of the "
+                         "rendered timeline")
     return p
 
 
@@ -181,6 +196,8 @@ def cmd_server(args) -> int:
         trace_export_endpoint=cfg.metric.trace_export_endpoint,
         trace_export_format=cfg.metric.trace_export_format,
         trace_export_sample=cfg.metric.trace_export_sample,
+        events_ring=cfg.metric.events_ring,
+        events_spool=cfg.metric.events_spool,
         slo_read_latency_ms=cfg.slo.read_latency_ms,
         slo_count_latency_ms=cfg.slo.count_latency_ms,
         slo_topn_latency_ms=cfg.slo.topn_latency_ms,
@@ -417,6 +434,74 @@ def cmd_advise(args) -> int:
     return 0
 
 
+def render_timeline(doc: dict, node: "str | None" = None,
+                    etype: "str | None" = None) -> str:
+    """Render a /cluster/events document as a terminal incident
+    timeline: one line per event in merged HLC order — local time from
+    the stamp's physical half, a short node id, the type, and the
+    event's own fields. health.transition lines are called out with a
+    marker and an explicit from→to annotation so "when did B go yellow"
+    is answerable by eye."""
+    import datetime
+
+    lines = []
+    nodes = {n["id"]: n for n in doc.get("nodes", [])}
+    legacy = sorted(i for i, n in nodes.items()
+                    if n.get("status") == "legacy")
+    events = doc.get("events", [])
+    if node:
+        events = [e for e in events if e.get("node") == node]
+    if etype:
+        events = [e for e in events if e.get("type") == etype]
+    skip = {"hlc", "ts", "type", "node", "seq"}
+    for e in events:
+        hlc = e.get("hlc") or [0, 0]
+        try:
+            when = datetime.datetime.fromtimestamp(
+                hlc[0] / 1000.0).strftime("%H:%M:%S.%f")[:-3]
+        except (OSError, OverflowError, ValueError):
+            when = "??:??:??"
+        stamp = f"{when}+{hlc[1]}" if hlc[1] else when
+        nid = str(e.get("node", "?"))[:8]
+        fields = " ".join(f"{k}={e[k]}" for k in sorted(e)
+                          if k not in skip)
+        if e.get("type") == "health.transition":
+            arrow = (f"{e.get('fromScore', '?')} -> "
+                     f"{e.get('toScore', '?')}")
+            reasons = "; ".join(e.get("reasons") or [])
+            lines.append(f"{stamp}  {nid}  ** HEALTH {arrow}"
+                         + (f" ({reasons})" if reasons else ""))
+        else:
+            lines.append(f"{stamp}  {nid}  {e.get('type')}"
+                         + (f"  {fields}" if fields else ""))
+    head = [f"cluster timeline: {len(events)} event(s) across "
+            f"{len(nodes)} node(s), HLC-merged (causal order; "
+            f"+N = logical tiebreak)"]
+    if legacy:
+        head.append(f"note: legacy peer(s) without /debug/events "
+                    f"(no events contributed): {', '.join(legacy)}")
+    return "\n".join(head + [""] + lines)
+
+
+def cmd_timeline(args) -> int:
+    """`pilosa-tpu timeline`: the merged cluster incident timeline
+    (GET /cluster/events — every node's flight-recorder feed, HLC-sorted
+    into one causal stream), rendered for a terminal."""
+    url = args.host + "/cluster/events"
+    if args.limit:
+        url += f"?limit={args.limit}"
+    try:
+        with urllib.request.urlopen(url, timeout=30) as resp:
+            doc = json.loads(resp.read())
+    except (OSError, ValueError) as e:
+        raise SystemExit(f"error: fetching {url}: {e}")
+    if args.as_json:
+        print(json.dumps(doc, indent=2, sort_keys=True))
+    else:
+        print(render_timeline(doc, node=args.node, etype=args.etype))
+    return 0
+
+
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     handler = {
@@ -428,6 +513,7 @@ def main(argv=None) -> int:
         "config": cmd_config,
         "generate-config": cmd_generate_config,
         "advise": cmd_advise,
+        "timeline": cmd_timeline,
     }[args.command]
     return handler(args)
 

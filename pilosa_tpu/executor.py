@@ -218,6 +218,12 @@ class Executor:
         self._ici_route_memo: collections.OrderedDict = \
             collections.OrderedDict()
         self._ici_topo_fp = None
+        # flight-recorder journal (utils/events.py, set by Server):
+        # topology-fingerprint flips and slice-local routing flips land
+        # on the merged cluster timeline; the pre-flush memo lets a flip
+        # of a SPECIFIC routing decision be reported, not just the flush
+        self.journal = None
+        self._ici_prev_memo: dict = {}
         # cost-based query planner (pilosa_tpu/planner.py): cardinality
         # reorders, empty-branch short-circuits, Count/TopN pushdown
         # marking; PILOSA_TPU_PLANNER=0 / [query] plan=off fall back to
@@ -1908,19 +1914,41 @@ class Executor:
         memoized per (index, shard tuple) under one topology fingerprint."""
         fp = self._ici_topo_fingerprint()
         key = (index.name, tuple(qshards))
+        topo_flipped = False
         with self._ici_lock:
             if fp != self._ici_topo_fp:
+                topo_flipped = self._ici_topo_fp is not None
+                self._ici_prev_memo = dict(self._ici_route_memo)
                 self._ici_route_memo.clear()
                 self._ici_topo_fp = fp
             hit = self._ici_route_memo.get(key)
             if hit is not None:
                 self._ici_route_memo.move_to_end(key)
                 return hit
+        if topo_flipped and self.journal is not None:
+            try:
+                self.journal.emit(
+                    "topology.change", observer="ici-router",
+                    nodes=len(fp[0]), down=len(fp[2]),
+                    draining=len(fp[3]))
+            except Exception:  # noqa: BLE001 — recording must never
+                pass  # break routing
         local = self.cluster.local_id
         ok = all(
             any(n.id == local
                 for n in self.cluster.shard_nodes(index.name, s))
             for s in qshards)
+        prev = self._ici_prev_memo.get(key)
+        if prev is not None and prev != ok and self.journal is not None:
+            # a memoized slice-local decision flipped under the new
+            # topology: the query mix just changed serving plane
+            try:
+                self.journal.emit(
+                    "ici.route_flip", index=index.name,
+                    shards=len(qshards),
+                    route="slice_local" if ok else "cross_slice")
+            except Exception:  # noqa: BLE001 — never break routing
+                pass
         with self._ici_lock:
             if fp == self._ici_topo_fp:
                 self._ici_route_memo[key] = ok

@@ -91,6 +91,11 @@ class ClientError(Exception):
 class InternalClient:
     def __init__(self, timeout: float = 30.0, tls_skip_verify: bool = False):
         self.timeout = timeout
+        # flight-recorder hybrid logical clock (utils/events.py, set by
+        # Server): every outbound RPC piggybacks this node's HLC stamp
+        # and every response's stamp merges back — the causal ordering
+        # substrate of the merged cluster timeline
+        self.hlc = None
         self._ssl_ctx: Optional[ssl.SSLContext] = None
         # per-thread keep-alive connections keyed by (scheme, host:port):
         # the fan-out paths (remote query scatter, anti-entropy block
@@ -168,6 +173,12 @@ class InternalClient:
             # principal header's twin): the remote orders this RPC's
             # work under the original caller's class
             headers[qos.PRIORITY_HEADER] = priority
+        if self.hlc is not None:
+            # HLC piggyback (utils/events.py): the peer merges our stamp
+            # so its subsequent events sort causally after ours
+            from pilosa_tpu.utils import events as _events
+            headers[_events.HLC_HEADER] = _events.encode_hlc(
+                self.hlc.now())
         sock_timeout = timeout if timeout is not None else self.timeout
         rem = qctx.remaining()
         if rem is not None:
@@ -242,6 +253,15 @@ class InternalClient:
                 raise ClientError(
                     f"{method} {path}: short body: read {len(data)} of "
                     f"{clen} bytes")
+            if self.hlc is not None:
+                # merge the peer's HLC from the response (the reverse
+                # half of the piggyback): events this node records after
+                # hearing from the peer sort after the peer's
+                from pilosa_tpu.utils import events as _events
+                stamp = _events.decode_hlc(
+                    resp.getheader(_events.HLC_HEADER))
+                if stamp is not None:
+                    self.hlc.update(stamp)
             if resp.will_close:
                 self._drop_conn(key)
             if resp.status >= 400:
@@ -458,6 +478,16 @@ class InternalClient:
         /cluster/usage federation. Same legacy contract as node_stats:
         a peer predating the route 404s and the caller degrades it."""
         out = self._request("GET", uri, "/debug/usage", timeout=timeout)
+        return json.loads(out) if out else {}
+
+    def debug_events(self, uri: str,
+                     timeout: Optional[float] = None) -> dict:
+        """One peer's flight-recorder feed (GET /debug/events) for the
+        /cluster/events merged timeline. Same legacy contract as
+        node_stats: a peer predating the route 404s and the caller
+        degrades it. The response's HLC header merges into our clock
+        like every RPC, so the merge itself is causally consistent."""
+        out = self._request("GET", uri, "/debug/events", timeout=timeout)
         return json.loads(out) if out else {}
 
     def debug_heat(self, uri: str, timeout: Optional[float] = None) -> dict:

@@ -100,6 +100,12 @@ a { color: var(--series); }
 <div class="sub" id="heatmeta"></div>
 <div id="heatgrid" class="heatgrid"></div>
 
+<h2>Event feed (flight recorder)</h2>
+<div class="sub" id="eventsmeta"></div>
+<table id="events"><thead><tr>
+  <th>time</th><th>type</th><th class="reasons">detail</th>
+</tr></thead><tbody></tbody></table>
+
 <h2>Fleet</h2>
 <table id="fleet"><thead><tr>
   <th>health</th><th>node</th><th>state</th><th class="num">uptime</th>
@@ -358,6 +364,44 @@ function renderHeat(doc) {
   }
 }
 
+// flight-recorder event feed (GET /debug/events): incremental via the
+// same since-cursor discipline as the time-series ring — each event
+// crosses the wire once; newest 40 rendered, lifecycle before log
+let eventsCursor = 0;
+const eventRows = [];
+function renderEvents(doc) {
+  for (const e of (doc.events || [])) eventRows.push(e);
+  while (eventRows.length > 200) eventRows.shift();
+  const meta = document.getElementById("eventsmeta");
+  meta.textContent = eventRows.length + " retained client-side" +
+    (doc.enabled === false ? " · RECORDER OFF" : "") +
+    " · merged cluster view: GET /cluster/events or `pilosa-tpu timeline`";
+  const body = document.querySelector("#events tbody");
+  body.textContent = "";
+  const skip = { hlc: 1, ts: 1, type: 1, node: 1, seq: 1, trace: 1 };
+  for (const e of eventRows.slice(-40).reverse()) {
+    const tr = document.createElement("tr");
+    tr.appendChild(td(new Date((e.hlc || [0])[0]).toLocaleTimeString()));
+    const ty = td(e.type);
+    if (e.type === "health.transition") {
+      ty.className = "health health-" + (e.toScore || "yellow");
+    }
+    tr.appendChild(ty);
+    const detail = Object.keys(e).filter(k => !skip[k]).sort()
+      .map(k => k + "=" + JSON.stringify(e[k])).join(" ");
+    const dt = document.createElement("td");
+    dt.className = "reasons";
+    dt.textContent = detail;
+    tr.appendChild(dt);
+    body.appendChild(tr);
+  }
+  if (!eventRows.length) {
+    const tr = document.createElement("tr");
+    tr.appendChild(td("no events yet"));
+    body.appendChild(tr);
+  }
+}
+
 async function refresh() {
   const err = document.getElementById("err");
   try {
@@ -371,6 +415,9 @@ async function refresh() {
     renderUsage(us);
     const ht = await (await fetch("/debug/heat?top=48")).json();
     renderHeat(ht);
+    const ev = await (await fetch("/debug/events?since=" + eventsCursor)).json();
+    eventsCursor = ev.seq || eventsCursor;
+    renderEvents(ev);
     const cs = await (await fetch("/cluster/stats")).json();
     renderFleet(cs);
     err.textContent = "";

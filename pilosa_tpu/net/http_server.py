@@ -47,6 +47,8 @@ ROUTES: list[tuple[str, re.Pattern, str]] = [
     ("GET", re.compile(r"^/cluster/stats$"), "get_cluster_stats"),
     ("GET", re.compile(r"^/cluster/usage$"), "get_cluster_usage"),
     ("GET", re.compile(r"^/cluster/heat$"), "get_cluster_heat"),
+    ("GET", re.compile(r"^/cluster/events$"), "get_cluster_events"),
+    ("GET", re.compile(r"^/debug/events$"), "get_debug_events"),
     ("GET", re.compile(r"^/debug/vars$"), "get_debug_vars"),
     ("GET", re.compile(r"^/debug/usage$"), "get_debug_usage"),
     ("GET", re.compile(r"^/debug/heat$"), "get_debug_heat"),
@@ -95,6 +97,8 @@ ALLOWED_QUERY_ARGS: dict[str, frozenset] = {
     "get_debug_timeseries": frozenset({"since", "limit"}),
     "get_debug_usage": frozenset({"since", "limit", "top"}),
     "get_debug_heat": frozenset({"since", "limit", "top", "advice"}),
+    "get_debug_events": frozenset({"since", "limit", "type", "severity"}),
+    "get_cluster_events": frozenset({"since", "limit"}),
 }
 
 
@@ -104,12 +108,16 @@ class Handler:
     def __init__(self, api: API,
                  cluster_message_fn: Optional[Callable[[dict], None]] = None,
                  stats=None, query_timeout: float = 0.0, telemetry=None,
-                 qos_plane=None):
+                 qos_plane=None, events=None):
         self.api = api
         self.cluster_message_fn = cluster_message_fn
         self.stats = stats
         self.query_timeout = query_timeout  # [cluster] query-timeout default
         self.telemetry = telemetry  # TelemetrySampler (GET /debug/timeseries)
+        # flight-recorder journal (utils/events.py EventJournal, set by
+        # Server): serves GET /debug/events, merges incoming X-Pilosa-HLC
+        # stamps into the node's clock, and stamps every response
+        self.events = events
         # multi-tenant QoS plane (pilosa_tpu/qos.py): admission control —
         # quotas, priority resolution, deadline-aware shedding — runs here
         # at dispatch, BEFORE parse. None = no admission (plumbing only).
@@ -176,6 +184,15 @@ class Handler:
         # caller's trace id for every span opened while serving this request
         incoming_trace = (headers or {}).get(tracing.TRACE_HEADER) if headers else None
         token = tracing.current_trace_id.set(incoming_trace) if incoming_trace else None
+        if self.events is not None and headers is not None \
+                and hasattr(headers, "get"):
+            # HLC piggyback (utils/events.py): merge the caller's stamp
+            # so events recorded while serving this request sort causally
+            # after the caller's events — cheap no-op when absent
+            from pilosa_tpu.utils import events as _events
+            stamp = _events.decode_hlc(headers.get(_events.HLC_HEADER))
+            if stamp is not None:
+                self.events.clock.update(stamp)
         # accounting middleware (utils/accounting.py): install the
         # caller's Account so every charge site in the stack attributes
         # this request's device-ms/HBM/RPC spend to its principal —
@@ -637,6 +654,10 @@ class Handler:
         # graceful-drain lifecycle state (server.drain)
         if self.api.drain_status_fn is not None:
             snap["drain"] = self.api.drain_status_fn()
+        # flight-recorder journal (utils/events.py): per-type emit
+        # counts, lane occupancy/evictions, spool state
+        if self.events is not None:
+            snap["events"] = self.events.snapshot()
         holder = getattr(self.api, "holder", None)
         if holder is not None:
             # volatility surface (frozen bulk loads are NOT durable until
@@ -776,6 +797,50 @@ class Handler:
                 residency=res.snapshot() if res is not None else None,
                 budget_bytes=res.budget if res is not None else 0)
         return self._json(out)
+
+    def get_debug_events(self, params, query, body):
+        """Flight-recorder event feed (utils/events.py EventJournal):
+        `?since=<seq>` returns only events newer than the cursor (the
+        /debug/timeseries discipline — each event crosses the wire once
+        per poller); `?type=` / `?severity=lifecycle|log` filter. Every
+        event carries the node's HLC stamp, so feeds from several nodes
+        merge into one causal timeline (GET /cluster/events does exactly
+        that)."""
+        from pilosa_tpu.utils import events as _events
+        try:
+            since = int(self._arg(query, "since", "0"))
+            limit = int(self._arg(query, "limit", "0"))
+        except ValueError:
+            raise ApiError("since and limit must be integers")
+        etype = self._arg(query, "type")
+        severity = self._arg(query, "severity")
+        if severity and severity not in _events.LANES:
+            raise ApiError(
+                f"invalid severity {severity!r} (expected "
+                f"{' | '.join(_events.LANES)})")
+        if etype and etype not in _events.EVENT_TYPES:
+            raise ApiError(f"unknown event type {etype!r}")
+        if self.events is None:
+            return self._json({"seq": 0, "enabled": False, "node": "",
+                               "events": []})
+        out = self.events.since(since, limit, etype=etype,
+                                severity=severity)
+        out["enabled"] = _events.enabled()
+        out["node"] = self.events.node_id
+        return self._json(out)
+
+    def get_cluster_events(self, params, query, body):
+        """The merged cluster timeline: every live peer's /debug/events
+        feed collected concurrently and HLC-sorted into one causal event
+        stream (Server.cluster_events — legacy peers that 404 the route
+        degrade to "legacy", never an error)."""
+        if self.api.cluster_events_fn is None:
+            raise ApiError("cluster events not supported", status=501)
+        try:
+            limit = int(self._arg(query, "limit", "0"))
+        except ValueError:
+            raise ApiError("limit must be an integer")
+        return self._json(self.api.cluster_events_fn(limit=limit))
 
     def get_cluster_heat(self, params, query, body):
         """The fleet's merged fragment heat map: every live peer's
@@ -999,6 +1064,20 @@ class Handler:
             gauges["drain/draining"] = 1.0 if ds["draining"] else 0.0
             gauges["drain/activeQueries"] = ds["activeQueries"]
             counts["drain/shedQueries"] = ds["shedQueries"]
+        # flight-recorder event families: the FULL registered type
+        # keyspace emitted unconditionally (zeros included) like the qos
+        # families, so an "event rate spiked" alert never races the
+        # first emitted event for the family to exist
+        if self.events is not None:
+            from pilosa_tpu.utils import events as _events
+            es = self.events.snapshot()
+            for t in sorted(_events.EVENT_TYPES):
+                counts[f"events,type:{t}"] = es["byType"].get(t, 0)
+            for lane, n in es["evicted"].items():
+                counts[f"events/evicted,lane:{lane}"] = n
+            gauges["events/retained"] = float(
+                sum(es["retained"].values()))
+            gauges["events/spoolBytes"] = float(es["spoolBytes"])
         if self.api.health_fn is not None:
             try:
                 score = self.api.health_fn()["score"]
@@ -1226,6 +1305,14 @@ class _RequestHandler(BaseHTTPRequestHandler):
         self.send_response(status)
         self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(payload)))
+        if self.handler.events is not None:
+            # HLC piggyback on every response: the caller merges it so
+            # its later events sort after anything this node recorded
+            # while serving (utils/events.py)
+            from pilosa_tpu.utils import events as _events
+            self.send_header(
+                _events.HLC_HEADER,
+                _events.encode_hlc(self.handler.events.clock.now()))
         if extra:
             for k, v in extra.items():
                 self.send_header(k, v)

@@ -182,6 +182,10 @@ class Gossip:
             self._cipher = AESGCM(secret_key)
         self.crypto_drops = 0  # cleartext/forged/undecryptable datagrams
         self._meta = dict(meta or {})
+        # flight-recorder hybrid logical clock (utils/events.py, set by
+        # Server): datagrams piggyback the stamp so gossip hops carry
+        # causality exactly like the HTTP plane's X-Pilosa-HLC header
+        self.clock = None
         self.on_alive = on_alive
         self.on_suspect = on_suspect
         self.on_dead = on_dead
@@ -329,6 +333,9 @@ class Gossip:
         # explicit updates (e.g. the tell-the-sender-it-is-suspected ack
         # path) ride in front of the piggyback queue
         msg["updates"] = msg.get("updates", []) + self._take_piggyback()
+        if self.clock is not None:
+            p, l = self.clock.now()
+            msg["hlc"] = [p, l]
         data = json.dumps(msg).encode()
         if len(data) > _MAX_DATAGRAM:  # shed piggyback before giving up
             msg["updates"] = []
@@ -367,6 +374,8 @@ class Gossip:
                 msg = json.loads(data)
             except ValueError:
                 continue
+            if self.clock is not None and msg.get("hlc") is not None:
+                self.clock.update(msg["hlc"])
             for u in msg.get("updates", []):
                 self._apply_update(u)
             t = msg.get("t")

@@ -299,6 +299,16 @@ class QosPlane:
     HEALTH_TTL_S = 1.0
     # EWMA smoothing for queue-wait / per-class service cost
     EWMA_ALPHA = 0.3
+    # shed-storm detection (flight-recorder events, utils/events.py):
+    # STORM_N rejections inside STORM_WINDOW_S is the onset; a window
+    # with no rejections ends it. One event per edge, never per shed.
+    STORM_WINDOW_S = 5.0
+    STORM_N = 20
+    # deep quota debt: a 429 whose Retry-After reaches this marks the
+    # principal as in debt (rate-limited to one event per principal per
+    # DEBT_EMIT_INTERVAL_S so an abusive tenant can't storm the journal)
+    QUOTA_DEBT_S = 5.0
+    DEBT_EMIT_INTERVAL_S = 60.0
 
     def __init__(self, mode: str = "off",
                  default_priority: str = "interactive",
@@ -348,6 +358,16 @@ class QosPlane:
         self.ledger = ledger
         self.health_fn = health_fn
         self.logger = logger
+        # flight-recorder journal (utils/events.py, set by Server):
+        # shed-storm onset/end + deep quota debt become timeline events
+        self.journal = None
+        import collections as _collections
+        self._storm_times: "_collections.deque" = _collections.deque()
+        self.storm_active = False
+        self._storm_started = 0.0
+        self._storm_total = 0
+        self.storms = 0
+        self._debt_last_emit: dict[str, float] = {}
         self._lock = threading.Lock()
         self._principals: dict[str, _PrincipalState] = {}
         # counters — every surface iterates these dicts, and /metrics
@@ -513,27 +533,100 @@ class QosPlane:
         with `503 + X-Pilosa-Shed-Reason: draining` (server.drain). NOT
         gated on [qos] mode — drain shedding is a lifecycle decision, not
         an overload policy; this just rides the same counter families."""
+        now = time.monotonic()
         with self._lock:
             self.shed["draining"] += 1
+            storm_started = self._note_rejection(now, "draining")
+        self._storm_debt_events(storm_started, False, "", "draining", 0.0)
+
+    def _journal_emit(self, etype: str, **fields) -> None:
+        if self.journal is not None:
+            try:
+                self.journal.emit(etype, **fields)
+            except Exception:  # noqa: BLE001 — recording must never
+                pass  # break the admission hot path it observes
+
+    def _note_rejection(self, now: float, reason: str) -> bool:
+        """Track one rejection toward storm onset (call under _lock);
+        True when THIS rejection crossed the storm threshold."""
+        dq = self._storm_times
+        dq.append(now)
+        while dq and now - dq[0] > self.STORM_WINDOW_S:
+            dq.popleft()
+        if self.storm_active:
+            self._storm_total += 1
+            return False
+        if len(dq) >= self.STORM_N:
+            self.storm_active = True
+            self.storms += 1
+            self._storm_started = now
+            self._storm_total = len(dq)
+            return True
+        return False
+
+    def _note_calm(self, now: float) -> Optional[dict]:
+        """Storm-end check (call under _lock) — a full window without a
+        rejection ends the storm; returns the end-event fields once."""
+        if self.storm_active and (
+                not self._storm_times
+                or now - self._storm_times[-1] > self.STORM_WINDOW_S):
+            self.storm_active = False
+            return {"rejections": self._storm_total,
+                    "durationSeconds": round(
+                        now - self._storm_started, 3)}
+        return None
 
     def _reject(self, principal: str, priority: str, status: int,
                 retry_after: float, reason: str,
                 message: str) -> Optional[Rejection]:
         """Count (and in observe mode, swallow) one rejection verdict."""
         kind = "throttled" if status == 429 else "shed"
+        now = time.monotonic()
+        storm_started = False
+        debt = False
+        observed = False
         with self._lock:
+            # storm tracking counts observe-mode would-rejections too: a
+            # dry-run storm is exactly what observe mode exists to show
+            storm_started = self._note_rejection(now, reason)
+            if status == 429 and retry_after >= self.QUOTA_DEBT_S:
+                last = self._debt_last_emit.get(principal, 0.0)
+                if now - last >= self.DEBT_EMIT_INTERVAL_S:
+                    self._debt_last_emit[principal] = now
+                    debt = True
             if self.mode == "observe":
                 (self.would_throttled if status == 429
                  else self.would_shed)[reason] += 1
-                if self.logger is not None:
-                    self.logger.printf(
-                        "qos: observe: would %s %s (priority=%s): %s",
-                        "throttle" if status == 429 else "shed",
-                        principal, priority, message)
-                return None
-            (self.throttled if status == 429 else self.shed)[reason] += 1
-            self._pp(principal)[kind] += 1
+                observed = True
+            else:
+                (self.throttled if status == 429
+                 else self.shed)[reason] += 1
+                self._pp(principal)[kind] += 1
+        # journal/log emission OUTSIDE the plane lock (the spool write
+        # and log line must never serialize the admission hot path)
+        self._storm_debt_events(storm_started, debt, principal, reason,
+                                retry_after)
+        if observed:
+            if self.logger is not None:
+                self.logger.printf(
+                    "qos: observe: would %s %s (priority=%s): %s",
+                    "throttle" if status == 429 else "shed",
+                    principal, priority, message)
+            return None
         return Rejection(status, retry_after, reason, message)
+
+    def _storm_debt_events(self, storm_started: bool, debt: bool,
+                           principal: str, reason: str,
+                           retry_after: float) -> None:
+        if storm_started:
+            self._journal_emit("qos.shed_storm.start", reason=reason,
+                             mode=self.mode,
+                             windowSeconds=self.STORM_WINDOW_S,
+                             threshold=self.STORM_N)
+        if debt:
+            self._journal_emit("qos.quota_debt", principal=principal,
+                             reason=reason,
+                             retryAfterSeconds=round(retry_after, 3))
 
     # -- the admission check (HTTP dispatch hot path) -----------------------
 
@@ -618,6 +711,9 @@ class QosPlane:
         with self._lock:
             self.admitted[priority] = self.admitted.get(priority, 0) + 1
             self._pp(principal)["admitted"] += 1
+            calm = self._note_calm(now)
+        if calm is not None:
+            self._journal_emit("qos.shed_storm.end", **calm)
         return None
 
     # -- surfaces -----------------------------------------------------------
@@ -641,6 +737,8 @@ class QosPlane:
                 "trackedPrincipals": len(self._principals),
                 "defaultPriority": self.default_priority,
                 "defaultDeadline": self.default_deadline,
+                "shedStormActive": self.storm_active,
+                "shedStorms": self.storms,
             }
 
     def totals(self) -> dict:

@@ -113,7 +113,9 @@ class Server:
                  hint_max_bytes: int = 64 << 20,
                  hint_max_age: float = 3600.0,
                  drain_timeout: float = 30.0,
-                 eviction: str = "lru"):
+                 eviction: str = "lru",
+                 events_ring: int = 2048,
+                 events_spool: int = 0):
         self.data_dir = data_dir
         # [storage] wal-fsync, plumbed down the model tree to every
         # Fragment (PILOSA_TPU_WAL_FSYNC env overrides per fragment —
@@ -162,6 +164,38 @@ class Server:
         # --log-format=json emits structured lines carrying trace=<id> as
         # a proper field (utils/logger.py); Logger validates the mode
         self.logger = Logger(fmt=log_format)
+        # cluster flight recorder (utils/events.py; docs/operations.md
+        # "Flight recorder and incident timelines"): a typed, HLC-stamped
+        # event journal every state-transition choke point emits into.
+        # The HLC piggybacks on internal RPCs and gossip so the merged
+        # /cluster/events timeline is causal, not wall-clock. Knobs:
+        # [metric] events-ring (per-lane bound) / events-spool (durable
+        # JSONL byte cap, 0 = off); PILOSA_TPU_EVENTS=0 kills recording.
+        from pilosa_tpu.utils.events import (
+            EventJournal,
+            HybridLogicalClock,
+            register_crash_dump,
+        )
+        if events_ring < 1:
+            raise ValueError(
+                f"invalid [metric] events-ring {events_ring!r} "
+                "(expected >= 1)")
+        if events_spool < 0:
+            raise ValueError("[metric] events-spool must be >= 0")
+        self.clock = HybridLogicalClock()
+        self.events = EventJournal(
+            node_id=self.node_id, ring_size=events_ring, clock=self.clock,
+            spool_path=(os.path.join(data_dir, "events.spool.jsonl")
+                        if events_spool > 0 else ""),
+            spool_max_bytes=events_spool, stats=self.stats)
+        # warn/error log lines land on the merged timeline too (bounded
+        # LOG lane: a log storm can't evict lifecycle events)
+        self.logger.journal = self.events
+        # every outbound RPC piggybacks this node's HLC; responses merge
+        self.client.hlc = self.clock
+        # crash forensics: SIGQUIT (and any fatal path calling
+        # spill_all_crash_dumps) spills the ring next to the data dir
+        register_crash_dump(self.events, data_dir)
         from pilosa_tpu.utils.diagnostics import (
             DiagnosticsCollector,
             RuntimeMonitor,
@@ -227,8 +261,12 @@ class Server:
                                max_bytes=hint_max_bytes,
                                max_age=hint_max_age,
                                fsync=(wal_fsync == "always"),
-                               stats=self.stats, logger=self.logger)
+                               stats=self.stats, logger=self.logger,
+                               journal=self.events)
         self.executor.hints = self.hints
+        # flight-recorder hook for topology-fingerprint flips and
+        # slice-local route flips (executor._ici_co_resident)
+        self.executor.journal = self.events
         # graceful-drain lifecycle (docs/operations.md "Rolling restarts
         # and drains"): SIGTERM / POST /cluster/drain moves this node to
         # a broadcast DRAINING state, sheds new external queries with
@@ -335,6 +373,10 @@ class Server:
         self.api.cluster_stats_fn = self.cluster_stats
         self.api.cluster_usage_fn = self.cluster_usage
         self.api.cluster_heat_fn = self.cluster_heat
+        self.api.cluster_events_fn = self.cluster_events
+        # last health score seen by the sampler: a change emits a
+        # health.transition event onto the timeline
+        self._last_health: Optional[str] = None
         # multi-tenant QoS plane (pilosa_tpu/qos.py): per-principal quota
         # buckets refilled against the usage ledger, priority classes the
         # batchers/pools order by, deadline-aware admission + shedding.
@@ -352,6 +394,8 @@ class Server:
             max_principals=qos_max_principals, principals=qos_principals,
             executor=self.executor, ledger=self.usage,
             health_fn=self.node_health, logger=self.logger)
+        # shed-storm onset/end + quota-debt events ride the journal
+        self.qos.journal = self.events
         self.api.qos_plane = self.qos
         self.api.drain_fn = self.request_drain
         self.api.drain_status_fn = self.drain_status
@@ -359,7 +403,8 @@ class Server:
             lambda: "DRAINING" if self.draining else "READY")
         self.handler = Handler(self.api, cluster_message_fn=self.receive_message,
                                stats=self.stats, query_timeout=query_timeout,
-                               telemetry=self.telemetry, qos_plane=self.qos)
+                               telemetry=self.telemetry, qos_plane=self.qos,
+                               events=self.events)
         self.http = HTTPServer(self.handler, host=host, port=port,
                                tls_certificate=tls_certificate, tls_key=tls_key)
         self._bind_host = host
@@ -477,20 +522,27 @@ class Server:
         self.holder.open()
         for d in self.holder.damaged_fragments():
             # recovery happened inside Fragment.open; make it LOUD for the
-            # operator (also surfaced in /debug/vars damagedFragments)
+            # operator (also surfaced in /debug/vars damagedFragments and
+            # on the flight-recorder timeline)
+            frag_key = (f"{d['index']}/{d['field']}/{d['view']}"
+                        f"/{d['shard']}")
             if d["quarantinePath"]:
-                self.logger.printf(
-                    "storage: fragment %s/%s/%s/%d failed its integrity "
+                self.logger.errorf(
+                    "storage: fragment %s failed its integrity "
                     "check (%s): quarantined to %s, reopened empty — the "
                     "scrubber will rebuild it from a replica",
-                    d["index"], d["field"], d["view"], d["shard"],
-                    d["corruptionError"], d["quarantinePath"])
+                    frag_key, d["corruptionError"], d["quarantinePath"])
+                self.events.emit("snapshot.quarantined", fragment=frag_key,
+                                 error=str(d["corruptionError"])[:200],
+                                 quarantinePath=d["quarantinePath"])
             if d["walTruncatedBytes"]:
-                self.logger.printf(
-                    "storage: fragment %s/%s/%s/%d had a torn WAL tail "
+                self.logger.warnf(
+                    "storage: fragment %s had a torn WAL tail "
                     "(%s): truncated %d un-acked bytes",
-                    d["index"], d["field"], d["view"], d["shard"],
-                    d["walTruncateError"], d["walTruncatedBytes"])
+                    frag_key, d["walTruncateError"],
+                    d["walTruncatedBytes"])
+                self.events.emit("wal.truncated", fragment=frag_key,
+                                 bytes=int(d["walTruncatedBytes"]))
         self.holder.set_shard_hook(self._on_shard_added)
         self.http.serve_background()
         me = Node(id=self.node_id, uri=self.http.uri,
@@ -549,6 +601,11 @@ class Server:
         from pilosa_tpu.utils import telemetry as _telemetry
         if _telemetry.xla.log_fn is None:
             _telemetry.xla.log_fn = self.logger.printf
+        if _telemetry.xla.event_fn is None:
+            # recompile storms land on the flight-recorder timeline too
+            # (process-global counters: first server's journal wins,
+            # exactly like log_fn)
+            _telemetry.xla.event_fn = self._xla_storm_event
         self.telemetry.start()
         # rejoin protocol (docs/operations.md "Rolling restarts and
         # drains"): (1) read-fence local fragments that may have missed
@@ -557,6 +614,8 @@ class Server:
         # DRAINING/down mark and replay queued hints immediately instead
         # of waiting a probe cycle.
         self._arm_read_fence()
+        self.events.emit("node.start", uri=self.http.uri,
+                         cluster=bool(self.cluster_hosts))
         if self.cluster_hosts and not self.join:
             self.broadcast({"type": "node-state", "id": self.node_id,
                             "state": "READY"})
@@ -619,6 +678,9 @@ class Server:
                              secret_key=(derive_key(self._gossip_secret)
                                          if self._gossip_secret else None),
                              logger=self.logger)
+        # gossip datagrams piggyback the flight-recorder HLC (the UDP
+        # twin of the HTTP plane's X-Pilosa-HLC header)
+        self.gossip.clock = self.clock
         self.gossip.open(seeds=[parse_seed(s) for s in self._gossip_seeds])
         self.logger.printf("gossip: listening on %s:%d (seeds: %s)",
                            self.gossip.host, self.gossip.port,
@@ -635,6 +697,8 @@ class Server:
                                "marking down", member.id)
             self.cluster.mark_down(member.id)
             self.stats.count("liveness/node_down")
+            self.events.emit("peer.down", peer=member.id,
+                             detector="gossip")
 
     def _on_gossip_alive(self, member) -> None:
         """A peer (re)entered alive state: revive it if it was down, or
@@ -655,6 +719,8 @@ class Server:
         elif self.cluster.is_down(member.id):
             self.logger.printf("gossip: node %s back up", member.id)
             self.cluster.mark_up(member.id)
+            self.events.emit("peer.up", peer=member.id,
+                             detector="gossip")
             self._on_node_return(node)
 
     def refresh_membership(self) -> None:
@@ -767,6 +833,8 @@ class Server:
                     self.logger.printf("liveness: node %s (%s) back up",
                                        node.id, node.uri)
                     self.cluster.mark_up(node.id)
+                    self.events.emit("peer.up", peer=node.id,
+                                     detector="probe")
                     self._on_node_return(node)
                 elif self.cluster.is_draining(node.id) \
                         and node_states.get(node.id) == "READY":
@@ -831,6 +899,9 @@ class Server:
                 < self.cluster.replica_n else "STARTING")
             self.cluster.mark_down(node.id)
             self.stats.count("liveness/node_down")
+            self.events.emit("peer.down", peer=node.id, detector="probe",
+                             failedProbes=self._probe_failures.get(
+                                 node.id, 0))
 
     def _indirect_confirms_alive(self, target, peers, results) -> bool:
         """Ask up to `indirect_probes` live peers whether THEY can reach
@@ -997,6 +1068,7 @@ class Server:
                     "drain: peer %s is draining — routing around it", nid)
                 self.cluster.mark_draining(nid)
                 self.stats.count("drain/peerDraining")
+                self.events.emit("peer.draining", peer=nid)
         elif state == "READY":
             was_away = (self.cluster.is_down(nid)
                         or self.cluster.is_draining(nid))
@@ -1007,6 +1079,7 @@ class Server:
             if was_away and node is not None:
                 self.logger.printf(
                     "drain: peer %s rejoined — replaying hints", nid)
+                self.events.emit("peer.rejoined", peer=nid)
                 self._on_node_return(node)
 
     def request_drain(self, abort: bool = False,
@@ -1039,6 +1112,7 @@ class Server:
         if me is not None and me.state == "DRAINING":
             me.state = "READY"
         self.logger.printf("drain: aborted — resuming service")
+        self.events.emit("drain.abort")
         self.broadcast({"type": "node-state", "id": self.node_id,
                         "state": "READY"})
 
@@ -1081,6 +1155,7 @@ class Server:
             self.logger.printf(
                 "drain: shedding new external queries (timeout %.1fs)",
                 timeout)
+            self.events.emit("drain.start", timeoutSeconds=timeout)
             self.broadcast({"type": "node-state", "id": self.node_id,
                             "state": "DRAINING"})
         inflight_ok = self._drain_wait(
@@ -1132,6 +1207,10 @@ class Server:
             " — safe to stop the process",
             self._drain_info["durationSeconds"], inflight_ok, flushed_ok,
             snapshotted)
+        self.events.emit("drain.complete", snapshotted=snapshotted,
+                         snapshotErrors=snapshot_errors,
+                         durationSeconds=self._drain_info[
+                             "durationSeconds"])
         return self.drain_status()
 
     def drain_status(self) -> dict:
@@ -1170,6 +1249,7 @@ class Server:
             "rejoin: read-fenced %d shard(s) pending parity verification "
             "(reads route to replicas until hints replay or a checksum "
             "scrub confirms)", n)
+        self.events.emit("fence.armed", shards=n)
         self._start_fence_worker()
 
     def _start_fence_worker(self) -> None:
@@ -1198,10 +1278,12 @@ class Server:
                     n = len(self.executor.read_fence)
                     self.executor.read_fence.clear()
                 self.stats.count("readFence/expired", n)
-                self.logger.printf(
+                self.logger.warnf(
                     "rejoin: fence expired after %.0fs with %d shard(s) "
                     "unverified — serving local data; anti-entropy will "
                     "heal any divergence", self.rejoin_fence_timeout, n)
+                self.events.emit("fence.expired", shards=n,
+                                 timeoutSeconds=self.rejoin_fence_timeout)
                 break
             self._fence_wake.wait(0.25)
             self._fence_wake.clear()
@@ -1264,6 +1346,8 @@ class Server:
                 self.executor.unfence_reads((iname, shard))
                 lifted += 1
                 self.stats.count("readFence/verified")
+                self.events.emit("fence.lifted", index=iname, shard=shard,
+                                 healed=healed)
                 if healed:
                     self.stats.count("readFence/healed")
         return lifted
@@ -1293,14 +1377,29 @@ class Server:
 
         replayed, dropped, complete = self.hints.replay(node.id, apply)
         if replayed or dropped:
+            self.events.emit("hint.replay", target=node.id,
+                             replayed=replayed, dropped=dropped,
+                             complete=complete)
             self.logger.printf(
                 "hints: replayed %d hint(s) to %s, %d dropped%s",
                 replayed, node.id, dropped,
                 "" if complete else " — anti-entropy will finish the heal")
         return replayed, dropped, complete
 
+    def _xla_storm_event(self, family: str, new_keys: int) -> None:
+        """XLACounters storm hook: a recompile storm is a health incident
+        the merged timeline must show (utils/telemetry.py)."""
+        try:
+            self.events.emit("xla.recompile_storm", family=family,
+                             newShapes=int(new_keys))
+        except Exception:  # noqa: BLE001 — recording must never break
+            pass  # the dispatch path that tripped the storm
+
     def close(self) -> None:
         self.closed = True
+        from pilosa_tpu.utils.events import unregister_crash_dump
+        self.events.emit("node.stop")
+        unregister_crash_dump(self.events)
         if self.gossip is not None:
             self.gossip.close()
         if self._bcast_thread is not None:
@@ -1676,6 +1775,8 @@ class Server:
         uri_by_id = {n.id: n.uri for n in self.cluster.nodes}
         if job.node is not None:
             uri_by_id.setdefault(job.node.id, job.node.uri)
+        self.events.emit("resize.start", job=job.id, event=job.event,
+                         node=job.node_id)
         self._arm_watchdog(job.id)
         schema = self.holder.schema()
         # cluster-wide available-shards state rides along so a joining node
@@ -1815,6 +1916,8 @@ class Server:
             return
         if self._resize_watchdog is not None:
             self._resize_watchdog.cancel()
+        self.events.emit("resize.complete", job=job.id, event=job.event,
+                         node=job.node_id)
         if job.event == EVENT_LEAVE:
             # the departed node's queued hints are never deliverable
             self.hints.drop_target(job.node_id)
@@ -1860,6 +1963,7 @@ class Server:
     def _resize_aborted(self) -> None:
         """Un-wedge peers stuck in RESIZING, then try the next queued
         membership event (an aborted join self-heals by re-knocking)."""
+        self.events.emit("resize.abort")
         self._broadcast_state(self.cluster.state)
         self._drain_pending_resizes()
 
@@ -1920,8 +2024,15 @@ class Server:
             return
         nodes = [Node.from_dict(d) for d in nodes_d
                  if d["id"] not in self._removed_ids]
+        before = {n.id for n in self.cluster.nodes}
         self.cluster.set_static(nodes)
         self.cluster.elect_coordinator()
+        after = {n.id for n in self.cluster.nodes}
+        if after != before:
+            self.events.emit("topology.change",
+                             nodes=sorted(after),
+                             added=sorted(after - before),
+                             removed=sorted(before - after))
         self.clean_holder()
 
     def clean_holder(self) -> int:
@@ -2062,6 +2173,9 @@ class Server:
         raw["hints.dropped"] = hsnap["dropped"]
         g["drain.draining"] = 1.0 if self.draining else 0.0
         raw["drain.shed"] = self.handler.drain_sheds
+        esnap = self.events.snapshot()
+        raw["events.emitted"] = esnap["emitted"]
+        g["events.retained"] = float(sum(esnap["retained"].values()))
         g["fence.fenced_shards"] = float(
             ex.fence_snapshot()["fencedShards"])
         wal_bytes = 0
@@ -2147,6 +2261,7 @@ class Server:
         g["hints.replayed_per_s"] = rate("hints.replayed")
         g["hints.dropped_per_s"] = rate("hints.dropped")
         g["drain.shed_per_s"] = rate("drain.shed")
+        g["events.emitted_per_s"] = rate("events.emitted")
         g["hedges.fired_per_s"] = rate("hedges.fired")
         g["ici.slice_local_per_s"] = rate("ici.slice_local")
         g["ici.cross_slice_per_s"] = rate("ici.cross_slice")
@@ -2163,6 +2278,18 @@ class Server:
         g["usage.device_ms_per_s"] = rate("usage.device_ms")
         g["usage.rpc_bytes_per_s"] = rate("usage.rpc_bytes")
         self._telemetry_prev = (raw, now)
+        # health-transition events: the sampler is the one periodic
+        # observer of the shared health score, so a green->yellow->red
+        # (or recovery) edge lands on the flight-recorder timeline with
+        # its reasons exactly once per transition
+        health = self.node_health()
+        if self._last_health is not None \
+                and health["score"] != self._last_health:
+            self.events.emit("health.transition",
+                             fromScore=self._last_health,
+                             toScore=health["score"],
+                             reasons=health["reasons"][:5])
+        self._last_health = health["score"]
         return g
 
     def _health_inputs(self) -> dict:
@@ -2420,6 +2547,61 @@ class Server:
             "asOf": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
         }
 
+    def cluster_events(self, limit: int = 0) -> dict:
+        """The merged cluster timeline (GET /cluster/events): every live
+        peer's /debug/events feed collected CONCURRENTLY and HLC-sorted
+        into one causal event stream (utils/events.py merge_events) —
+        "what happened, in order, across the fleet" from any node. Same
+        degradation contract as cluster_stats: peers that 404 the route
+        are "legacy" (never an error), down peers are skipped without an
+        RPC, transient failures leave the merge partial-but-honest. The
+        RPCs themselves piggyback HLC stamps, so the collecting node's
+        clock catches up to every peer before it sorts."""
+        from pilosa_tpu.utils import events as _events
+
+        docs: dict[str, list[dict]] = {}
+        nodes: list[dict] = []
+        timeout = max(2.0, self.probe_timeout)
+        fetchers: list[tuple] = []
+        for n in list(self.cluster.nodes):
+            if n.id == self.node_id:
+                docs[n.id] = self.events.events(0)
+                nodes.append({"id": n.id, "uri": self.uri,
+                              "status": "ok"})
+                continue
+            if self.cluster.is_down(n.id) or not n.uri:
+                nodes.append({"id": n.id, "uri": n.uri or "",
+                              "status": "down"})
+                continue
+            entry = {"id": n.id, "uri": n.uri, "status": "pending"}
+            nodes.append(entry)
+
+            def fetch(node=n, entry=entry):
+                try:
+                    doc = self.client.debug_events(node.uri, timeout)
+                    docs[node.id] = doc.get("events", [])
+                    entry["status"] = "ok"
+                except ClientError as e:
+                    entry["status"] = ("legacy" if e.status == 404
+                                       else "error")
+                except Exception:  # noqa: BLE001 — never fail the merge
+                    entry["status"] = "error"
+
+            fetchers.append((entry, _threads.spawn(fetch)))
+        for entry, t in fetchers:
+            t.join(timeout + 1.0)
+            if entry["status"] == "pending":
+                entry["status"] = "error"
+        merged = _events.merge_events(docs)
+        if limit > 0:
+            merged = merged[-limit:]
+        return {
+            "events": merged,
+            "nodes": nodes,
+            "generatedBy": self.node_id,
+            "asOf": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        }
+
     def cluster_heat(self) -> dict:
         """The fleet's merged fragment heat map (GET /cluster/heat):
         every live peer's /debug/heat document collected concurrently
@@ -2530,6 +2712,9 @@ class Server:
         rebuilt = self.repair_quarantined()
         merged = self.sync_holder()
         self._scrub_passes += 1
+        self.events.emit("scrub.pass", blocksMerged=merged,
+                         fragmentsRebuilt=rebuilt,
+                         seconds=round(_time.monotonic() - t0, 3))
         self.stats.count("antiEntropy/passes")
         if merged:
             self.stats.count("antiEntropy/blocksMerged", merged)

@@ -120,13 +120,16 @@ class HintStore:
 
     def __init__(self, directory: str, max_bytes: int = 64 << 20,
                  max_age: float = 3600.0, fsync: bool = False,
-                 stats=None, logger=None):
+                 stats=None, logger=None, journal=None):
         self.dir = directory
         self.max_bytes = int(max_bytes)
         self.max_age = float(max_age)
         self.fsync = fsync
         self.stats = stats
         self.logger = logger
+        # flight-recorder journal (utils/events.py EventJournal, set by
+        # Server): hint append/drop land on the merged cluster timeline
+        self.journal = journal
         self._locks: dict[str, threading.Lock] = {}
         self._meta_lock = threading.Lock()
         # cumulative counters (the writeHandoffs/* families)
@@ -154,6 +157,13 @@ class HintStore:
     def _count(self, name: str, n: int = 1) -> None:
         if self.stats is not None:
             self.stats.count(f"writeHandoffs/{name}", n)
+
+    def _journal_emit(self, etype: str, **fields) -> None:
+        if self.journal is not None:
+            try:
+                self.journal.emit(etype, **fields)
+            except Exception:  # noqa: BLE001 — recording must never
+                pass  # break the write path it observes
 
     # -- append (the write path's skip-down branch) -------------------------
 
@@ -199,6 +209,8 @@ class HintStore:
                 with self._meta_lock:
                     self.dropped += 1
                 self._count("dropped")
+                self._journal_emit("hint.drop", target=node_id, index=index,
+                            reason="append-failed")
                 if self.logger is not None:
                     self.logger.printf(
                         "hints: append for %s failed (%s) — write will "
@@ -210,6 +222,12 @@ class HintStore:
             else:
                 self.queued += 1
         self._count("dropped" if dropped else "queued")
+        if dropped:
+            self._journal_emit("hint.drop", target=node_id, index=index,
+                        reason="over-byte-cap")
+        else:
+            self._journal_emit("hint.append", target=node_id, index=index,
+                        bytes=len(payload))
         return not dropped
 
     # -- replay (peer return) ----------------------------------------------

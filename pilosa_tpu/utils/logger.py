@@ -4,6 +4,12 @@
 active trace id as a proper `trace` field — so log lines join the
 query-history / profile surfaces mechanically instead of via the
 `trace=<id>` suffix convention grep'd out of plain lines.
+
+Logger↔journal bridge: when a flight-recorder journal is attached
+(`logger.journal = <EventJournal>`, wired by Server), every `warnf` /
+`errorf` line ALSO lands as a `log.warn` / `log.error` event on the
+merged cluster timeline — in the journal's bounded LOG lane, so a log
+storm can never evict the lifecycle events (utils/events.py).
 """
 
 from __future__ import annotations
@@ -25,6 +31,9 @@ class Logger:
         self.verbose = verbose
         self.fmt = fmt
         self.out = out or sys.stderr
+        # optional flight-recorder bridge (utils/events.py EventJournal):
+        # warn/error lines emit log.warn/log.error journal events
+        self.journal = None
 
     def _trace_id(self) -> Optional[str]:
         # imported lazily: the logger must stay importable from anything
@@ -48,9 +57,23 @@ class Logger:
             line = f"{ts} {level} {msg}"
         self.out.write(line + "\n")
         self.out.flush()
+        if self.journal is not None and level in ("WARN", "ERROR"):
+            try:
+                if level == "WARN":
+                    self.journal.emit("log.warn", msg=msg[:512])
+                else:
+                    self.journal.emit("log.error", msg=msg[:512])
+            except Exception:  # noqa: BLE001 — logging must never raise
+                pass
 
     def printf(self, fmt: str, *args) -> None:
         self._emit("INFO", fmt, *args)
+
+    def warnf(self, fmt: str, *args) -> None:
+        self._emit("WARN", fmt, *args)
+
+    def errorf(self, fmt: str, *args) -> None:
+        self._emit("ERROR", fmt, *args)
 
     def debugf(self, fmt: str, *args) -> None:
         if self.verbose:
@@ -59,6 +82,8 @@ class Logger:
 
 class NopLogger:
     def printf(self, fmt, *args): pass
+    def warnf(self, fmt, *args): pass
+    def errorf(self, fmt, *args): pass
     def debugf(self, fmt, *args): pass
 
 

@@ -193,6 +193,9 @@ class XLACounters:
         self.storm_n = storm_n
         self.storm_window_s = storm_window_s
         self.log_fn = None  # printf-style sink; warnings.warn fallback
+        # flight-recorder hook (utils/events.py; set by Server):
+        # event_fn(family, new_shapes_in_window) on each storm trip
+        self.event_fn = None
         self._lock = threading.Lock()
         self._families: dict[str, dict] = {}
         self.storms = 0
@@ -210,6 +213,7 @@ class XLACounters:
         """Count one dispatch; returns True when it was a (re)compile."""
         now = time.monotonic()
         storm_msg = None
+        storm_shapes = 0
         with self._lock:
             f = self._family(family)
             if key in f["keys"]:
@@ -226,6 +230,7 @@ class XLACounters:
                 f["last_storm"] = now
                 f["storms"] += 1
                 self.storms += 1
+                storm_shapes = len(rec)
                 storm_msg = (
                     f"telemetry: XLA recompile storm: kernel family "
                     f"{family!r} compiled {len(rec)} new program shapes in "
@@ -234,6 +239,11 @@ class XLACounters:
                     f"latency cliffs until shapes stabilize")
         if storm_msg is not None:
             self._warn(storm_msg)
+            if self.event_fn is not None:
+                try:
+                    self.event_fn(family, storm_shapes)
+                except Exception:  # noqa: BLE001 — recording must never
+                    pass  # break the dispatch path
         return True
 
     def _warn(self, msg: str) -> None:

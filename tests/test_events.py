@@ -1,0 +1,474 @@
+"""Cluster flight recorder: HLC causality, journal ring discipline,
+crash forensics, and the merged /cluster/events timeline.
+
+The acceptance contract (ISSUE 14): a 3-node rolling restart under
+artificially SKEWED node wall clocks reconstructs as ONE merged cluster
+timeline with drain → hint append → replay → fence → parity-lift events
+in causal order and zero HLC inversions — wall-clock order would shuffle
+them, the hybrid logical clock must not.
+"""
+
+import json
+import os
+import signal
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from pilosa_tpu.constants import SHARD_WIDTH
+from pilosa_tpu.server import Server
+from pilosa_tpu.utils import events as ev
+from pilosa_tpu.utils.events import (
+    EventJournal,
+    HybridLogicalClock,
+    decode_hlc,
+    encode_hlc,
+    hlc_sort_key,
+    merge_events,
+)
+
+
+def http(method, uri, path, body=None, timeout=20):
+    req = urllib.request.Request(uri + path, data=body, method=method)
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, dict(resp.headers), resp.read()
+    except urllib.error.HTTPError as e:
+        return e.code, dict(e.headers), e.read()
+
+
+def jpost(uri, path, payload=None, raw=None):
+    body = raw if raw is not None else (
+        json.dumps(payload).encode() if payload is not None else b"")
+    status, headers, out = http("POST", uri, path, body)
+    return status, headers, json.loads(out) if out else {}
+
+
+def jget(uri, path):
+    status, headers, out = http("GET", uri, path)
+    return status, headers, json.loads(out) if out else {}
+
+
+def wait_until(fn, timeout=30.0, interval=0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            if fn():
+                return True
+        except Exception:  # noqa: BLE001 — condition not ready yet
+            pass
+        time.sleep(interval)
+    return False
+
+
+# -- hybrid logical clock ----------------------------------------------------
+
+
+def test_hlc_monotonic_under_backward_wall_step():
+    """A stepped-back wall clock stalls the physical half; the logical
+    half keeps every stamp strictly increasing."""
+    walls = iter([1000, 2000, 1500, 1500, 900, 3000])
+    clock = HybridLogicalClock(wall_ms=lambda: next(walls))
+    stamps = [clock.now() for _ in range(6)]
+    assert stamps == sorted(stamps)
+    assert len(set(stamps)) == len(stamps)  # strictly increasing
+    assert stamps[1] == (2000, 0)
+    assert stamps[2] == (2000, 1)  # wall went backwards: logical ticks
+    assert stamps[5] == (3000, 0)  # wall caught up: logical resets
+
+
+def test_hlc_update_merges_remote_ahead_and_behind():
+    clock = HybridLogicalClock(wall_ms=lambda: 1000)
+    local = clock.now()
+    # remote far ahead (fast peer clock): adopt physical, logical+1
+    got = clock.update((999_999, 7))
+    assert got == (999_999, 8) and got > local
+    # remote behind: keep physical, logical ticks past both
+    got2 = clock.update((500, 3))
+    assert got2 > got and got2[0] == 999_999
+    # garbage merges as a plain local tick, never raises
+    got3 = clock.update("garbage")
+    assert got3 > got2
+
+
+def test_hlc_encode_decode_roundtrip_and_garbage():
+    assert decode_hlc(encode_hlc((123, 4))) == (123, 4)
+    assert decode_hlc(encode_hlc((123, 0))) == (123, 0)
+    assert decode_hlc(None) is None
+    assert decode_hlc("") is None
+    assert decode_hlc("not-a-stamp") is None
+    assert decode_hlc("1.2.3") is None
+    assert decode_hlc(12) is None
+
+
+def test_hlc_causal_chain_survives_hours_of_skew():
+    """Three nodes with wall clocks hours apart exchange messages; every
+    receive-side event must sort after the send-side event that caused
+    it (ZERO inversions) — wall-clock order would interleave them."""
+    import random
+    rng = random.Random(7)
+    offsets = {"a": -7200_000, "b": 0, "c": +7200_000}
+    base = [1_000_000_000_000]
+
+    def wall(node):
+        return lambda: base[0] + offsets[node]
+
+    clocks = {n: HybridLogicalClock(wall_ms=wall(n)) for n in offsets}
+    events = []  # (stamp, node, kind, chain-id)
+    for i in range(200):
+        base[0] += rng.randint(0, 50)  # real time creeps forward
+        src, dst = rng.sample(list(clocks), 2)
+        sent = clocks[src].now()
+        events.append((sent, src, "send", i))
+        recv = clocks[dst].update(sent)
+        events.append((recv, dst, "recv", i))
+        assert recv > sent, (sent, recv, src, dst)
+    # the merged order (hlc, node tiebreak) keeps every send before its
+    # receive — the acceptance "zero HLC inversions" property
+    merged = sorted(events, key=lambda e: (e[0], e[1]))
+    for i in range(200):
+        s = merged.index(next(e for e in merged
+                              if e[3] == i and e[2] == "send"))
+        r = merged.index(next(e for e in merged
+                              if e[3] == i and e[2] == "recv"))
+        assert s < r
+
+
+# -- journal ring ------------------------------------------------------------
+
+
+def test_emit_unregistered_type_raises():
+    j = EventJournal(node_id="n")
+    with pytest.raises(ValueError, match="unregistered event type"):
+        j.emit("made.up.type")
+
+
+def test_ring_bounds_and_since_cursor():
+    j = EventJournal(node_id="n", ring_size=8)
+    for i in range(20):
+        j.emit("scrub.pass", blocksMerged=i)
+    assert len(j) == 8  # bounded
+    doc = j.since(0)
+    assert doc["seq"] == 20
+    assert [e["blocksMerged"] for e in doc["events"]] == list(range(12, 20))
+    # cursor: nothing new -> empty, seq still advances the poller
+    again = j.since(doc["seq"])
+    assert again["events"] == [] and again["seq"] == 20
+    j.emit("scrub.pass", blocksMerged=99)
+    assert [e["blocksMerged"]
+            for e in j.since(doc["seq"])["events"]] == [99]
+    # limit keeps the newest
+    assert [e["blocksMerged"]
+            for e in j.since(0, limit=2)["events"]] == [20 - 1, 99]
+    snap = j.snapshot()
+    assert snap["emitted"] == 21
+    assert snap["evicted"]["lifecycle"] == 13
+    assert snap["byType"]["scrub.pass"] == 21
+
+
+def test_log_storm_cannot_evict_lifecycle_events():
+    """Separate severity lanes: a log.warn storm fills only the log
+    lane; the lifecycle events an incident reconstruction needs stay."""
+    j = EventJournal(node_id="n", ring_size=16)
+    j.emit("drain.start")
+    j.emit("hint.append", target="x")
+    for i in range(500):
+        j.emit("log.warn", msg=f"storm {i}")
+    types = [e["type"] for e in j.events(0)]
+    assert "drain.start" in types and "hint.append" in types
+    # the log lane stayed at its own (quarter) bound
+    assert types.count("log.warn") == 4
+    assert j.snapshot()["evicted"]["log"] == 496
+    # severity filter separates the lanes on the feed
+    assert all(e["type"] in ("drain.start", "hint.append")
+               for e in j.since(0, severity="lifecycle")["events"])
+    assert all(e["type"] == "log.warn"
+               for e in j.since(0, severity="log")["events"])
+
+
+def test_kill_switch_stops_recording(monkeypatch):
+    j = EventJournal(node_id="n")
+    monkeypatch.setenv("PILOSA_TPU_EVENTS", "0")
+    assert j.emit("drain.start") is None
+    assert len(j) == 0 and j.snapshot()["droppedDisabled"] == 1
+    monkeypatch.setenv("PILOSA_TPU_EVENTS", "1")
+    assert j.emit("drain.start") is not None
+    assert len(j) == 1
+
+
+def test_spool_is_bounded_with_one_rotation(tmp_path):
+    spool = str(tmp_path / "events.spool.jsonl")
+    j = EventJournal(node_id="n", spool_path=spool, spool_max_bytes=2000)
+    for i in range(200):
+        j.emit("scrub.pass", blocksMerged=i)
+    assert os.path.getsize(spool) <= 2000
+    assert os.path.exists(spool + ".1")
+    assert os.path.getsize(spool + ".1") <= 2200  # cap + one record
+    # spooled lines are valid JSONL carrying the stamp
+    with open(spool) as f:
+        recs = [json.loads(line) for line in f]
+    assert recs and all(r["type"] == "scrub.pass" and "hlc" in r
+                        for r in recs)
+    assert j.snapshot()["spoolErrors"] == 0
+    # a new journal on the same spool reloads the tail at boot (the
+    # restarted-node contract: pre-restart lifecycle stays on the
+    # timeline) and new events sort after every reloaded one
+    j2 = EventJournal(node_id="n", spool_path=spool,
+                      spool_max_bytes=2000)
+    reloaded = j2.events(0)
+    assert reloaded and j2.snapshot()["reloaded"] == len(reloaded)
+    assert reloaded[-1]["blocksMerged"] == 199
+    fresh = j2.emit("drain.start")
+    assert hlc_sort_key(fresh) > hlc_sort_key(reloaded[-1])
+
+
+def test_dump_and_merge_events(tmp_path):
+    a = EventJournal(node_id="a",
+                     clock=HybridLogicalClock(wall_ms=lambda: 1000))
+    b = EventJournal(node_id="b",
+                     clock=HybridLogicalClock(wall_ms=lambda: 2000))
+    a.emit("drain.start")
+    b.clock.update(a.clock.peek())
+    b.emit("peer.draining", peer="a")
+    merged = merge_events({"a": a.events(0), "b": b.events(0)})
+    assert [e["type"] for e in merged] == ["drain.start", "peer.draining"]
+    assert merged == sorted(merged, key=hlc_sort_key)
+    path = str(tmp_path / "dump.jsonl")
+    assert a.dump(path) == 1
+    with open(path) as f:
+        assert json.loads(f.readline())["type"] == "drain.start"
+
+
+def test_crash_dump_spills_on_sigquit(tmp_path):
+    """The crash-forensics contract: SIGQUIT spills every registered
+    journal's ring to events.crash-<ts>.jsonl next to its data dir."""
+    j = EventJournal(node_id="crashy")
+    j.emit("drain.start")
+    j.emit("log.error", msg="about to die")
+    prev = signal.getsignal(signal.SIGQUIT)
+    ev.register_crash_dump(j, str(tmp_path))
+    try:
+        os.kill(os.getpid(), signal.SIGQUIT)
+        assert wait_until(lambda: any(
+            n.startswith("events.crash-") for n in os.listdir(tmp_path)),
+            timeout=10)
+        name = next(n for n in os.listdir(tmp_path)
+                    if n.startswith("events.crash-"))
+        with open(tmp_path / name) as f:
+            types = [json.loads(line)["type"] for line in f]
+        assert types == ["drain.start", "log.error"]
+    finally:
+        ev.unregister_crash_dump(j)
+        signal.signal(signal.SIGQUIT, prev)
+        ev._CRASH_INSTALLED = False
+
+
+# -- live cluster ------------------------------------------------------------
+
+
+SKEWS_MS = {0: -7_200_000, 1: 0, 2: +7_200_000}  # ±2h of wall skew
+
+
+def _skew(server, offset_ms):
+    """Give a server's flight-recorder clock a deliberately wrong wall
+    (every stamp it mints from now on leans by offset_ms)."""
+    server.clock._wall_ms = (
+        lambda off=offset_ms: int(time.time() * 1000) + off)  # wall-clock: test skew injection
+
+
+@pytest.fixture
+def trio(tmp_path):
+    """3-node replica-2 cluster with ±2h wall skew; node index 2 runs
+    with the flight-recorder route 404ing like a legacy build."""
+    servers = []
+    for i in range(3):
+        s = Server(str(tmp_path / f"n{i}"), port=0, replica_n=2,
+                   node_id=chr(ord("a") + i), events_spool=1 << 20)
+        _skew(s, SKEWS_MS[i])
+        s.open()
+        servers.append(s)
+    uris = [s.uri for s in servers]
+    for s in servers:
+        s.cluster_hosts = uris
+        s.refresh_membership()
+    yield servers
+    for s in servers:
+        try:
+            s.close()
+        except Exception:  # noqa: BLE001 — some were restarted/closed
+            pass
+
+
+def test_debug_events_feed_and_hlc_response_header(trio):
+    s0 = trio[0]
+    st, headers, doc = jget(s0.uri, "/debug/events")
+    assert st == 200 and doc["enabled"] is True
+    types = [e["type"] for e in doc["events"]]
+    assert "node.start" in types
+    assert all(e["node"] == s0.node_id for e in doc["events"])
+    # every response piggybacks the node's HLC stamp
+    stamp = decode_hlc(headers.get("X-Pilosa-HLC"))
+    assert stamp is not None and stamp[0] > 0
+    # cursor discipline: nothing new after the reported seq
+    st, _h, doc2 = jget(s0.uri, f"/debug/events?since={doc['seq']}")
+    assert st == 200 and doc2["events"] == []
+    # filters validate
+    st, _h, _ = jget(s0.uri, "/debug/events?severity=bogus")
+    assert st == 400
+    st, _h, _ = jget(s0.uri, "/debug/events?type=not.registered")
+    assert st == 400
+
+
+def test_cluster_events_merges_and_degrades_legacy_peer(trio):
+    s0, s1, s2 = trio
+
+    def _legacy_404(params, query, body):
+        return 404, "application/json", b'{"error": "not found"}'
+
+    s2.handler.get_debug_events = _legacy_404
+    st, _h, doc = jget(s0.uri, "/cluster/events")
+    assert st == 200
+    by_id = {n["id"]: n["status"] for n in doc["nodes"]}
+    assert by_id == {"a": "ok", "b": "ok", "c": "legacy"}
+    nodes_seen = {e["node"] for e in doc["events"]}
+    assert nodes_seen == {"a", "b"}  # the legacy peer contributes none
+    # merged stream is HLC-sorted (causal order, node-id tiebreak)
+    keys = [hlc_sort_key(e) for e in doc["events"]]
+    assert keys == sorted(keys)
+
+
+def test_events_observability_surfaces(trio):
+    s0 = trio[0]
+    st, _h, dv = jget(s0.uri, "/debug/vars")
+    assert st == 200
+    assert dv["events"]["emitted"] >= 1
+    assert "node.start" in dv["events"]["byType"]
+    st, _h2, text = http("GET", s0.uri, "/metrics")
+    assert st == 200
+    body = text.decode()
+    assert 'pilosa_events_total{type="node.start"} 1' in body
+    # the full registered keyspace exists, zeros included
+    assert 'pilosa_events_total{type="qos.quota_debt"} 0' in body
+    # the dashboard panel rides the same feed (air-gapped page)
+    st, _h3, page = http("GET", s0.uri, "/debug/dashboard")
+    assert st == 200 and b"/debug/events?since=" in page
+
+
+def test_rolling_restart_reconstructs_one_causal_timeline(trio, tmp_path):
+    """THE acceptance criterion: a rolling restart (drain → writes acked
+    while the replica is away → rejoin → hint replay → fence lift) under
+    ±2h wall skew reconstructs as ONE merged timeline with
+    drain.start → hint.append → fence.armed → hint.replay →
+    fence.lifted in causal order, zero HLC inversions."""
+    s0, s1, s2 = trio
+    uris = [s.uri for s in trio]
+    # seed a few shards so the restarted node has fragments to fence
+    jpost(s0.uri, "/index/rr", {})
+    jpost(s0.uri, "/index/rr/field/f", {})
+    for shard in range(3):
+        for k in range(4):
+            col = shard * SHARD_WIDTH + 50 + k
+            st, _h, out = jpost(s0.uri, "/index/rr/query",
+                                raw=f"Set({col}, f=7)".encode())
+            assert st == 200 and out["results"] == [True]
+
+    # drain node c (the +2h fast clock), then the process goes away
+    port = s2.http.port
+    st, _h, out = jpost(s2.uri, "/cluster/drain")
+    assert st == 200
+    assert wait_until(lambda: s2.drained, timeout=20)
+    s2.close()
+
+    # writes acked while c is away ride the hint path
+    acked = []
+    for k in range(9):
+        col = (k % 3) * SHARD_WIDTH + 900 + k
+        st, _h, out = jpost(trio[k % 2].uri, "/index/rr/query",
+                            raw=f"Set({col}, f=9)".encode())
+        assert st == 200 and out["results"] == [True]
+        acked.append(col)
+    assert (s0.hints.snapshot()["queued"]
+            + s1.hints.snapshot()["queued"]) >= 1
+
+    # restart on the same port/data (skewed again): rejoin broadcast →
+    # hint replay from peers → read fence verifies and lifts
+    # the durable spool reloads at boot, so the restarted process still
+    # carries its pre-restart drain.start/drain.complete on the timeline
+    s2b = Server(str(tmp_path / "n2"), port=port, replica_n=2,
+                 node_id="c", events_spool=1 << 20)
+    _skew(s2b, SKEWS_MS[2])
+    s2b.cluster_hosts = uris
+    s2b.open()
+    trio[2] = s2b  # fixture teardown closes the restarted instance
+    assert wait_until(
+        lambda: (s0.hints.snapshot()["pendingBytes"] == 0
+                 and s1.hints.snapshot()["pendingBytes"] == 0
+                 and s2b.executor.fence_snapshot()["fencedShards"] == 0),
+        timeout=30)
+
+    # ONE merged cluster timeline from any node
+    st, _h, doc = jget(s0.uri, "/cluster/events")
+    assert st == 200
+    assert {n["id"]: n["status"] for n in doc["nodes"]} == {
+        "a": "ok", "b": "ok", "c": "ok"}
+    merged = doc["events"]
+    keys = [hlc_sort_key(e) for e in merged]
+    assert keys == sorted(keys)
+
+    # zero HLC inversions, part 1: each node's own events keep their
+    # local (seq) order under the HLC sort — the clock never ran
+    # backwards on any node despite the skew
+    for nid in ("a", "b", "c"):
+        own = [e for e in merged if e["node"] == nid]
+        assert [e["seq"] for e in own] == sorted(e["seq"] for e in own)
+
+    # zero HLC inversions, part 2: the causal chain of the restart
+    # appears in order even though the wall clocks disagree by hours
+    def first_idx(etype, **match):
+        for i, e in enumerate(merged):
+            if e["type"] == etype and all(e.get(k) == v
+                                          for k, v in match.items()):
+                return i
+        raise AssertionError(
+            f"event {etype} {match} missing from merged timeline: "
+            f"{[(e['type'], e.get('node')) for e in merged]}")
+
+    i_drain = first_idx("drain.start", node="c")
+    i_draining = first_idx("peer.draining", peer="c")
+    i_append = first_idx("hint.append", target="c")
+    i_complete = first_idx("drain.complete", node="c")
+    i_fence = first_idx("fence.armed", node="c")
+    i_rejoined = first_idx("peer.rejoined", peer="c")
+    i_replay = first_idx("hint.replay", target="c")
+    i_lift = first_idx("fence.lifted", node="c")
+    # the message-driven chain: the drain broadcast precedes the peers'
+    # routing-around and their hint appends; the rejoin (fence armed on
+    # the restarted node, READY broadcast) precedes the peers' replays;
+    # every parity-lift follows the fence arming. hint.replay and the
+    # per-shard lifts are genuinely CONCURRENT (a lift can ride the
+    # block-majority heal while a peer is still streaming its log), so
+    # no order is asserted between them — that's the HLC telling the
+    # truth, not a gap in it.
+    assert i_drain < i_draining < i_append, (i_drain, i_draining,
+                                             i_append)
+    assert i_append < i_fence < i_lift, (i_append, i_fence, i_lift)
+    assert i_drain < i_complete < i_fence, (i_drain, i_complete, i_fence)
+    assert i_fence < i_rejoined < i_replay, (i_fence, i_rejoined,
+                                             i_replay)
+    lifts = [i for i, e in enumerate(merged)
+             if e["type"] == "fence.lifted"]
+    assert len(lifts) == 3 and all(i > i_fence for i in lifts)
+
+    # the acked writes actually survived (the PR-9 contract still holds
+    # with the recorder on)
+    st, _h, out = jpost(s2b.uri, "/index/rr/query", raw=b"Row(f=9)")
+    assert st == 200
+    assert set(out["results"][0]["columns"]) == set(acked)
+
+    # `pilosa-tpu timeline` renders the same merged document
+    from pilosa_tpu.cli.main import render_timeline
+    text = render_timeline(doc)
+    assert "drain.start" in text and "hint.replay" in text
+    assert "3 node(s)" in text

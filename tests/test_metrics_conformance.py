@@ -484,6 +484,45 @@ def test_live_metrics_heat_families(pair):
         if n == "pilosa_residency_total"}
 
 
+def test_live_metrics_events_families(pair):
+    """Flight-recorder PR satellite: the pilosa_events_total{type=...}
+    family is emitted unconditionally for EVERY registered event type
+    (zeros included — an "event rate spiked" alert can never race the
+    first emitted event), plus the eviction counters per lane and the
+    retained/spool gauges, all conforming like everything else."""
+    from pilosa_tpu.utils.events import EVENT_TYPES, LANES
+    servers, uris = pair
+    with urllib.request.urlopen(uris[0] + "/metrics", timeout=10) as r:
+        text = r.read().decode()
+    types, samples = check_conformance(text)
+    assert types["pilosa_events_total"] == "counter"
+    emitted = {l.get("type"): v for n, l, v in samples
+               if n == "pilosa_events_total" and "type" in l}
+    for t in EVENT_TYPES:
+        assert t in emitted, f"event family missing type={t}"
+    # the live server booted, so its node.start is a real nonzero count
+    assert emitted["node.start"] >= 1
+    lanes = {l.get("lane") for n, l, _ in samples
+             if n == "pilosa_events_total" and l.get("key") == "evicted"}
+    assert set(LANES) <= lanes
+    gkeys = {l.get("key") for n, l, _ in samples if n == "pilosa_events"}
+    assert {"retained", "spoolBytes"} <= gkeys
+
+
+def test_event_type_inventory_drift_guard():
+    """Companion to the env-gate/config-knob guards: every event type
+    emitted anywhere under pilosa_tpu/ must be registered in
+    utils/events.py EVENT_TYPES, and every registered type must appear
+    in the docs/operations.md glossary — a future PR cannot add a
+    timeline event operators can't decode."""
+    import os
+
+    from pilosa_tpu.analysis import event_type_findings
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    findings = event_type_findings(root)
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
 def test_stats_registry_drift_guard(pair):
     """Tier-1 drift guard: every counter/gauge/timing name registered in
     the live StatsClient reaches the /metrics exposition — so a future PR
