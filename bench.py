@@ -1887,6 +1887,125 @@ def _keepalive_qps(host: str, path: str, body: bytes, check,
     return clients * per_thread / elapsed
 
 
+def bench_hybrid(tmpdir) -> dict:
+    """Hybrid sparse/dense containers (ISSUE 15): two interleaved A/Bs.
+
+    (a) equal-HBM-budget capacity on a zipf-sparse dataset: a budget
+        sized for only ~6 dense planes, swept twice over a 160-row
+        working set whose cardinalities follow a zipf tail (a few rows
+        above the sparse threshold, most far below — the realistic
+        sparsity regime of the motivation). Reported: resident row
+        leaves and warm-pass hit rate, hybrid vs pure dense. Acceptance:
+        >= 4x resident sparse rows at equal budget.
+    (b) dense-headline guard: the executor-bench query shape over rows
+        ABOVE the threshold, hybrid on vs off interleaved on one
+        executor — enabling hybrid must not touch the dense path
+        (acceptance: warm p50 delta <= 15%, the --compare gate's bound).
+    """
+    import statistics
+
+    from pilosa_tpu.executor import Executor
+    from pilosa_tpu.models import Holder
+
+    shards = 2
+    n_rows = 160
+    holder = Holder(os.path.join(tmpdir, "hybrid")).open()
+    try:
+        idx = holder.create_index("hy", track_existence=False)
+        f = idx.create_field("f")
+        rng = np.random.default_rng(47)
+        sets = {}
+        for r in range(n_rows):
+            # zipf tail: row 0 ~ 30k bits per shard (dense), the bulk of
+            # the tail far below the 4096 sparse threshold
+            per_shard = max(16, int(30000 / (1 + r)))
+            cols = np.concatenate([
+                rng.choice(SHARD_WIDTH, size=per_shard, replace=False)
+                .astype(np.int64) + s * SHARD_WIDTH
+                for s in range(shards)])
+            f.import_bits([r] * cols.size, cols.tolist())
+            sets[r] = cols
+        # budget = 12 planes: the zipf head (7 above-threshold rows)
+        # plus the whole sparse tail fits as hybrid (~7.2 planes), while
+        # the all-dense arm needs 160 planes and scan-thrashes — the
+        # regime the motivation describes (sparse rows wasting the
+        # budget ROADMAP items 2-4 fight over)
+        plane_bytes = shards * (SHARD_WIDTH // 8)
+        budget = 12 * plane_bytes
+
+        def sweep(threshold: int):
+            ex = Executor(holder)
+            ex.plan_cache.enabled = False  # the residency LRU is under test
+            ex.hybrid.threshold = threshold
+            ex.residency.budget = budget
+            for r in range(n_rows):  # cold pass: fill
+                (n,) = ex.execute("hy", f"Count(Row(f={r}))")
+                assert n == sets[r].size
+            before = ex.residency.snapshot()
+            for r in range(n_rows):  # warm pass: who stayed resident?
+                ex.execute("hy", f"Count(Row(f={r}))")
+            after = ex.residency.snapshot()
+            lookups = (after["hits"] + after["misses"]
+                       - before["hits"] - before["misses"])
+            hit_rate = (after["hits"] - before["hits"]) / max(1, lookups)
+            bk = after["by_kind"]
+            resident = (bk.get("sparse", {}).get("entries", 0)
+                        + bk.get("row", {}).get("entries", 0))
+            return resident, round(hit_rate, 4)
+
+        res_hybrid, warm_hybrid = sweep(4096)
+        res_dense, warm_dense = sweep(0)
+        ratio = res_hybrid / max(1, res_dense)
+
+        # (b) dense-headline guard: rows 0..3 are all above the threshold
+        ex = Executor(holder)
+        ex.plan_cache.enabled = False
+        qs = [f"Count(Intersect(Row(f={a}), Row(f={b})))"
+              for a, b in ((0, 1), (1, 2), (2, 3), (0, 3))]
+        for q in qs:  # warm both representations' residency
+            ex.execute("hy", q)
+
+        def round_p50():
+            lat = []
+            for _ in range(6):
+                for q in qs:
+                    t0 = time.perf_counter()
+                    ex.execute("hy", q)
+                    lat.append((time.perf_counter() - t0) * 1e3)
+            return statistics.median(lat)
+
+        on_p50, off_p50 = [], []
+        for _ in range(4):  # interleaved: drift hits both arms alike
+            ex.hybrid.threshold = 4096
+            on_p50.append(round_p50())
+            ex.hybrid.threshold = 0
+            off_p50.append(round_p50())
+        on_med = statistics.median(on_p50)
+        off_med = statistics.median(off_p50)
+        overhead = (on_med / off_med - 1.0) * 100.0
+        return {
+            "metric": "hybrid_capacity_ratio",
+            "value": round(ratio, 2),
+            "unit": "x resident rows at equal HBM budget",
+            "vs_baseline": 0.0,
+            "resident_rows_hybrid": res_hybrid,
+            "resident_rows_dense": res_dense,
+            "warm_hit_rate_hybrid": warm_hybrid,
+            "warm_hit_rate_dense": warm_dense,
+            "budget_planes": 12,
+            "rows": n_rows,
+            "dense_overhead_pct": round(overhead, 2),
+            "dense_on_p50_ms": round(on_med, 3),
+            "dense_off_p50_ms": round(off_med, 3),
+            "path": "zipf-sparse capacity sweep (2 passes x 160 rows, "
+                    "budget = 12 dense planes) hybrid vs dense; dense "
+                    "headline Count(Intersect) interleaved hybrid "
+                    "on/off on above-threshold rows",
+        }
+    finally:
+        holder.close()
+
+
 def bench_distributed(tmpdir) -> dict:
     """Config 5: distributed Intersect+Count over a 3-node cluster — the
     mapReduce fan-out path (executor.go:2183 analog): node 0 executes its
@@ -2548,6 +2667,7 @@ def worker() -> None:
         stage("heat", bench_heat, tmp)
         stage("qos", bench_qos, tmp)
         stage("planner", bench_planner, tmp)
+        stage("hybrid", bench_hybrid, tmp)
         stage("distributed", bench_distributed, tmp)
         stage("ici", bench_ici, tmp)
         stage("rolling_restart", bench_rolling_restart, tmp)
@@ -2815,6 +2935,10 @@ _CRITERIA = [
     (r"^rolling_restart_failed_requests$",
      lambda m: (m["value"] == 0 and not m.get("acked_write_loss"),
                 "0 failed requests and 0 lost acked writes")),
+    (r"^hybrid_capacity_ratio$",
+     lambda m: (m["value"] >= 4.0 and m["dense_overhead_pct"] <= 15.0,
+                ">= 4x resident sparse rows at equal HBM budget AND "
+                "dense headline within the 15% gate with hybrid on")),
 ]
 
 # headline stages for `--compare` and the regression direction of their
@@ -2828,6 +2952,7 @@ _HEADLINE_COMPARE = [
     (r"^bsi_range_sum_p50_ms$", "lower"),
     (r"^http_count_qps$", "higher"),
     (r"^distributed_count_qps_16shard", "higher"),
+    (r"^hybrid_capacity_ratio$", "higher"),
 ]
 
 COMPARE_REGRESSION_PCT = float(os.environ.get(

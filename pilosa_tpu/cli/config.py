@@ -96,9 +96,16 @@ class QueryConfig:
     pushdowns; "off" evaluates written order. plan-cache-bytes bounds the
     generation-keyed device-resident subexpression cache (0 disables).
     The PILOSA_TPU_PLANNER=0 / PILOSA_TPU_PLAN_CACHE=0 env kill switches
-    override both to off (emergency toggles needing no config rollout)."""
+    override both to off (emergency toggles needing no config rollout).
+
+    sparse-threshold: hybrid sparse/dense device containers
+    (docs/operations.md "Hybrid containers") — rows at or below this many
+    set bits per shard upload to HBM as padded sorted-index arrays
+    instead of 128 KiB dense planes; 0 keeps every row dense. The
+    PILOSA_TPU_HYBRID=0 env kill switch wins over any threshold."""
     plan: str = "on"
     plan_cache_bytes: int = 256 * 1024 * 1024
+    sparse_threshold: int = 4096
 
 
 @dataclass
@@ -399,6 +406,7 @@ class Config:
             "[query]",
             f'plan = "{self.query.plan}"',
             f"plan-cache-bytes = {self.query.plan_cache_bytes}",
+            f"sparse-threshold = {self.query.sparse_threshold}",
             "",
             "[qos]",
             f'mode = "{self.qos.mode}"',

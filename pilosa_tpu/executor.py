@@ -151,6 +151,16 @@ class Executor:
         from pilosa_tpu.utils import heat as _heat
         self.heat = _heat.HeatTracker() if _heat.enabled() else None
         self.residency.heat = self.heat
+        # hybrid sparse/dense device containers (parallel/residency.py
+        # HybridManager; ops/bitvector.py sparse kernels): rows at or
+        # below [query] sparse-threshold bits per shard live in HBM as
+        # padded sorted-index arrays instead of dense planes, chosen per
+        # operand by the planner from exact cardinalities
+        # (planner.choose_representation) with promote/demote hysteresis
+        # and heat-informed demotion. PILOSA_TPU_HYBRID=0 / threshold 0
+        # restore pure-dense behavior (read per decision, no restart).
+        from pilosa_tpu.parallel.residency import HybridManager
+        self.hybrid = HybridManager(heat=self.heat)
         # continuous batching of concurrent simple Counts into single
         # device dispatches (parallel/batcher.py); PILOSA_TPU_BATCH=0
         # falls back to one dispatch per query
@@ -574,7 +584,12 @@ class Executor:
         """HBM-resident [S(padded), W] device array for one row via the
         residency manager — shared by bitmap programs, BSI planes and TopN
         recounts. `gens` skips the per-shard generation scan when the
-        caller already computed it (GroupBy slab keys)."""
+        caller already computed it (GroupBy slab keys).
+
+        When the row is already HBM-resident in its SPARSE hybrid form, a
+        dense consumer gets the plane by materializing ON DEVICE from the
+        resident index array (one small kernel, zero host->device bytes)
+        instead of re-uploading 128 KiB per shard."""
         if gens is None:
             gens = self._leaf_gens(index, field_name, view_name, shards,
                                    row_id)
@@ -588,22 +603,126 @@ class Executor:
             # funnels through here, so this is THE read charge site)
             tracker.touch_many([(index.name, field_name, view_name, s)
                                 for s in shards], reads=1)
-        return self.residency.leaf(key, lambda: np.stack([
-            self._cached_row(index, field_name, view_name, s, row_id)
-            for s in shards]))
+
+        def make():
+            hyb = self.hybrid
+            if hyb is not None and hyb.active():
+                from pilosa_tpu.ops import bitvector as bv
+                # probe (no hit/miss accounting) for a resident sparse
+                # twin under the SAME generations: any slot bucket the
+                # chooser could have used
+                card = self._row_max_card(index, field_name, view_name,
+                                          shards, row_id)
+                skey = ("sparse", index.name, field_name, view_name,
+                        row_id, tuple(shards), hyb.pad_slots(max(card, 1)),
+                        gens)
+                sp = self.residency.peek(skey)
+                if sp is not None:
+                    hyb.record_materialize()
+                    return bv.sparse_to_dense(sp, WORDS)
+            return np.stack([
+                self._cached_row(index, field_name, view_name, s, row_id)
+                for s in shards])
+
+        return self.residency.leaf(
+            key, make,
+            put=lambda h: (self.hybrid.record_upload("dense", h.nbytes),
+                           self.runner.put_leaf(h))[1])
+
+    def _row_max_card(self, index: Index, field_name: str, view_name: str,
+                      shards, row_id: int) -> int:
+        """Largest per-shard cardinality of one row — the hybrid sizing
+        statistic (write-maintained, storage/fragment.py row_counts cache:
+        dict probes, not container walks)."""
+        f = index.field(field_name)
+        view = f.view(view_name) if f is not None else None
+        if view is None:
+            return 0
+        best = 0
+        for s in shards:
+            frag = view.fragment(s)
+            if frag is not None:
+                c = frag.row_cardinality(row_id)
+                if c > best:
+                    best = c
+        return best
+
+    def _row_leaf_sparse_dev(self, index: Index, field_name: str,
+                             view_name: str, shards, row_id: int,
+                             gens: tuple, slots: int):
+        """HBM-resident SPARSE row leaf: int32[S(padded), slots] of sorted
+        shard-local column ids, sentinel-padded (ops/bitvector.py) — the
+        hybrid representation for rows below the sparse threshold. Byte
+        cost is the real padded allocation (S · slots · 4), charged to the
+        residency budget like any leaf; pad shards fill with the sentinel
+        through put_leaf's fill parameter so they read as empty."""
+        from pilosa_tpu.ops import bitvector as bv
+        key = ("sparse", index.name, field_name, view_name, row_id,
+               tuple(shards), slots, gens)
+        tracker = self.heat
+        if tracker is not None and tracker.enabled:
+            tracker.touch_many([(index.name, field_name, view_name, s)
+                                for s in shards], reads=1)
+        f = index.field(field_name)
+        view = f.view(view_name) if f is not None else None
+
+        def make():
+            arr = np.full((len(shards), slots), bv.SPARSE_SENTINEL,
+                          dtype=np.int32)
+            for i, s in enumerate(shards):
+                frag = view.fragment(s) if view is not None else None
+                if frag is None:
+                    continue
+                cols = frag.row_columns(row_id)
+                if cols.size:
+                    # a write racing between the sizing read and this one
+                    # can exceed the slot bucket; truncation stays inside
+                    # the engine's existing read-consistency envelope (the
+                    # dense path's per-shard rows tear the same way) and
+                    # the write's generation bump re-keys the next lookup
+                    n = min(cols.size, slots)
+                    arr[i, :n] = cols[:n]
+            return arr
+
+        hyb = self.hybrid
+        return self.residency.leaf(
+            key, make,
+            put=lambda h: (hyb.record_upload("sparse", h.nbytes),
+                           self.runner.put_leaf(
+                               h, fill=bv.SPARSE_SENTINEL))[1])
+
+    def hybrid_snapshot(self) -> dict:
+        """The /debug/vars `hybrid` block + /metrics family source:
+        manager counters (uploads/transitions by representation) merged
+        with the residency manager's live per-kind occupancy."""
+        out = self.hybrid.snapshot()
+        by_kind = self.residency.snapshot()["by_kind"]
+        sp = by_kind.get("sparse", {})
+        dn = by_kind.get("row", {})
+        out["residentSparseLeaves"] = sp.get("entries", 0)
+        out["residentSparseBytes"] = sp.get("bytes", 0)
+        out["residentDenseRowLeaves"] = dn.get("entries", 0)
+        out["residentDenseRowBytes"] = dn.get("bytes", 0)
+        return out
 
     def _compile(self, index: Index, call: Call, shards: list[int]):
-        """Walk the call tree -> (program, leaves) where leaves are
-        HBM-resident device arrays [S, W] from the residency manager."""
+        """Walk the call tree -> (program, leaves, kinds) where leaves are
+        HBM-resident device arrays from the residency manager and kinds[i]
+        marks leaf i "dense" ([S, W] uint32 plane) or "sparse" ([S, slots]
+        int32 sorted-index array — the hybrid representation the planner
+        chose for a low-cardinality row)."""
         leaves: list = []
+        kinds: list = []
         shards_t = tuple(shards)
 
         def leaf(key: tuple, make):
             leaves.append(self.residency.leaf(key, make))
+            kinds.append("dense")
             return ("leaf", len(leaves) - 1)
 
-        def leaf_arr(arr):
+        def leaf_arr(arr, kind: str = "dense"):
             leaves.append(arr)
+            kinds.append(kind)
             return ("leaf", len(leaves) - 1)
 
         def row_leaf(c: Call):
@@ -618,8 +737,16 @@ class Executor:
                             lambda: np.zeros((len(shards), WORDS), dtype=np.uint32))
             if f.options.type == FieldType.BOOL and isinstance(row_val, bool):
                 row_id = 1 if row_val else 0
+            from pilosa_tpu import planner as _planner
+            rep, slots, gens = _planner.choose_representation(
+                self, index, c, field_name, VIEW_STANDARD, shards, row_id)
+            if rep == "sparse":
+                return leaf_arr(self._row_leaf_sparse_dev(
+                    index, field_name, VIEW_STANDARD, shards, row_id,
+                    gens, slots), "sparse")
             return leaf_arr(self._row_leaf_dev(
-                index, field_name, VIEW_STANDARD, shards, row_id))
+                index, field_name, VIEW_STANDARD, shards, row_id,
+                gens=gens))
 
         def range_leaf(c: Call):
             if "_start" in c.args or "_end" in c.args:
@@ -699,7 +826,8 @@ class Executor:
             leaves.append(self.residency.leaf(
                 ("zeros", len(shards)),
                 lambda: np.zeros((len(shards), WORDS), dtype=np.uint32)))
-        return program, leaves
+            kinds.append("dense")
+        return program, leaves, kinds
 
     def _composed_row_dev(self, index: Index, call: Call, shards):
         """Device [S', W] result of a bitmap call tree, through the
@@ -734,8 +862,8 @@ class Executor:
                 return hit
         acct = accounting.current_account.get()
         t0 = _time.perf_counter() if (acct is not None or heat_on) else 0.0
-        program, leaves = self._compile(index, call, shards)
-        dev = self.runner.row_leaves_dev(leaves, program)
+        program, leaves, kinds = self._compile(index, call, shards)
+        dev = self._eval_program_dense(program, leaves, kinds)
         if acct is not None or heat_on:
             # the composed-subtree evaluation is per-query device work the
             # batchers never see — charged as wall time of the compile +
@@ -751,6 +879,33 @@ class Executor:
         if key is not None:
             pc.put(key, dev, dev.nbytes, epoch=epoch)
         return dev
+
+    def _eval_program_dense(self, program, leaves, kinds):
+        """Dense [S', W] result of a compiled program. All-dense programs
+        take the runner's fused path (XLA / Pallas / ICI shard_map);
+        hybrid programs evaluate through the sparse kernel family and
+        materialize the root to a plane only if it is still sparse —
+        downstream consumers (plan cache, Row segments, BSI/GroupBy
+        filter folds) all expect planes."""
+        if "sparse" not in kinds:
+            return self.runner.row_leaves_dev(leaves, program)
+        from pilosa_tpu.ops import bitvector as bv
+        kind, arr = bv.eval_hybrid(
+            program, leaves, kinds, WORDS,
+            sparse_dense_fn=self._sparse_dense_fn())
+        if kind == "sparse":
+            self.hybrid.record_materialize()
+            return bv.sparse_to_dense(arr, WORDS)
+        return arr
+
+    def _sparse_dense_fn(self):
+        """The sparse∩dense kernel implementation: the Pallas blocked
+        variant behind the existing PILOSA_TPU_PALLAS gate, else the XLA
+        gather-and-test (ops/bitvector.py)."""
+        if self.runner.use_pallas:
+            from pilosa_tpu.ops import pallas_kernels
+            return pallas_kernels.sparse_intersect_dense
+        return None
 
     def _heat_call_touch(self, index: Index, call: Call, shards,
                          reads: int = 0, device_ms: float = 0.0) -> None:
@@ -874,7 +1029,27 @@ class Executor:
         import time as _time
 
         from pilosa_tpu.utils import accounting
-        program, leaves = self._compile(index, child, shards)
+        program, leaves, kinds = self._compile(index, child, shards)
+        if "sparse" in kinds:
+            # hybrid program: count through the sparse kernel family — a
+            # sparse root counts its live slots with no plane ever
+            # materialized (the sparse-count pushdown). Skips the batcher
+            # and the dense chain kernel, which both assume uint32 planes.
+            from pilosa_tpu.ops import bitvector as bv
+            acct = accounting.current_account.get()
+            heat_on = self.heat is not None and self.heat.enabled
+            t0 = (_time.perf_counter()
+                  if (acct is not None or heat_on) else 0.0)
+            n = bv.hybrid_count(program, leaves, kinds,
+                                sparse_dense_fn=self._sparse_dense_fn())
+            if acct is not None or heat_on:
+                elapsed_ms = (_time.perf_counter() - t0) * 1e3
+                if acct is not None:
+                    acct.charge(device_ms=elapsed_ms)
+                if heat_on:
+                    self._heat_call_touch(index, child, shards,
+                                          device_ms=elapsed_ms)
+            return n
         if self.batcher is not None:
             # concurrent Counts coalesce into one device dispatch
             # (continuous batching — parallel/batcher.py; the batcher's

@@ -137,6 +137,8 @@ const LOCAL_SERIES = [
   ["planner.reorders_per_s", "planner reorders / s", fmtNum],
   ["ici.slice_local_share", "ICI slice-local share (window)", fmtRatio],
   ["ici.slice_local_per_s", "ICI slice-local / s", fmtNum],
+  ["hybrid.sparse_share", "hybrid sparse upload share (window)", fmtRatio],
+  ["hybrid.sparse_bytes", "hybrid sparse resident bytes", fmtBytes],
   ["usage.queries_per_s", "accounted queries / s", fmtNum],
   ["qos.admitted_per_s", "QoS admitted / s", fmtNum],
   ["qos.shed_per_s", "QoS shed / s", fmtNum],

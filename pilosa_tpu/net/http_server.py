@@ -626,6 +626,12 @@ class Handler:
             pc = getattr(ex, "plan_cache", None)
             if pc is not None:
                 snap["planCache"] = pc.snapshot()
+            # hybrid sparse/dense containers (parallel/residency.py
+            # HybridManager): uploads and promote/demote transitions by
+            # representation, plus live sparse/dense leaf occupancy —
+            # the operator's view of how much HBM the sparse rows return
+            if hasattr(ex, "hybrid_snapshot"):
+                snap["hybrid"] = ex.hybrid_snapshot()
             # fragment heat map (utils/heat.py): top hot/cold fragments,
             # totals, skew — the expvar mirror of GET /debug/heat
             tracker = getattr(ex, "heat", None)
@@ -963,6 +969,28 @@ class Handler:
                 counts["planCache/evictions"] = cs["evictions"]
                 gauges["planCache/bytes"] = cs["bytes"]
                 gauges["planCache/entries"] = cs["entries"]
+            # hybrid sparse/dense containers: the full rep/transition
+            # keyspace emitted unconditionally (zeros included) like the
+            # planner families, so a "sparse share collapsed" alert never
+            # races the first sparse upload for the family to exist
+            if hasattr(ex, "hybrid_snapshot"):
+                hy = ex.hybrid_snapshot()
+                counts["hybrid,rep:sparse"] = hy["sparseUploads"]
+                counts["hybrid,rep:dense"] = hy["denseUploads"]
+                counts["hybrid,transition:promoted"] = hy["promoted"]
+                counts["hybrid,transition:demoted"] = hy["demoted"]
+                counts["hybrid,transition:materialized"] = \
+                    hy["materialized"]
+                gauges["hybridLeaves,rep:sparse"] = \
+                    hy["residentSparseLeaves"]
+                gauges["hybridLeaves,rep:dense"] = \
+                    hy["residentDenseRowLeaves"]
+                gauges["hybridBytes,rep:sparse"] = \
+                    hy["residentSparseBytes"]
+                gauges["hybridBytes,rep:dense"] = \
+                    hy["residentDenseRowBytes"]
+                gauges["hybrid/threshold"] = float(hy["threshold"])
+                gauges["hybrid/enabled"] = 1.0 if hy["enabled"] else 0.0
             # hinted handoff + rejoin fence: emitted unconditionally
             # (zeros included) like the planner families — "hint log
             # growing" / "fence stuck" alerts must never race the first

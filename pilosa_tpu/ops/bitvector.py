@@ -263,6 +263,244 @@ def xor_range(x: jax.Array, mask: jax.Array) -> jax.Array:
 
 
 # ---------------------------------------------------------------------------
+# Hybrid sparse containers: padded sorted-index rows for low-cardinality
+# operands (the roaring array-container idea ported to XLA; arXiv:1402.6407
+# container taxonomy, arXiv:1401.6399 galloping intersection of sorted
+# integer sets). A sparse row leaf is int32[..., K]: sorted shard-local
+# column ids, padded with SPARSE_SENTINEL — K slots of 4 bytes instead of
+# a 128 KiB dense plane, so resident capacity scales with CARDINALITY, not
+# shard width. Kernels broadcast over leading axes like the dense algebra
+# (one row [S, K], or anything stacked above it); every kernel returns
+# sorted sentinel-padded output, so compositions chain freely. The planner
+# chooses representation per operand (pilosa_tpu/planner.py
+# choose_representation) and eval_hybrid() below evaluates a mixed
+# sparse/dense program tree, materializing to dense only where an op
+# demands a plane (Not, wide unions, GroupBy slabs, BSI).
+# ---------------------------------------------------------------------------
+
+# one past the last legal column offset; sorts after every real entry.
+# Fits int32 (SHARD_WIDTH = 2^20), and its word index (SHARD_WIDTH >> 5)
+# is one past the last dense lane, so scatter mode="drop" discards pads.
+SPARSE_SENTINEL = SHARD_WIDTH
+
+# sparse∪sparse output keeps Ka+Kb slots; past this the padded arrays stop
+# being meaningfully cheaper than a plane (W = 32768 lanes) and eval_hybrid
+# densifies the union instead of growing index arrays toward plane size
+SPARSE_UNION_CAP = 1 << 14
+
+
+def _member_in_sorted(vals: jax.Array, ref: jax.Array) -> jax.Array:
+    """Membership of vals[..., Kv] in sorted ref[..., Kr], elementwise
+    bool. One binary probe per value of the SMALLER operand into the
+    larger — the galloping/skewed-intersection regime of 1401.6399 (cost
+    Kv·log Kr, sub-linear in the large side). Sentinel padding never
+    matches (pads in ref are excluded by the value test on vals)."""
+    kv, kr = vals.shape[-1], ref.shape[-1]
+    v2 = vals.reshape(-1, kv)
+    r2 = ref.reshape(-1, kr)
+    pos = jax.vmap(lambda r, v: jnp.searchsorted(r, v))(r2, v2)
+    pos = jnp.minimum(pos, kr - 1)
+    hit = jnp.take_along_axis(r2, pos, axis=-1) == v2
+    return (hit & (v2 < SPARSE_SENTINEL)).reshape(vals.shape)
+
+
+def _resort(vals: jax.Array, keep: jax.Array) -> jax.Array:
+    """Mask non-kept entries to the sentinel and restore sorted order
+    (masking alone breaks it: the sentinel outranks every survivor)."""
+    return jnp.sort(jnp.where(keep, vals, SPARSE_SENTINEL), axis=-1)
+
+
+@counted_jit("sparse")
+def sparse_count(sp: jax.Array) -> jax.Array:
+    """Set-bit count of a sparse row: entries below the sentinel -> int32
+    (the popcount analog; pad shards and pad slots contribute zero)."""
+    return jnp.sum((sp < SPARSE_SENTINEL).astype(jnp.int32), axis=-1)
+
+
+@counted_jit("sparse")
+def sparse_intersect(a: jax.Array, b: jax.Array) -> jax.Array:
+    """sparse ∩ sparse -> sparse[..., min(Ka, Kb)]. Probes the smaller
+    operand's values into the larger (orientation is static — padded
+    widths are trace-time constants), the skewed-cardinality fast path."""
+    if a.shape[-1] > b.shape[-1]:
+        a, b = b, a
+    return _resort(a, _member_in_sorted(a, b))
+
+
+@counted_jit("sparse")
+def sparse_difference(a: jax.Array, b: jax.Array) -> jax.Array:
+    """sparse &~ sparse -> sparse[..., Ka]: a's entries absent from b."""
+    keep = ~_member_in_sorted(a, b) & (a < SPARSE_SENTINEL)
+    return _resort(a, keep)
+
+
+def _dense_bit_test(sp: jax.Array, dense: jax.Array) -> jax.Array:
+    """Gather-and-test: for each sparse entry, its bit in the dense
+    operand (the sparse∩dense primitive — K word gathers instead of a
+    W-lane bitwise pass). Sentinel slots test the last real lane and are
+    masked out by the range check."""
+    safe = jnp.minimum(sp, SPARSE_SENTINEL - 1)
+    w = jnp.take_along_axis(dense, safe >> 5, axis=-1)
+    bit = (w >> (safe & 31).astype(jnp.uint32)) & jnp.uint32(1)
+    return (bit != 0) & (sp < SPARSE_SENTINEL)
+
+
+@counted_jit("sparse")
+def sparse_intersect_dense(sp: jax.Array, dense: jax.Array) -> jax.Array:
+    """sparse ∩ dense -> sparse[..., K] via gather-and-test."""
+    return _resort(sp, _dense_bit_test(sp, dense))
+
+
+@counted_jit("sparse")
+def sparse_difference_dense(sp: jax.Array, dense: jax.Array) -> jax.Array:
+    """sparse &~ dense -> sparse[..., K]."""
+    keep = ~_dense_bit_test(sp, dense) & (sp < SPARSE_SENTINEL)
+    return _resort(sp, keep)
+
+
+@counted_jit("sparse")
+def sparse_dense_count(sp: jax.Array, dense: jax.Array) -> jax.Array:
+    """popcount(sparse ∩ dense) -> int32[...] without materializing the
+    intersection (the Count(Intersect(sparse_row, dense_mask)) pushdown)."""
+    return jnp.sum(_dense_bit_test(sp, dense).astype(jnp.int32), axis=-1)
+
+
+def _merge_sorted(a: jax.Array, b: jax.Array):
+    """(merged[..., Ka+Kb], dup_prev, dup_next): sorted concatenation with
+    adjacent-duplicate masks. Inputs are sorted-unique per row, so a value
+    present in both appears as exactly one adjacent pair."""
+    srt = jnp.sort(jnp.concatenate([a, b], axis=-1), axis=-1)
+    edge = jnp.full(srt.shape[:-1] + (1,), -1, dtype=srt.dtype)
+    dup_prev = srt == jnp.concatenate([edge, srt[..., :-1]], axis=-1)
+    dup_next = srt == jnp.concatenate([srt[..., 1:], edge], axis=-1)
+    return srt, dup_prev, dup_next
+
+
+@counted_jit("sparse")
+def sparse_union(a: jax.Array, b: jax.Array) -> jax.Array:
+    """sparse ∪ sparse -> sparse[..., Ka+Kb] (drop the second copy of
+    every duplicated value)."""
+    srt, dup_prev, _ = _merge_sorted(a, b)
+    return _resort(srt, ~dup_prev & (srt < SPARSE_SENTINEL))
+
+
+@counted_jit("sparse")
+def sparse_xor(a: jax.Array, b: jax.Array) -> jax.Array:
+    """sparse ^ sparse -> sparse[..., Ka+Kb] (keep values appearing in
+    exactly one operand)."""
+    srt, dup_prev, dup_next = _merge_sorted(a, b)
+    keep = ~dup_prev & ~dup_next & (srt < SPARSE_SENTINEL)
+    return _resort(srt, keep)
+
+
+@counted_jit("sparse", static_argnames=("n_words",))
+def sparse_to_dense(sp: jax.Array, n_words: int) -> jax.Array:
+    """Materialize sparse[..., K] -> dense uint32[..., n_words] — the
+    bridge for ops that need planes (Not, GroupBy slabs, BSI folds, the
+    final Row result). Entries are unique per row, so the per-word
+    scatter-add assembles distinct bits without carries; sentinel slots
+    index one word past the plane and mode=\"drop\" discards them."""
+    lead, k = sp.shape[:-1], sp.shape[-1]
+    flat = sp.reshape(-1, k)
+
+    def one(idx):
+        bit = jnp.uint32(1) << (idx & 31).astype(jnp.uint32)
+        return jnp.zeros((n_words,), jnp.uint32).at[idx >> 5].add(
+            bit, mode="drop")
+
+    return jax.vmap(one)(flat).reshape(*lead, n_words)
+
+
+def sparse_from_columns(columns: np.ndarray, slots: int) -> np.ndarray:
+    """Host-side builder: sorted shard-local offsets -> one padded sparse
+    row int32[slots] (the dense_from_columns analog)."""
+    out = np.full(slots, SPARSE_SENTINEL, dtype=np.int32)
+    cols = np.sort(np.asarray(columns, dtype=np.int64))
+    n = min(cols.size, slots)
+    out[:n] = cols[:n]
+    return out
+
+
+def eval_hybrid(program, leaves: list, kinds: list,
+                n_words: int = SHARD_WIDTH // WORD_BITS,
+                sparse_dense_fn=None):
+    """Evaluate a nested-tuple bitmap program over MIXED sparse/dense
+    leaves -> (kind, device array). The representation flows bottom-up:
+    intersections against a sparse operand stay sparse (galloping /
+    gather-and-test), differences keep the left operand's kind, unions of
+    two small sparse rows stay sparse until SPARSE_UNION_CAP, and Not —
+    whose complement is dense by construction — materializes. Dispatched
+    eagerly per node (operand shapes differ per node, so one fused program
+    would recompile per query shape anyway); each kernel is a tiny K-slot
+    pass. `sparse_dense_fn` swaps the sparse∩dense kernel (the Pallas
+    blocked variant plugs in here, ops/pallas_kernels.py) so the gated
+    path cannot drift from the XLA contract."""
+    sd = sparse_dense_fn or sparse_intersect_dense
+
+    def dense_of(kind, arr):
+        return sparse_to_dense(arr, n_words) if kind == "sparse" else arr
+
+    def ev(p):
+        op = p[0]
+        if op == "leaf":
+            return kinds[p[1]], leaves[p[1]]
+        if op == "not":
+            k, a = ev(p[1])
+            return "dense", bnot(dense_of(k, a))
+        k, acc = ev(p[1])
+        for q in p[2:]:
+            k2, x = ev(q)
+            if op == "and":
+                if k == "sparse" and k2 == "sparse":
+                    acc = sparse_intersect(acc, x)
+                elif k == "sparse":
+                    acc = sd(acc, x)
+                elif k2 == "sparse":
+                    acc, k = sd(x, acc), "sparse"
+                else:
+                    acc = band(acc, x)
+            elif op == "andnot":
+                if k == "sparse" and k2 == "sparse":
+                    acc = sparse_difference(acc, x)
+                elif k == "sparse":
+                    acc = sparse_difference_dense(acc, x)
+                else:
+                    acc = bandnot(acc, dense_of(k2, x))
+            elif op in ("or", "xor"):
+                if (k == "sparse" and k2 == "sparse"
+                        and acc.shape[-1] + x.shape[-1] <= SPARSE_UNION_CAP):
+                    acc = (sparse_union if op == "or" else sparse_xor)(acc, x)
+                else:
+                    acc = (bor if op == "or" else bxor)(
+                        dense_of(k, acc), dense_of(k2, x))
+                    k = "dense"
+            else:
+                raise ValueError(f"unknown op {op!r}")
+        return k, acc
+
+    return ev(program)
+
+
+def hybrid_count(program, leaves: list, kinds: list,
+                 sparse_dense_fn=None) -> int:
+    """Total count of a mixed sparse/dense program — sparse results count
+    their live slots (no plane ever materializes), dense results popcount.
+
+    The reduction stays PER-SHARD on device and sums on host: every
+    hybrid kernel is per-shard local (zero collectives), so on a mesh the
+    sharded program partitions with no cross-device dependencies and
+    concurrent request threads can dispatch freely — a device-side total
+    would insert a GSPMD all-reduce, and concurrent all-reduce programs
+    from independent threads interleave across devices and deadlock
+    (the dense path funnels concurrent counts through the single-threaded
+    batcher for exactly this reason)."""
+    kind, arr = eval_hybrid(program, leaves, kinds,
+                            sparse_dense_fn=sparse_dense_fn)
+    per_shard = sparse_count(arr) if kind == "sparse" else popcount(arr)
+    return int(np.asarray(per_shard).sum())
+
+
+# ---------------------------------------------------------------------------
 # Host <-> device conversion (numpy, zero-copy friendly).
 # ---------------------------------------------------------------------------
 

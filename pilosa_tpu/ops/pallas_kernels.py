@@ -267,6 +267,56 @@ def pair_stream_counts(rows: jax.Array, ii: jax.Array,
     return out[:, 0, 0]
 
 
+# -- hybrid sparse containers -------------------------------------------------
+# The sparse∩dense gather-and-test (ops/bitvector.py sparse_intersect_dense)
+# with explicit shard blocking: one (shard-block) step holds the [blk, K]
+# index tile and the [blk, W] dense tile in VMEM and emits the masked index
+# tile — the dense operand streams HBM->VMEM double-buffered instead of
+# relying on XLA's gather fusion. Plugs into bitvector.eval_hybrid as
+# `sparse_dense_fn` (PILOSA_TPU_PALLAS=1), so the gated path shares the
+# sentinel/sort contract with the XLA form and cannot drift.
+
+
+def _sparse_dense_kernel(a_ref, b_ref, out_ref):
+    from pilosa_tpu.ops.bitvector import SPARSE_SENTINEL
+
+    idx = a_ref[...]                                   # [blk, K] int32
+    dense = b_ref[...]                                 # [blk, W] uint32
+    safe = jnp.minimum(idx, SPARSE_SENTINEL - 1)
+    w = jnp.take_along_axis(dense, safe >> 5, axis=-1)
+    bit = (w >> (safe & 31).astype(jnp.uint32)) & jnp.uint32(1)
+    hit = (bit != 0) & (idx < SPARSE_SENTINEL)
+    out_ref[...] = jnp.where(hit, idx, SPARSE_SENTINEL)
+
+
+@jax.jit
+def sparse_intersect_dense(sp: jax.Array, dense: jax.Array) -> jax.Array:
+    """int32[S, K] sparse row x uint32[S, W] dense plane -> sorted
+    sentinel-padded int32[S, K] intersection — the Pallas form of
+    bitvector.sparse_intersect_dense (parity tested in tests/test_hybrid.py).
+    Zero-padded pad shards are harmless: a pad index 0 tests bit 0 of a
+    zero dense pad row, misses, and masks to the sentinel."""
+    from pilosa_tpu.ops.bitvector import SPARSE_SENTINEL  # noqa: F401
+
+    s, k = sp.shape
+    w = dense.shape[-1]
+    sp_p, dense_p = _pad_shards(sp, 0), _pad_shards(dense, 0)
+    spd = sp_p.shape[0]
+    blk = SHARD_BLOCK
+    masked = pl.pallas_call(
+        _sparse_dense_kernel,
+        grid=(spd // blk,),
+        in_specs=[
+            pl.BlockSpec((blk, k), lambda i: (i, 0)),
+            pl.BlockSpec((blk, w), lambda i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((blk, k), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((spd, k), jnp.int32),
+        interpret=_interpret(),
+    )(sp_p, dense_p)
+    return jnp.sort(masked[:s], axis=-1)
+
+
 def available() -> bool:
     """Pallas compiles on this backend (real TPU or interpret fallback)."""
     try:

@@ -521,10 +521,14 @@ class DeviceRunner:
         return (1 if self.mesh is None
                 else self.mesh.shape.get(REPLICA_AXIS, 1))
 
-    def _put_shard_padded(self, arr: np.ndarray, shard_axis: int) -> jax.Array:
+    def _put_shard_padded(self, arr: np.ndarray, shard_axis: int,
+                          fill: int = 0) -> jax.Array:
         """Pad `shard_axis` to a multiple of the shard slots and place on
         device(s): that axis shards over the mesh, every other axis (and
-        the replica axis) replicates."""
+        the replica axis) replicates. `fill` is the pad value — zero for
+        dense bitvectors (a zero pad shard is empty), the sparse sentinel
+        for hybrid index-array leaves (a ZERO pad slot would read as
+        "column 0 set" on every pad shard)."""
         # lock-order witness choke point: a host->device upload while
         # holding a witnessed lock stalls that lock's siblings behind the
         # transfer (no-op unless PILOSA_TPU_LOCKCHECK=1)
@@ -533,7 +537,7 @@ class DeviceRunner:
         if pad:
             widths = [(0, 0)] * arr.ndim
             widths[shard_axis] = (0, pad)
-            arr = np.pad(arr, widths)
+            arr = np.pad(arr, widths, constant_values=fill)
         arr = np.ascontiguousarray(arr)
         if self.mesh is None:
             return jax.device_put(arr)
@@ -541,13 +545,15 @@ class DeviceRunner:
         spec[shard_axis] = SHARD_AXIS
         return jax.device_put(arr, NamedSharding(self.mesh, P(*spec)))
 
-    def put_leaf(self, rows: np.ndarray) -> jax.Array:
+    def put_leaf(self, rows: np.ndarray, fill: int = 0) -> jax.Array:
         """Place one leaf [S, W] on device(s), padded to a multiple of the
         shard-axis size and sharded over it — the unit cached by the HBM
         residency manager (parallel/residency.py). On a replica×shard mesh
         the unmentioned replica axis replicates: every replica slice holds
-        a full copy of the leaf (ReplicaN on-mesh, SURVEY §2.9)."""
-        return self._put_shard_padded(rows, 0)
+        a full copy of the leaf (ReplicaN on-mesh, SURVEY §2.9). Hybrid
+        sparse leaves [S, K] place the same way (axis 0 shards) with
+        `fill` set to the sparse sentinel."""
+        return self._put_shard_padded(rows, 0, fill=fill)
 
     def put_plane_slab(self, planes: np.ndarray) -> jax.Array:
         """Place a [depth, S, W] BSI plane slab on device(s), shard-axis
@@ -567,7 +573,14 @@ class DeviceRunner:
         """Dense result as a device array [S(padded), W] — stays in HBM for
         further device-side composition (BSI filters, TopN sources). In
         ICI serving mode the program runs as an explicit shard_map and the
-        result lands SHARDED across the slice, like its input leaves."""
+        result lands SHARDED across the slice, like its input leaves.
+
+        Dense uint32 leaves only: hybrid programs with sparse operands
+        route through ops.bitvector.eval_hybrid instead (the executor's
+        compile step decides) — the slice-local route still accepts them
+        because the sparse kernels are per-shard local, so GSPMD
+        partitions them over the mesh with zero communication; only the
+        explicit shard_map program cache below falls back."""
         if self.mesh is not None and self.ici_serving:
             return eval_row_mesh(self.mesh, tuple(leaves), program)
         return eval_row(tuple(leaves), program)

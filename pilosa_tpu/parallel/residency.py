@@ -20,6 +20,7 @@ that the heat signal is load-bearing before tiering starts steering by it.
 
 from __future__ import annotations
 
+import os
 import threading
 from collections import OrderedDict
 from typing import Callable, Optional
@@ -54,7 +55,8 @@ class DeviceResidency:
         self.eviction = "lru"  # [storage] eviction: lru | heat
         self.heat_evictions = 0  # victims chosen by heat (not LRU order)
 
-    def leaf(self, key: tuple, make: Callable[[], np.ndarray]) -> jax.Array:
+    def leaf(self, key: tuple, make: Callable[[], np.ndarray],
+             put: Optional[Callable] = None) -> jax.Array:
         """Return the device array for `key`, uploading via `make()` on miss.
 
         `key` must encode content versions (fragment row generations), so a
@@ -64,7 +66,9 @@ class DeviceResidency:
         `make()` may return a host array (uploaded via the runner) or a
         jax.Array already composed on device (e.g. a BSI comparison mask) —
         the latter is cached as-is, avoiding a device->host->device round
-        trip."""
+        trip. `put`, when given, replaces the runner's default placement
+        for host arrays (sparse hybrid leaves pad with the sentinel, not
+        zero — parallel/mesh.py put_leaf's fill parameter)."""
         prof = qprofile.current_profile.get()  # None = profiling off
         with self._lock:
             arr = self._lru.get(key)
@@ -81,7 +85,7 @@ class DeviceResidency:
             return arr
         host = make()
         uploaded = not isinstance(host, jax.Array)
-        arr = self.runner.put_leaf(host) if uploaded else host
+        arr = ((put or self.runner.put_leaf)(host) if uploaded else host)
         if prof is not None:
             # host->device bytes count only real uploads: a mask already
             # composed on device (bsicmp results) costs no link transfer
@@ -162,6 +166,18 @@ class DeviceResidency:
                     # residency-transition history: the fragment left HBM
                     tracker.touch_many(fkeys, evictions=1)
 
+    def peek(self, key: tuple) -> Optional[jax.Array]:
+        """The resident array for `key`, or None — WITHOUT hit/miss
+        accounting (a representation probe by the hybrid manager is not
+        a leaf read; counting it would distort the hit-rate telemetry
+        the churn alerts key on). Touches LRU order: a probe that leads
+        to an on-device materialization is about to read the entry."""
+        with self._lock:
+            arr = self._lru.get(key)
+            if arr is not None:
+                self._lru.move_to_end(key)
+            return arr
+
     def clear(self) -> None:
         with self._lock:
             self._lru.clear()
@@ -185,6 +201,159 @@ class DeviceResidency:
                     "evictions": self.evictions,
                     "heatEvictions": self.heat_evictions,
                     "eviction": self.eviction, "by_kind": by_kind}
+
+
+# ---------------------------------------------------------------------------
+# Hybrid sparse/dense representation management
+# ---------------------------------------------------------------------------
+
+# default [query] sparse-threshold: rows at or below this many set bits per
+# shard upload as padded sorted-index arrays (ops/bitvector.py sparse
+# kernels) instead of dense planes. 4096 is the roaring array->bitmap
+# flip (constants.ARRAY_MAX_SIZE) applied at shard granularity: a
+# 4096-slot int32 row costs 16 KiB against the 128 KiB plane (8x), and
+# smaller rows bucket down in power-of-two slots (a 100-bit row: 512 B,
+# 256x). 0 disables — every row uploads dense.
+DEFAULT_SPARSE_THRESHOLD = 4096
+
+# smallest sparse allocation (slots); uploads bucket to powers of two so
+# cardinality drift re-keys through a handful of XLA shapes, not one per row
+SPARSE_SLOT_MIN = 8
+
+# representation-memory bound: (index, field, view, row) -> last chosen
+# representation, the hysteresis state. Eviction forgets the row's history
+# (it re-decides from thresholds alone) — never correctness
+REP_MEMORY_BOUND = 1 << 16
+
+
+def hybrid_env_enabled() -> bool:
+    """PILOSA_TPU_HYBRID=0 kills sparse uploads at the choice site (read
+    per call: the emergency toggle needs no restart, and the parity fuzz
+    flips it at runtime). Existing sparse residents keep serving — they
+    are bit-correct — and age out by LRU as re-uploads come back dense."""
+    return os.environ.get("PILOSA_TPU_HYBRID", "1") != "0"
+
+
+class HybridManager:
+    """Per-row representation chooser: sparse (padded sorted-index array)
+    below the cardinality threshold, dense plane above — with promote/
+    demote hysteresis so a row flapping around the threshold doesn't
+    thrash re-uploads, and heat-informed demotion so a COLD dense row
+    re-enters sparse (the array/bitmap container flip of the roaring
+    taxonomy, arXiv:1402.6407, decided from write-maintained exact
+    cardinalities — storage/fragment.py row_cardinality).
+
+    The decision is advisory and never affects results: both
+    representations evaluate bit-identically (ops/bitvector.eval_hybrid;
+    the parity fuzz in tests/test_hybrid_fuzz.py churns rows across the
+    threshold in both directions). State here is only the hysteresis
+    memory plus counters for /debug/vars `hybrid` and the
+    pilosa_hybrid_total metric families."""
+
+    def __init__(self, threshold: int = DEFAULT_SPARSE_THRESHOLD,
+                 hysteresis: float = 0.25, heat=None):
+        self.threshold = int(threshold)
+        # the demote band: a dense row stays dense until its cardinality
+        # falls below threshold*(1-hysteresis) OR its fragments go cold
+        self.hysteresis = float(hysteresis)
+        self.heat = heat  # utils/heat.py HeatTracker or None
+        self._lock = threading.Lock()
+        self._rep: "OrderedDict[tuple, str]" = OrderedDict()
+        self.sparse_uploads = 0
+        self.dense_uploads = 0
+        self.promoted = 0      # sparse -> dense (cardinality crossed up)
+        self.demoted = 0       # dense -> sparse (fell below band / went cold)
+        self.materialized = 0  # sparse leaves expanded to planes on device
+        self.sparse_bytes_uploaded = 0
+        self.dense_bytes_uploaded = 0
+
+    def active(self) -> bool:
+        return self.threshold > 0 and hybrid_env_enabled()
+
+    @staticmethod
+    def pad_slots(cardinality: int) -> int:
+        """Power-of-two padded slot count covering `cardinality` (the
+        static XLA shape bucket; shape churn is bounded by log2 buckets)."""
+        k = SPARSE_SLOT_MIN
+        while k < cardinality:
+            k <<= 1
+        return k
+
+    def _cold(self, frag_keys) -> bool:
+        """True when every covered fragment scores below the heat
+        tracker's hot cutoff — the signal that a band-resident dense row
+        isn't earning its plane. No tracker (PILOSA_TPU_HEAT=0) means
+        never-cold: hysteresis alone decides."""
+        tracker = self.heat
+        if tracker is None or not getattr(tracker, "enabled", False) \
+                or not frag_keys:
+            return False
+        from pilosa_tpu.utils import heat as _heat
+        try:
+            scores = tracker.scores_for(list(frag_keys))
+        except Exception:  # noqa: BLE001 — advisory signal only
+            return False
+        return max(scores, default=0.0) < _heat.HOT_SCORE
+
+    def choose(self, row_key: tuple, max_card: int,
+               frag_keys=None) -> tuple[str, int]:
+        """(representation, padded slots) for one row leaf whose largest
+        per-shard cardinality is `max_card`. Hysteresis: crossing the
+        threshold upward promotes immediately (correct sizing matters
+        more than churn); inside the band a previously-dense row stays
+        dense while any covered fragment is hot, demoting only when cold
+        or when the cardinality falls below the band floor."""
+        if not self.active():
+            return "dense", 0
+        lo = self.threshold * (1.0 - self.hysteresis)
+        with self._lock:
+            prev = self._rep.get(row_key)
+        if max_card > self.threshold:
+            rep = "dense"
+        elif prev == "dense" and max_card > lo:
+            rep = "sparse" if self._cold(frag_keys) else "dense"
+        else:
+            rep = "sparse"
+        with self._lock:
+            if prev is not None and prev != rep:
+                if rep == "dense":
+                    self.promoted += 1
+                else:
+                    self.demoted += 1
+            self._rep[row_key] = rep
+            self._rep.move_to_end(row_key)
+            while len(self._rep) > REP_MEMORY_BOUND:
+                self._rep.popitem(last=False)
+        return rep, self.pad_slots(max(int(max_card), 1))
+
+    def record_upload(self, rep: str, nbytes: int) -> None:
+        with self._lock:
+            if rep == "sparse":
+                self.sparse_uploads += 1
+                self.sparse_bytes_uploaded += int(nbytes)
+            else:
+                self.dense_uploads += 1
+                self.dense_bytes_uploaded += int(nbytes)
+
+    def record_materialize(self) -> None:
+        with self._lock:
+            self.materialized += 1
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "enabled": self.active(),
+                "threshold": self.threshold,
+                "hysteresis": self.hysteresis,
+                "sparseUploads": self.sparse_uploads,
+                "denseUploads": self.dense_uploads,
+                "promoted": self.promoted,
+                "demoted": self.demoted,
+                "materialized": self.materialized,
+                "sparseBytesUploaded": self.sparse_bytes_uploaded,
+                "denseBytesUploaded": self.dense_bytes_uploaded,
+                "trackedRows": len(self._rep),
+            }
 
 
 class PlanCache:

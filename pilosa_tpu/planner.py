@@ -28,6 +28,12 @@ row_cardinality; per-row write generations, fragment.py row_generation):
     intersect_chain_count_total, runner.row_leaves_dev), so no
     intermediate row bitmap is ever materialized on host — the profiler's
     plan node records hostRowBitmapBytes=0 as the verifiable contract.
+  * **Choose device representation per operand.** The same exact
+    cardinalities drive the hybrid sparse/dense container decision
+    (choose_representation below): rows at or below [query]
+    sparse-threshold bits per shard upload as padded sorted-index arrays
+    with galloping/gather-test kernels (ops/bitvector.py), dense rows
+    keep full planes — recorded on the plan node like the ICI route.
   * **Key the cross-query plan cache.** subtree_cache_key() canonicalizes
     a planned subtree to (index, PQL text, shard set, per-leaf fragment
     row generations) — the same generation-keying discipline the
@@ -508,3 +514,50 @@ def record_cache_event(call: Call, hit: bool) -> None:
     if events is not None and len(events) < 48:
         events.append({"expr": truncate_pql(call.to_pql(), _EXPR_LIMIT),
                        "hit": hit})
+
+
+# ------------------------------------------------- hybrid representation
+
+def choose_representation(executor, index, call: Optional[Call],
+                          field_name: str, view_name: str, shards,
+                          row_id: int) -> tuple[str, int, tuple]:
+    """The planner's per-operand container decision (the hybrid
+    sparse/dense tentpole): from the same exact write-maintained
+    cardinalities the reorder pass reads (storage/fragment.py
+    row_cardinality, via the row_counts cache — dict probes, not
+    container walks), pick the device representation for one row leaf
+    and record it on the executing plan node, so ?profile=true and
+    /debug/query-history show WHY a leaf uploaded as a 512-byte index
+    array instead of a 128 KiB plane (the `route`-node discipline of the
+    ICI router applied to representation).
+
+    Returns (rep, padded slots, per-shard generations) — the generations
+    ride along because both the decision and the residency key need them
+    and the per-shard scan should run once. Hysteresis/heat state lives
+    in the executor's HybridManager (parallel/residency.py)."""
+    gens = executor._leaf_gens(index, field_name, view_name, shards,
+                               row_id)
+    hyb = getattr(executor, "hybrid", None)
+    if hyb is None or not hyb.active():
+        return "dense", 0, gens
+    f = index.field(field_name)
+    view = f.view(view_name) if f is not None else None
+    max_card = 0
+    if view is not None:
+        for s in shards:
+            frag = view.fragment(s)
+            if frag is not None:
+                c = frag.row_cardinality(row_id)
+                if c > max_card:
+                    max_card = c
+    rep, slots = hyb.choose(
+        (index.name, field_name, view_name, row_id), max_card,
+        frag_keys=[(index.name, field_name, view_name, s) for s in shards])
+    plan = current_plan.get()
+    if plan is not None and call is not None:
+        reps = plan.setdefault("hybrid", [])
+        if len(reps) < 48:
+            reps.append({"expr": truncate_pql(call.to_pql(), _EXPR_LIMIT),
+                         "rep": rep, "maxShardCardinality": int(max_card),
+                         "slots": slots})
+    return rep, slots, gens

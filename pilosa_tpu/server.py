@@ -83,6 +83,7 @@ class Server:
                  log_format: str = "plain",
                  plan: str = "on",
                  plan_cache_bytes: int = 256 << 20,
+                 sparse_threshold: int = 4096,
                  usage_max_principals: int = 256,
                  usage_ring: int = 360,
                  slo_read_latency_ms: float = 0.0,
@@ -238,6 +239,15 @@ class Server:
             self.executor.plan_cache = None
         elif self.executor.plan_cache is not None:
             self.executor.plan_cache.budget = plan_cache_bytes
+        # [query] sparse-threshold: hybrid sparse/dense device containers
+        # (docs/operations.md "Hybrid containers"); 0 = pure dense. The
+        # PILOSA_TPU_HYBRID=0 env kill switch is read per decision and
+        # wins over any threshold — no rollout needed.
+        if sparse_threshold < 0:
+            raise ValueError(
+                f"invalid [query] sparse-threshold {sparse_threshold!r} "
+                "(expected >= 0)")
+        self.executor.hybrid.threshold = sparse_threshold
         if self.executor.coalescer is not None:
             self.executor.coalescer.admission_s = fanout_coalesce_window
             self.executor.coalescer.max_batch = max(
@@ -368,6 +378,7 @@ class Server:
         self._last_hit_rate = 1.0  # carried through zero-lookup windows
         self._last_plan_hit_rate = 0.0  # plan cache starts cold
         self._last_ici_share = 0.0  # slice-local share of routed reads
+        self._last_hybrid_share = 0.0  # sparse share of row-leaf uploads
         self.api.health_fn = self.node_health
         self.api.node_stats_fn = self.node_stats
         self.api.cluster_stats_fn = self.cluster_stats
@@ -2164,6 +2175,16 @@ class Server:
         raw["ici.fallback"] = isnap["fallback"]
         raw["ici.routed"] = (isnap["sliceLocal"] + isnap["crossSlice"]
                              + isnap["fallback"])
+        # hybrid sparse/dense containers: live sparse occupancy gauges
+        # plus the windowed sparse share of row-leaf uploads (the
+        # dashboard's sparkline of how much of the leaf traffic escapes
+        # the dense-plane cost)
+        hy = ex.hybrid_snapshot()
+        g["hybrid.sparse_bytes"] = float(hy["residentSparseBytes"])
+        g["hybrid.sparse_leaves"] = float(hy["residentSparseLeaves"])
+        raw["hybrid.sparse_uploads"] = hy["sparseUploads"]
+        raw["hybrid.row_uploads"] = (hy["sparseUploads"]
+                                     + hy["denseUploads"])
         # hinted handoff + drain lifecycle + rejoin read fence
         hsnap = self.hints.snapshot()
         g["hints.pending_bytes"] = float(hsnap["pendingBytes"])
@@ -2272,6 +2293,14 @@ class Server:
             if drouted > 0:
                 self._last_ici_share = max(0.0, dlocal) / drouted
         g["ici.slice_local_share"] = self._last_ici_share
+        if prev is not None:
+            dups = raw["hybrid.row_uploads"] - prev.get(
+                "hybrid.row_uploads", 0)
+            dsp = raw["hybrid.sparse_uploads"] - prev.get(
+                "hybrid.sparse_uploads", 0)
+            if dups > 0:
+                self._last_hybrid_share = max(0.0, dsp) / dups
+        g["hybrid.sparse_share"] = self._last_hybrid_share
         g["http.errors_per_s"] = rate("http.errors")
         g["xla.compiles_per_s"] = rate("xla.compiles")
         g["usage.queries_per_s"] = rate("usage.queries")

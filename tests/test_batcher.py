@@ -149,6 +149,9 @@ def test_executor_count_uses_batcher(tmp_path, monkeypatch):
     holder = Holder(str(tmp_path)).open()
     ex = Executor(holder)
     assert ex.batcher is not None
+    # hybrid off: these few-bit rows would ride the sparse path, which
+    # bypasses the batcher by design — the batcher layer is under test
+    ex.hybrid.threshold = 0
     idx = holder.create_index("bt", track_existence=False)
     f = idx.create_field("f")
     f.import_bits([0, 0, 1, 1, 1], [1, 5, 5, 9, 2_000_000])

@@ -502,8 +502,13 @@ def test_residency_eviction_pressure(tmp_path):
     try:
         e = Executor(h, runner=DeviceRunner(None))
         # plan cache off: repeat sweeps would be answered from cached
-        # scalars without ever touching the residency LRU under test
+        # scalars without ever touching the residency LRU under test.
+        # Hybrid off too: 300-bit rows upload as ~1 KiB sparse leaves,
+        # and the whole 24-row working set then FITS the 4-plane budget
+        # (exactly the capacity win tests/test_hybrid.py asserts) — this
+        # test needs dense planes to create eviction pressure.
         e.plan_cache.enabled = False
+        e.hybrid.threshold = 0
         idx = h.create_index("ev", track_existence=False)
         f = idx.create_field("f")
         n_rows, per_row = 24, 300

@@ -284,6 +284,44 @@ def test_live_metrics_planner_and_plan_cache_series(pair):
     assert {"bytes", "entries"} <= gkeys
 
 
+def test_live_metrics_hybrid_families(pair):
+    """Hybrid containers PR satellite: the sparse/dense representation
+    counters (uploads by rep, promote/demote/materialize transitions)
+    and the resident-occupancy gauges are scrapeable — emitted
+    unconditionally (zeros included) so a "sparse share collapsed" alert
+    never races the first sparse upload — and conform like everything
+    else. The fixture's row f=0 (~117 bits per shard) sits far below the
+    default sparse-threshold, so real sparse uploads back the counter."""
+    servers, uris = pair
+    req = urllib.request.Request(
+        uris[0] + "/index/m/query", data=b"Count(Row(f=0))",
+        method="POST")
+    urllib.request.urlopen(req, timeout=30).read()
+    with urllib.request.urlopen(uris[0] + "/metrics", timeout=10) as r:
+        text = r.read().decode()
+    types, samples = check_conformance(text)
+    assert types["pilosa_hybrid_total"] == "counter"
+    reps = {l.get("rep") for n, l, _ in samples
+            if n == "pilosa_hybrid_total" and "rep" in l}
+    assert {"sparse", "dense"} <= reps
+    transitions = {l.get("transition") for n, l, _ in samples
+                   if n == "pilosa_hybrid_total" and "transition" in l}
+    assert {"promoted", "demoted", "materialized"} <= transitions
+    sparse_ups = next(v for n, l, v in samples
+                      if n == "pilosa_hybrid_total"
+                      and l.get("rep") == "sparse")
+    assert sparse_ups >= 1  # real sparse traffic uploaded
+    for fam in ("pilosa_hybridLeaves", "pilosa_hybridBytes"):
+        assert types[fam] == "gauge"
+        assert {"sparse", "dense"} <= {
+            l.get("rep") for n, l, _ in samples if n == fam}
+    thr = next(v for n, l, v in samples
+               if n == "pilosa_hybrid" and l.get("key") == "threshold")
+    assert thr == 4096.0  # the default [query] sparse-threshold
+    assert any(n == "pilosa_hybrid" and l.get("key") == "enabled"
+               and v == 1.0 for n, l, v in samples)
+
+
 def test_live_metrics_ici_families(pair):
     """ICI serving PR satellite: the slice-local routing decision
     counters and the serving-mode program-cache economics are scrapeable

@@ -1084,15 +1084,28 @@ def test_debug_vars_surfaces_engine_stats(server):
     jpost(server.uri, "/index/dv/query", raw=b"Set(1, f=0)")
     jpost(server.uri, "/index/dv/query", raw=b"Set(2, f=1)")
     jpost(server.uri, "/index/dv/query", raw=b"Set(1, v=7)")
-    _, out = jpost(server.uri, "/index/dv/query",
-                   raw=b"Count(Intersect(Row(f=0), Row(f=1)))")
+    # one-bit rows ride the hybrid sparse path, which bypasses the count
+    # batcher by design — force dense for this query so the batcher
+    # surface under test sees traffic, then restore
+    old_thr = server.executor.hybrid.threshold
+    server.executor.hybrid.threshold = 0
+    try:
+        _, out = jpost(server.uri, "/index/dv/query",
+                       raw=b"Count(Intersect(Row(f=0), Row(f=1)))")
+    finally:
+        server.executor.hybrid.threshold = old_thr
     assert out["results"] == [0]
+    # and one hybrid-path query so the `hybrid` block is visibly live
+    _, out = jpost(server.uri, "/index/dv/query", raw=b"Count(Row(f=0))")
+    assert out["results"] == [1]
     _, out = jpost(server.uri, "/index/dv/query", raw=b"Sum(field=v)")
     assert out["results"][0] == {"value": 7, "count": 1}
     status, body = http("GET", server.uri, "/debug/vars")
     assert status == 200
     d = json.loads(body)
     assert d["deviceResidency"]["entries"] > 0
+    assert d["hybrid"]["sparseUploads"] >= 1
+    assert d["hybrid"]["threshold"] == 4096
     if server.executor.batcher is not None:
         assert d["countBatcher"]["batched_queries"] >= 1
         assert d["planeSumBatcher"]["batched_queries"] >= 1
