@@ -1642,6 +1642,188 @@ def bench_qos(tmpdir) -> dict:
         srv.close()
 
 
+INGEST_WRITERS = int(os.environ.get("PILOSA_BENCH_INGEST_WRITERS", "8"))
+INGEST_ENVELOPE = int(os.environ.get("PILOSA_BENCH_INGEST_ENVELOPE", "500"))
+INGEST_READERS = int(os.environ.get("PILOSA_BENCH_INGEST_READERS", "256"))
+INGEST_READ_QPC = int(os.environ.get("PILOSA_BENCH_INGEST_READ_QPC", "4"))
+
+
+def bench_ingest(tmpdir) -> dict:
+    """Streaming-ingest throughput concurrent with serving (ISSUE 16).
+
+    INGEST_WRITERS keep-alive writer threads flood mixed Set/Clear
+    envelopes (80/20, INGEST_ENVELOPE mutations each) through the
+    coalesced write path while INGEST_READERS interactive clients run
+    the warm dense-read workload. Headline: acked mutations/s during the
+    concurrent window (acceptance >= 100k/s). Gates: the readers' warm
+    p50 moves <= 15% vs a writer-free baseline round; every acked write
+    is immediately readable (read-your-writes spot check); and the WAL
+    group-commit ratio — per-bit-equivalent WAL writes (one per mutation
+    plus one per Set for existence marking) over actual fsync-able
+    appends — is >= 10x."""
+    import http.client
+    import statistics
+    import threading
+
+    from pilosa_tpu.server import Server
+
+    srv = Server(os.path.join(tmpdir, "ingest"), port=0).open()
+    try:
+        hostport = srv.uri.split("//", 1)[1]
+        _local = threading.local()
+
+        def post(path, body, batch_priority=False):
+            # bulk writers self-declare the QoS batch class, the
+            # documented practice for ingest clients (docs/operations.md
+            # "Streaming ingest"): under admission pressure reads order
+            # ahead of the flood
+            headers = ({"X-Pilosa-Priority": "batch"} if batch_priority
+                       else {})
+            conn = getattr(_local, "conn", None)
+            if conn is None:
+                conn = _local.conn = http.client.HTTPConnection(
+                    hostport, timeout=60)
+            try:
+                conn.request("POST", path, body=body, headers=headers)
+                resp = conn.getresponse()
+                out = resp.read()
+            except (http.client.HTTPException, OSError):
+                conn.close()
+                conn = _local.conn = http.client.HTTPConnection(
+                    hostport, timeout=60)
+                conn.request("POST", path, body=body, headers=headers)
+                resp = conn.getresponse()
+                out = resp.read()
+            if resp.status != 200:
+                raise RuntimeError(f"{path}: {resp.status}: {out[:200]}")
+            return out
+
+        post("/index/in", b"{}")
+        post("/index/in/field/f", b"{}")
+        post("/index/in/field/w", b"{}")
+        rng = np.random.default_rng(16)
+        cols = rng.choice(4 * SHARD_WIDTH, size=100_000, replace=False)
+        half = len(cols) // 2
+        post("/index/in/field/f/import", json.dumps({
+            "rowIDs": [0] * half + [1] * (len(cols) - half),
+            "columnIDs": cols.tolist()}).encode())
+        q = b"Count(Intersect(Row(f=0), Row(f=1)))"
+        for _ in range(5):
+            post("/index/in/query", q)
+
+        def read_round(stop_writers=None):
+            lats: list[float] = []
+            lock = threading.Lock()
+            barrier = threading.Barrier(INGEST_READERS)
+
+            def reader(i):
+                mine = []
+                barrier.wait()
+                for _ in range(INGEST_READ_QPC):
+                    t0 = time.perf_counter()
+                    post("/index/in/query", q)
+                    mine.append((time.perf_counter() - t0) * 1e3)
+                with lock:
+                    lats.extend(mine)
+
+            ts = [threading.Thread(target=reader, args=(i,))
+                  for i in range(INGEST_READERS)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+            if stop_writers is not None:
+                stop_writers.set()
+            lats.sort()
+            return (statistics.median(lats),
+                    lats[min(len(lats) - 1, int(0.99 * len(lats)))])
+
+        base_p50, base_p99 = read_round()
+
+        # -- concurrent writers: mixed 80/20 Set/Clear envelopes ---------
+        acked = [0] * INGEST_WRITERS
+        write_errors: list = []
+        stop = threading.Event()
+
+        def writer(tid):
+            wrng = np.random.default_rng(1000 + tid)
+            lane = tid * 50_000_000  # disjoint columns per writer
+            seq = 0
+            try:
+                while not stop.is_set():
+                    calls = []
+                    for _ in range(INGEST_ENVELOPE):
+                        if seq and wrng.random() < 0.2:
+                            c = lane + int(wrng.integers(0, seq))
+                            calls.append(f"Clear({c}, w={tid % 4})")
+                        else:
+                            calls.append(f"Set({lane + seq}, w={tid % 4})")
+                            seq += 1
+                    post("/index/in/query", "".join(calls).encode(),
+                         batch_priority=True)
+                    acked[tid] += INGEST_ENVELOPE
+            except BaseException as e:  # noqa: BLE001
+                write_errors.append(repr(e))
+
+        writers = [threading.Thread(target=writer, args=(t,), daemon=True)
+                   for t in range(INGEST_WRITERS)]
+        t0 = time.perf_counter()
+        for t in writers:
+            t.start()
+        conc_p50, conc_p99 = read_round(stop_writers=stop)
+        for t in writers:
+            t.join(timeout=60)
+        elapsed = time.perf_counter() - t0
+        total_acked = sum(acked)
+        sets_per_s = total_acked / elapsed if elapsed else 0.0
+
+        # read-your-writes: acked mutations are immediately visible
+        ryw = json.loads(post(
+            "/index/in/query", b"Count(Row(w=0))").decode())
+        ryw_count = ryw["results"][0]
+
+        dv = json.loads(urlopen_json(srv.uri + "/debug/vars"))
+        ing = dv["ingest"]
+        perbit_equiv = ing["mutations"] + ing["setMutations"]
+        fsync_reduction = (perbit_equiv / ing["walAppends"]
+                           if ing["walAppends"] else float("inf"))
+        p50_delta = (100.0 * (conc_p50 / base_p50 - 1.0)
+                     if base_p50 else 0.0)
+        return {
+            "metric": "ingest_sets_per_s",
+            "value": round(sets_per_s, 1),
+            "unit": "acked mutations/s concurrent with "
+                    f"{INGEST_READERS}-client reads (acceptance >= 100k)",
+            "mutations_acked": total_acked,
+            "write_errors": write_errors[:3],
+            "read_p50_ms_baseline": round(base_p50, 3),
+            "read_p99_ms_baseline": round(base_p99, 3),
+            "read_p50_ms_concurrent": round(conc_p50, 3),
+            "read_p99_ms_concurrent": round(conc_p99, 3),
+            "read_p50_delta_pct": round(p50_delta, 2),
+            "read_your_writes_count": ryw_count,
+            "fsync_reduction_x": round(fsync_reduction, 1),
+            "wal_appends": ing["walAppends"],
+            "applied_batches": ing["appliedBatches"],
+            "max_batch_seen": ing["max_batch_seen"],
+            "patched_leaves": ing["patchedDense"] + ing["patchedSparse"],
+            "vs_baseline": 0.0,
+            "path": f"{INGEST_WRITERS} keep-alive writers x "
+                    f"{INGEST_ENVELOPE}-mutation 80/20 Set/Clear "
+                    f"envelopes vs {INGEST_READERS} readers x "
+                    f"{INGEST_READ_QPC} warm Count(Intersect); "
+                    "baseline/concurrent read rounds",
+        }
+    finally:
+        srv.close()
+
+
+def urlopen_json(url: str):
+    import urllib.request
+    with urllib.request.urlopen(url, timeout=30) as r:
+        return r.read()
+
+
 PLANNER_SHARDS = 8
 PLANNER_CLIENTS = int(os.environ.get("PILOSA_BENCH_PLANNER_CLIENTS", "256"))
 PLANNER_ROUNDS = int(os.environ.get("PILOSA_BENCH_PLANNER_ROUNDS", "3"))
@@ -2671,6 +2853,7 @@ def worker() -> None:
         stage("distributed", bench_distributed, tmp)
         stage("ici", bench_ici, tmp)
         stage("rolling_restart", bench_rolling_restart, tmp)
+        stage("ingest", bench_ingest, tmp)
     finally:
         shutil.rmtree(tmp, ignore_errors=True)
 
@@ -2939,6 +3122,14 @@ _CRITERIA = [
      lambda m: (m["value"] >= 4.0 and m["dense_overhead_pct"] <= 15.0,
                 ">= 4x resident sparse rows at equal HBM budget AND "
                 "dense headline within the 15% gate with hybrid on")),
+    (r"^ingest_sets_per_s$",
+     lambda m: (m["value"] >= 100_000.0
+                and m["read_p50_delta_pct"] <= 15.0
+                and m["fsync_reduction_x"] >= 10.0
+                and not m["write_errors"],
+                ">= 100k acked mutations/s concurrent with serving, "
+                "warm read p50 delta <= 15%, WAL group-commit >= 10x "
+                "fewer appends than per-bit, 0 write errors")),
 ]
 
 # headline stages for `--compare` and the regression direction of their
@@ -2953,6 +3144,7 @@ _HEADLINE_COMPARE = [
     (r"^http_count_qps$", "higher"),
     (r"^distributed_count_qps_16shard", "higher"),
     (r"^hybrid_capacity_ratio$", "higher"),
+    (r"^ingest_sets_per_s$", "higher"),
 ]
 
 COMPARE_REGRESSION_PCT = float(os.environ.get(

@@ -153,6 +153,23 @@ class StorageConfig:
 
 
 @dataclass
+class IngestConfig:
+    """[ingest] — write-side continuous batching (pilosa_tpu/parallel/
+    ingest.py; docs/operations.md "Streaming ingest"). batch-window:
+    admission window in seconds (duration strings accepted) a batch
+    leader waits for stragglers before cutting; the default 0 is self-
+    clocked group commit — a lone writer cuts immediately, and under
+    concurrency arrivals accumulate behind the in-flight apply, so batch
+    size tracks arrival_rate x apply_time. Raise it on fsync-heavy
+    configs to trade lone-writer latency for larger group commits.
+    max-batch bounds mutations per applied batch. PILOSA_TPU_INGEST=0 is
+    the env kill switch (read per call — no restart): mutations take the
+    per-bit write path with identical semantics."""
+    batch_window: float = 0.0
+    max_batch: int = 4096
+
+
+@dataclass
 class AntiEntropyConfig:
     interval: float = 0.0  # seconds; 0 disables (server.go:430-445)
     # scrubber tuning: jitter spreads node passes apart (fraction of the
@@ -300,6 +317,7 @@ class Config:
     slo: SLOConfig = field(default_factory=SLOConfig)
     cluster: ClusterConfig = field(default_factory=ClusterConfig)
     storage: StorageConfig = field(default_factory=StorageConfig)
+    ingest: IngestConfig = field(default_factory=IngestConfig)
     anti_entropy: AntiEntropyConfig = field(default_factory=AntiEntropyConfig)
     metric: MetricConfig = field(default_factory=MetricConfig)
     diagnostics: DiagnosticsConfig = field(default_factory=DiagnosticsConfig)
@@ -326,7 +344,7 @@ class Config:
     def _apply_dict(self, data: dict) -> None:
         for key, value in data.items():
             attr = key.replace("-", "_")
-            if attr in ("tls", "query", "qos", "slo", "cluster", "storage", "anti_entropy", "metric", "diagnostics", "tracing", "mesh", "gossip") and isinstance(value, dict):
+            if attr in ("tls", "query", "qos", "slo", "cluster", "storage", "ingest", "anti_entropy", "metric", "diagnostics", "tracing", "mesh", "gossip") and isinstance(value, dict):
                 sub = getattr(self, attr)
                 for k, v in value.items():
                     sk = k.replace("-", "_")
@@ -348,7 +366,7 @@ class Config:
 
     def _set_path(self, parts: list[str], raw: str) -> None:
         # try sub-config first (cluster_replicas -> cluster.replicas)
-        for sub_name in ("tls", "query", "qos", "slo", "cluster", "storage", "anti_entropy", "metric", "diagnostics", "tracing", "mesh", "gossip"):
+        for sub_name in ("tls", "query", "qos", "slo", "cluster", "storage", "ingest", "anti_entropy", "metric", "diagnostics", "tracing", "mesh", "gossip"):
             sub_parts = sub_name.split("_")
             if parts[: len(sub_parts)] == sub_parts and len(parts) > len(sub_parts):
                 sub = getattr(self, sub_name)
@@ -358,12 +376,13 @@ class Config:
                 return
         attr = "_".join(parts)
         if attr in ("tls", "query", "qos", "slo", "cluster", "storage",
-                    "anti_entropy", "metric", "diagnostics", "tracing",
-                    "mesh", "gossip"):
+                    "ingest", "anti_entropy", "metric", "diagnostics",
+                    "tracing", "mesh", "gossip"):
             # a bare section name is never a config path — notably
-            # PILOSA_TPU_QOS=0 is the runtime kill switch (read by
-            # pilosa_tpu/qos.py per call), and coercing it here would
-            # clobber the whole [qos] section object with a string
+            # PILOSA_TPU_QOS=0 and PILOSA_TPU_INGEST=0 are runtime kill
+            # switches (read per call by pilosa_tpu/qos.py and
+            # parallel/ingest.py), and coercing one here would clobber
+            # the whole section object with a string
             return
         if hasattr(self, attr):
             setattr(self, attr, _coerce(raw, getattr(self, attr)))
@@ -441,6 +460,10 @@ class Config:
             "[storage]",
             f'wal-fsync = "{self.storage.wal_fsync}"',
             f'eviction = "{self.storage.eviction}"',
+            "",
+            "[ingest]",
+            f"batch-window = {self.ingest.batch_window}",
+            f"max-batch = {self.ingest.max_batch}",
             "",
             "[anti-entropy]",
             f"interval = {self.anti_entropy.interval}",

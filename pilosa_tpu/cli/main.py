@@ -168,6 +168,8 @@ def cmd_server(args) -> int:
         anti_entropy_max_blocks=cfg.anti_entropy.max_blocks,
         wal_fsync=cfg.storage.wal_fsync,
         eviction=cfg.storage.eviction,
+        ingest_batch_window=cfg.ingest.batch_window,
+        ingest_max_batch=cfg.ingest.max_batch,
         join=getattr(args, "join", False),
         long_query_time=cfg.cluster.long_query_time,
         query_timeout=cfg.cluster.query_timeout,

@@ -40,7 +40,13 @@ from pilosa_tpu.models.view import VIEW_STANDARD
 from pilosa_tpu.ops import bsi as bsi_ops
 from pilosa_tpu.ops.bitvector import columns_from_dense
 from pilosa_tpu.parallel.mesh import DeviceRunner
-from pilosa_tpu.pql import Call, Condition, Query, parse_string_cached
+from pilosa_tpu.pql import (
+    Call,
+    Condition,
+    Query,
+    parse_mutations_fast,
+    parse_string_cached,
+)
 from pilosa_tpu.pql.ast import BETWEEN, EQ, GT, GTE, LT, LTE, NEQ
 from pilosa_tpu.utils import qctx
 from pilosa_tpu.utils import profile as qprofile
@@ -277,6 +283,31 @@ class Executor:
         # extra broadcast round-trip is paid ~never; bulk imports keep
         # the async announcement queue.
         self.announce_shard_fn = None
+        # ---- streaming ingest (parallel/ingest.py, ISSUE 16) ----
+        # write-side continuous batcher: concurrent Set/Clear coalesce
+        # into per-(fragment, shard) bulk applies — one WAL group-commit,
+        # one container merge, one generation bump per fragment per batch.
+        # PILOSA_TPU_INGEST=0 is read per decision at the interception
+        # site (execute()), so the batcher object always exists and the
+        # kill switch needs no restart. Window/max-batch are Server/config
+        # knobs ([ingest] section).
+        from pilosa_tpu.parallel.ingest import IngestBatcher
+        self.ingest = IngestBatcher(self._apply_ingest_batch)
+        self._ingest_lock = _threading.Lock()
+        self.ingest_stats = {
+            "appliedBatches": 0,    # per-fragment bulk applies
+            "walAppends": 0,        # WAL group-commits (<= 1 fsync each)
+            "walOps": 0,            # net framed records written
+            "remoteBatches": 0,     # replica envelopes sent
+            "remoteMutations": 0,   # mutations those envelopes carried
+            "hintedMutations": 0,   # mutations demoted to durable hints
+            "errors": 0,            # per-mutation failures
+            "patchedDense": 0,      # resident dense leaves patched in HBM
+            "patchedSparse": 0,     # resident sparse leaves patched in HBM
+            "patchDropped": 0,      # stale residents dropped un-patchable
+            "hybridEvals": 0,       # write-side hysteresis ticks
+            "newShards": 0,         # shards created by batched Sets
+        }
 
     # ------------------------------------------------------ fan-out pools
 
@@ -467,7 +498,12 @@ class Executor:
         nodes (ctx cancellation, executor.go:2591-2608); an inherited
         deadline (HTTP layer) applies when omitted."""
         if isinstance(query, str):
-            query = parse_string_cached(query)
+            # bulk-ingest envelopes (runs of Set/Clear calls) take the
+            # linear mutation scanner; unique column ids make them
+            # useless to the LRU plan cache and the full parser is ~10x
+            # slower per call. Everything else keeps the cached parse.
+            query = (parse_mutations_fast(query)
+                     or parse_string_cached(query))
         if not isinstance(query, Query):
             raise TypeError("query must be a PQL string or Query")
         index = self.holder.index(index_name)
@@ -480,6 +516,28 @@ class Executor:
         distributed = (not remote and self.cluster is not None
                        and self.client is not None
                        and len(self.cluster.nodes) > 1)
+        # ---- coalesced streaming ingest (parallel/ingest.py) ----
+        # all-Set/Clear queries route through the IngestBatcher: the
+        # mutations are translated HERE (submitter thread), queued under
+        # the index's compatibility key, and applied by a batch leader as
+        # per-fragment bulk operations. remote=True multi-call envelopes
+        # (a coordinator's batched replica fan-out) bulk-apply directly —
+        # they ARE a batch already; queueing them again would serialize
+        # the cluster on one node's admission. Anything the batcher can't
+        # take bit-identically (INT fields, mutex/bool, timestamps,
+        # missing fields) falls through to the per-bit path below.
+        from pilosa_tpu.parallel import ingest as _ingest
+        if (_ingest.ingest_env_enabled()
+                and query.calls
+                and all(c.name in ("Set", "Clear") for c in query.calls)):
+            if not remote:
+                handled = self._execute_ingest(index, query)
+                if handled is not None:
+                    return handled
+            else:
+                handled = self._execute_ingest_remote(index, query)
+                if handled is not None:
+                    return handled
         import time as _time
         dl_token = (qctx.deadline.set(_time.monotonic() + timeout)
                     if timeout else None)
@@ -2633,6 +2691,573 @@ class Executor:
         if self.hints is None:
             return
         self.hints.append(node_id, index_name, pql, shards=hshards)
+
+    # ----------------------------------- coalesced streaming ingest (ISSUE 16)
+
+    def _ingest_mutation(self, index: Index, call: Call, fields: dict,
+                         Mutation):
+        """One Set/Clear -> a pre-translated ingest Mutation; a bare bool
+        for calls that resolve without touching storage (unknown Clear
+        keys, matching the per-bit early returns); None when only the
+        per-bit path serves it bit-identically — missing field (its
+        error), INT fields (per-plane BSI writes), mutex/bool fields
+        (cross-row clear side effects), timestamped writes (time views).
+        `fields` caches field resolution across the envelope (bulk runs
+        repeat one or two fields thousands of times; False = known
+        non-batchable) — this loop is the per-mutation cost floor of the
+        whole ingest path, so it stays allocation- and lookup-lean."""
+        args = call.args
+        fname = None
+        for k, v in args.items():  # call.field_arg(), sans the raise
+            if k[0] != "_" and not isinstance(v, Condition):
+                fname = k
+                break
+        f = fields.get(fname)
+        if f is None:
+            if fname is None:
+                return None
+            f = index.field(fname)
+            if f is None or f.options.type != FieldType.SET:
+                fields[fname] = False
+                return None
+            fields[fname] = f
+        elif f is False:
+            return None
+        if args.get("_timestamp") is not None:
+            return None
+        if call.name == "Set":
+            col = self._translate_col(index, args["_col"])
+            row_id = self._translate_row(index, f, args[fname])
+            return Mutation(True, fname, int(row_id), int(col), call)
+        col = self._translate_col(index, args["_col"], create=False)
+        if col is None:
+            return False  # unknown column key: nothing to clear
+        row_id = self._translate_row(index, f, args[fname], create=False)
+        if row_id is None:
+            return False
+        return Mutation(False, fname, int(row_id), int(col), call)
+
+    def _ingest_prepare(self, index: Index, query):
+        """(slots, muts) for an all-Set/Clear query, or None to fall back
+        to the per-bit path. Each slot is either a pre-resolved bool or
+        an index into `muts`. Translation happens here, on the submitting
+        thread — the batch leader never pays a stranger's translator
+        round trip, and create=True minting is idempotent so a later
+        fallback re-translates to the same ids."""
+        from pilosa_tpu.parallel.ingest import Mutation
+        slots: list = []
+        muts: list = []
+        fields: dict = {}
+        try:
+            for call in query.calls:
+                m = self._ingest_mutation(index, call, fields, Mutation)
+                if m is None:
+                    return None
+                if isinstance(m, bool):
+                    slots.append(m)
+                else:
+                    slots.append(len(muts))
+                    muts.append(m)
+        except ExecutionError:
+            raise  # translator contract errors, identical per-bit
+        except Exception:  # noqa: BLE001 — any oddity: per-bit decides
+            return None
+        return slots, muts
+
+    @staticmethod
+    def _ingest_unpack(slots: list, outcomes: list) -> list:
+        results = []
+        for s in slots:
+            if isinstance(s, bool):
+                results.append(s)
+                continue
+            status, val = outcomes[s]
+            if status == "err":
+                raise val
+            results.append(val)
+        return results
+
+    def _execute_ingest(self, index: Index, query) -> Optional[list]:
+        """Coordinator-side ingest interception: translate, enqueue under
+        the index's compatibility key, block until a batch leader applies
+        the batch (locally or across replicas), unpack this request's
+        outcomes. Returns None to fall back to the per-bit path."""
+        prepared = self._ingest_prepare(index, query)
+        if prepared is None:
+            return None
+        slots, muts = prepared
+        if not muts:
+            return list(slots)
+        outcomes = self.ingest.submit((index.name,), muts)
+        return self._ingest_unpack(slots, outcomes)
+
+    def _execute_ingest_remote(self, index: Index, query) -> Optional[list]:
+        """Replica-side bulk apply of a coordinator's batched envelope
+        (remote=True, multi-call). The envelope IS a batch: apply it
+        directly — one WAL group-commit per touched fragment — without
+        re-queueing through this node's batcher (which would serialize
+        the cluster on one node's admission window). A failed mutation
+        fails the whole envelope (HTTP error), which the coordinator
+        maps back onto this replica's mutations."""
+        prepared = self._ingest_prepare(index, query)
+        if prepared is None:
+            return None
+        slots, muts = prepared
+        if not muts:
+            return list(slots)
+        outcomes = self._apply_ingest_local(index, muts)
+        return self._ingest_unpack(slots, outcomes)
+
+    def _apply_ingest_batch(self, index_name: str, muts) -> list:
+        """IngestBatcher apply hook, run on the batch leader's thread
+        under the QoS `batch` class — every pool submit and replica
+        envelope the apply makes queues behind interactive traffic, so
+        sustained ingest cannot move interactive p99 through queue
+        position."""
+        from pilosa_tpu import qos
+        index = self.holder.index(index_name)
+        if index is None:
+            e = ExecutionError(f"index not found: {index_name}")
+            return [("err", e)] * len(muts)
+        tok = qos.current_priority.set("batch")
+        try:
+            if (self.cluster is not None and self.client is not None
+                    and len(self.cluster.nodes) > 1):
+                return self._apply_ingest_distributed(index, muts)
+            return self._apply_ingest_local(index, muts)
+        finally:
+            qos.current_priority.reset(tok)
+
+    def _apply_ingest_distributed(self, index: Index, muts) -> list:
+        """The per-mutation replica discipline of _execute_write_distributed
+        applied batch-wide: live/skip split per shard, draining demotion
+        to durable hints, all-down/all-draining hard errors per mutation,
+        synchronous new-shard announcement before waking waiters. Each
+        remote replica receives ONE multi-call envelope per batch (bulk-
+        applied by its remote=True interception); each skipped replica
+        gets ONE hint record per batch."""
+        from pilosa_tpu.net.client import ClientError
+        outcomes: list = [None] * len(muts)
+        acked = [0] * len(muts)
+        ored = [False] * len(muts)
+        skipped = [False] * len(muts)
+        local: list = []
+        by_node: dict[str, list] = {}
+        hint_by_node: dict[str, list] = {}
+        new_shard_muts: list = []
+        for mi, m in enumerate(muts):
+            shard = m.shard
+            targets = self.cluster.shard_nodes(index.name, shard)
+            live = [n for n in targets
+                    if not self.cluster.is_unavailable(n.id)]
+            if targets and not live:
+                outcomes[mi] = ("err", ExecutionError(
+                    "all replicas down for write"))
+                continue
+            if m.is_set:
+                fld = index.field(m.field_name)
+                if (fld is not None
+                        and not fld.available_shards.contains(shard)):
+                    new_shard_muts.append((m.field_name, shard, mi))
+            for n in targets:
+                if n in live:
+                    if n.id == self.cluster.local_id:
+                        local.append((mi, m))
+                    else:
+                        by_node.setdefault(n.id, []).append((mi, m))
+                else:
+                    skipped[mi] = True
+                    hint_by_node.setdefault(n.id, []).append((mi, m))
+        if local:
+            res = self._apply_ingest_local(index, [m for _, m in local])
+            for (mi, _m), out in zip(local, res):
+                if outcomes[mi] is not None:
+                    continue
+                if out[0] == "err":
+                    outcomes[mi] = out
+                else:
+                    acked[mi] += 1
+                    ored[mi] = ored[mi] or bool(out[1])
+        for node_id, items in by_node.items():
+            node = self.cluster.node_by_id(node_id)
+            pql = "\n".join(m.call.to_pql() for _, m in items)
+            try:
+                results = self.client.query_proto(
+                    node.uri, index.name, pql, shards=None, remote=True)
+                with self._ingest_lock:
+                    self.ingest_stats["remoteBatches"] += 1
+                    self.ingest_stats["remoteMutations"] += len(items)
+                for (mi, _m), r in zip(items, results):
+                    if outcomes[mi] is not None:
+                        continue
+                    acked[mi] += 1
+                    ored[mi] = ored[mi] or bool(r)
+            except ClientError as e:
+                if (e.shed_reason == "draining"
+                        or self.cluster.is_unavailable(node_id)):
+                    # started draining between planning and send: demote
+                    # this node's share of the batch to a durable hint
+                    if e.shed_reason == "draining":
+                        self.cluster.mark_draining(node_id)
+                    for mi, _m in items:
+                        skipped[mi] = True
+                    hint_by_node.setdefault(node_id, []).extend(items)
+                else:
+                    err = ExecutionError(f"replica write failed: {e}")
+                    for mi, _m in items:
+                        if outcomes[mi] is None:
+                            outcomes[mi] = ("err", err)
+        for mi in range(len(muts)):
+            if outcomes[mi] is not None:
+                continue
+            if skipped[mi] and not acked[mi]:
+                # every target raced into draining: landed nowhere
+                outcomes[mi] = ("err", ExecutionError(
+                    "all replicas draining for write"))
+            else:
+                outcomes[mi] = ("ok", ored[mi])
+        # skipped replicas: one group hint per node per batch, covering
+        # only mutations that actually acked (a failed mutation was never
+        # acked, so replaying it could resurrect a write the client saw
+        # rejected)
+        for node_id, items in hint_by_node.items():
+            good = [m for mi, m in items if outcomes[mi][0] == "ok"]
+            if not good:
+                continue
+            self._hint_write(node_id, index.name,
+                             "\n".join(m.call.to_pql() for m in good), None)
+            with self._ingest_lock:
+                self.ingest_stats["hintedMutations"] += len(good)
+        # shard-creating Sets: announce synchronously BEFORE waking the
+        # waiters, so the ack implies cluster-wide planability (the
+        # read-your-writes-through-any-node contract)
+        seen: set = set()
+        for fname, shard, mi in new_shard_muts:
+            if outcomes[mi][0] != "ok" or (fname, shard) in seen:
+                continue
+            seen.add((fname, shard))
+            with self._ingest_lock:
+                self.ingest_stats["newShards"] += 1
+            if self.announce_shard_fn is not None:
+                self.announce_shard_fn(index.name, fname, shard)
+            if not any(n.id == self.cluster.local_id
+                       for n in self.cluster.shard_nodes(index.name,
+                                                         shard)):
+                # every replica is remote: merge availability first-hand
+                # (quiet — the owners' own announcements still fire)
+                fld = index.field(fname)
+                if fld is not None:
+                    fld.add_available_shard(shard, quiet=True)
+        n_err = sum(1 for o in outcomes if o[0] == "err")
+        if n_err:
+            with self._ingest_lock:
+                self.ingest_stats["errors"] += n_err
+        return outcomes
+
+    def _apply_ingest_local(self, index: Index, muts) -> list:
+        """Apply one coalesced batch to THIS node's fragments: group per
+        (field, view, shard), one Fragment.apply_batch each — one WAL
+        group-commit, one sorted-dedup container merge, one generation
+        bump per fragment — then the batch-granular side effects the
+        per-bit path pays per mutation: rank-cache refresh and hybrid
+        hysteresis once per touched row, heat charged batch-size-
+        weighted, existence marked through the same bulk apply, resident
+        leaves patched in place. Returns ("ok", changed) / ("err", exc)
+        per mutation, order-aligned."""
+        outcomes: list = [None] * len(muts)
+        groups: dict = {}
+        fields: dict = {}
+        for mi, m in enumerate(muts):
+            f = fields.get(m.field_name)
+            if f is None:
+                f = index.field(m.field_name)
+                if f is None:
+                    outcomes[mi] = ("err", ExecutionError(
+                        f"field not found: {m.field_name}"))
+                    continue
+                fields[m.field_name] = f
+            shard = m.shard
+            if m.is_set:
+                view = f.create_view_if_not_exists(VIEW_STANDARD)
+                view.create_fragment_if_not_exists(shard)
+                groups.setdefault((m.field_name, VIEW_STANDARD, shard),
+                                  []).append((mi, m))
+            else:
+                in_any = False
+                for v in list(f.views.values()):
+                    if v.name.startswith("bsig_"):
+                        continue
+                    if v.fragments.get(shard) is None:
+                        continue
+                    groups.setdefault((m.field_name, v.name, shard),
+                                      []).append((mi, m))
+                    in_any = True
+                if not in_any:
+                    outcomes[mi] = ("ok", False)
+        tracker = self.heat
+        hyb = self.hybrid
+        # (field, view, row) -> {shard: [pre_gen, post_gen, net_set_cols,
+        # net_clear_cols]} — the residency patch input
+        touched: dict = {}
+        set_cols_by_shard: dict[int, set] = {}
+        for (fname, vname, shard), items in groups.items():
+            f = fields[fname]
+            view = f.view(vname)
+            frag = view.fragments[shard]
+            rows = {m.row_id for _, m in items}
+            pre = {r: frag.row_generation(r) for r in rows}
+            try:
+                changed, wal_ops, wal_appends = frag.apply_batch(
+                    [(m.is_set, m.row_id, m.col) for _, m in items])
+            except BaseException as e:  # noqa: BLE001 — per-group failure
+                for mi, _m in items:
+                    outcomes[mi] = ("err", e)
+                continue
+            changed_rows: set = set()
+            for (mi, m), ch in zip(items, changed):
+                if ch:
+                    changed_rows.add(m.row_id)
+                prev = outcomes[mi]
+                if prev is not None and prev[0] == "err":
+                    continue  # an earlier view's failure is sticky
+                outcomes[mi] = ("ok",
+                                ch if prev is None else (prev[1] or ch))
+            if changed_rows:
+                # net last-write-wins state per (row, local col): the
+                # idempotent patch payload (setting a set bit / clearing
+                # a clear bit are no-ops on the device side)
+                net: dict = {}
+                for _mi, m in items:
+                    s_, c_ = net.setdefault(m.row_id, (set(), set()))
+                    lc = m.col % SHARD_WIDTH
+                    if m.is_set:
+                        s_.add(lc)
+                        c_.discard(lc)
+                    else:
+                        c_.add(lc)
+                        s_.discard(lc)
+                for r in changed_rows:
+                    # once per changed row, not per mutation: rank cache
+                    view._update_rank(shard, frag, r)
+                    t = touched.setdefault((fname, vname, r), {})
+                    t[shard] = [pre[r], frag.row_generation(r),
+                                net[r][0], net[r][1]]
+                if hyb is not None and hyb.active():
+                    fk = [(index.name, fname, vname, shard)]
+                    for r in changed_rows:
+                        hyb.observe((index.name, fname, vname, r),
+                                    frag.row_cardinality(r), frag_keys=fk)
+                    with self._ingest_lock:
+                        self.ingest_stats["hybridEvals"] += \
+                            len(changed_rows)
+            if tracker is not None and tracker.enabled:
+                # batch-size-weighted write heat, one charge per fragment
+                # (satellite: Sets charge like the per-bit path — every
+                # Set — Clears only when they changed a bit)
+                w = sum(1 for (_mi, m), ch in zip(items, changed)
+                        if m.is_set or ch)
+                if w:
+                    tracker.touch(index.name, fname, vname, shard,
+                                  writes=w)
+            if any(m.is_set for _mi, m in items):
+                f.add_available_shard(shard)
+                set_cols_by_shard.setdefault(shard, set()).update(
+                    m.col for _mi, m in items if m.is_set)
+            with self._ingest_lock:
+                st = self.ingest_stats
+                st["appliedBatches"] += 1
+                st["walAppends"] += wal_appends
+                st["walOps"] += wal_ops
+        self._ingest_mark_exists(index, set_cols_by_shard, outcomes, muts)
+        if touched:
+            try:
+                self._ingest_patch_residency(index, touched)
+            except Exception:  # noqa: BLE001 — patching is optional
+                # the durable write already happened and the generation
+                # bump re-keys every touched leaf, so a failed patch can
+                # only cost a re-upload — it must never fail acked writes
+                with self._ingest_lock:
+                    self.ingest_stats["patchDropped"] += 1
+        n_err = sum(1 for o in outcomes if o is not None and o[0] == "err")
+        if n_err:
+            with self._ingest_lock:
+                self.ingest_stats["errors"] += n_err
+        return [o if o is not None else ("ok", False) for o in outcomes]
+
+    def _ingest_mark_exists(self, index: Index, set_cols_by_shard: dict,
+                            outcomes: list, muts) -> None:
+        """Batched index.mark_exists: the per-bit path pays one existence
+        set_bit (with its own WAL op + fsync) per Set — which would undo
+        the whole group commit — so the existence row rides the same
+        Fragment.apply_batch, one WAL append per existence fragment."""
+        if not set_cols_by_shard or not getattr(index, "track_existence",
+                                                False):
+            return
+        ef = index.existence_field()
+        if ef is None:
+            return
+        ev = ef.create_view_if_not_exists(VIEW_STANDARD)
+        for shard, cols in sorted(set_cols_by_shard.items()):
+            efrag = ev.create_fragment_if_not_exists(shard)
+            try:
+                ech, wal_ops, wal_appends = efrag.apply_batch(
+                    [(True, 0, c) for c in sorted(cols)])
+            except BaseException as e:  # noqa: BLE001 — existence failure
+                # fails the shard's Sets, as the per-bit mark_exists would
+                for mi, m in enumerate(muts):
+                    if m.is_set and m.shard == shard:
+                        outcomes[mi] = ("err", e)
+                continue
+            if any(ech):
+                ev._update_rank(shard, efrag, 0)
+            ef.add_available_shard(shard)
+            with self._ingest_lock:
+                st = self.ingest_stats
+                st["appliedBatches"] += 1
+                st["walAppends"] += wal_appends
+                st["walOps"] += wal_ops
+
+    def _ingest_patch_residency(self, index: Index, touched: dict) -> None:
+        """Patch HBM-resident row leaves with the batch's net effect
+        instead of letting the generation bump strand them: a matching
+        dense leaf absorbs per-word set/clear masks (2·k·8 bytes over the
+        link instead of 128 KiB per shard on the next read), a sparse
+        leaf absorbs sorted add/remove arrays when it stays in its slot
+        bucket. Purely an optimization — generation-keyed lookups mean
+        any dropped or unmatched entry is re-uploaded correctly on its
+        next read."""
+        from pilosa_tpu.ops import bitvector as bv
+        iname = index.name
+
+        def p2(n: int) -> int:
+            k = 8
+            while k < n:
+                k <<= 1
+            return k
+
+        def parse(key):
+            if not (isinstance(key, tuple) and key
+                    and key[1:2] == (iname,)):
+                return None
+            if key[0] == "row" and len(key) == 7:
+                out = key[2], key[3], key[4], key[5], key[6], 0
+            elif key[0] == "sparse" and len(key) == 8:
+                out = key[2], key[3], key[4], key[5], key[7], key[6]
+            else:
+                return None
+            # shards/gens must be same-length tuples: a leaf uploaded
+            # before its view existed carries gens=() (_leaf_gens on a
+            # missing view) — un-patchable, re-keyed on its next read
+            if (not isinstance(out[3], tuple) or not isinstance(out[4], tuple)
+                    or len(out[3]) != len(out[4])):
+                return None
+            return out
+
+        def matcher(key):
+            p = parse(key)
+            if p is None:
+                return False
+            fld, vw, row, shards_t, gens, _slots = p
+            hit = False
+            for i, s in enumerate(shards_t):
+                e = touched.get((fld, vw, row), {}).get(s)
+                if e is not None:
+                    if gens[i] != e[0]:
+                        return False  # older-stale: un-patchable, leave
+                    hit = True
+            return hit
+
+        def patcher(key, arr):
+            fld, vw, row, shards_t, gens, slots = parse(key)
+            t = touched[(fld, vw, row)]
+            new_gens = tuple(t[s][1] if s in t else g
+                             for s, g in zip(shards_t, gens))
+            if key[0] == "row":
+                # per-(shard, word) mask reduction: each coordinate once
+                pairs: dict = {}
+                for i, s in enumerate(shards_t):
+                    e = t.get(s)
+                    if e is None:
+                        continue
+                    for c in e[2]:
+                        mm = pairs.setdefault((i, c >> 5), [0, 0])
+                        mm[0] |= 1 << (c & 31)
+                    for c in e[3]:
+                        mm = pairs.setdefault((i, c >> 5), [0, 0])
+                        mm[1] |= 1 << (c & 31)
+                n = p2(len(pairs))
+                sidx = np.full(n, arr.shape[0], dtype=np.int32)
+                widx = np.zeros(n, dtype=np.int32)
+                smask = np.zeros(n, dtype=np.uint32)
+                cmask = np.zeros(n, dtype=np.uint32)
+                for j, ((i, w), (sm, cm)) in enumerate(
+                        sorted(pairs.items())):
+                    sidx[j] = i
+                    widx[j] = w
+                    smask[j] = sm
+                    cmask[j] = cm
+                new_arr = bv.patch_dense_words(arr, sidx, widx, smask,
+                                               cmask)
+                with self._ingest_lock:
+                    self.ingest_stats["patchedDense"] += 1
+                return (("row", iname, fld, vw, row, shards_t, new_gens),
+                        new_arr)
+            # sparse: only while the row stays in the SAME slot bucket —
+            # the read path probes with pad_slots(current card), so a
+            # bucket move would strand the entry anyway
+            f = index.field(fld)
+            view = f.view(vw) if f is not None else None
+            if view is None:
+                return None
+            max_card = 0
+            for s in shards_t:
+                fr = view.fragment(s)
+                if fr is not None:
+                    c = fr.row_cardinality(row)
+                    if c > max_card:
+                        max_card = c
+            if self.hybrid.pad_slots(max(max_card, 1)) != slots:
+                with self._ingest_lock:
+                    self.ingest_stats["patchDropped"] += 1
+                return None
+            na = max((len(t[s][2]) for s in t), default=0)
+            nr = max((len(t[s][3]) for s in t), default=0)
+            adds = np.full((arr.shape[0], p2(na)), bv.SPARSE_SENTINEL,
+                           np.int32)
+            rems = np.full((arr.shape[0], p2(nr)), bv.SPARSE_SENTINEL,
+                           np.int32)
+            for i, s in enumerate(shards_t):
+                e = t.get(s)
+                if e is None:
+                    continue
+                if e[2]:
+                    cs = np.sort(np.fromiter(e[2], np.int64)).astype(
+                        np.int32)
+                    adds[i, :cs.size] = cs
+                if e[3]:
+                    cs = np.sort(np.fromiter(e[3], np.int64)).astype(
+                        np.int32)
+                    rems[i, :cs.size] = cs
+            new_arr = bv.patch_sparse_rows(arr, adds, rems)
+            with self._ingest_lock:
+                self.ingest_stats["patchedSparse"] += 1
+            return (("sparse", iname, fld, vw, row, shards_t, slots,
+                     new_gens), new_arr)
+
+        self.residency.patch_entries(matcher, patcher)
+
+    def ingest_snapshot(self) -> dict:
+        """The /debug/vars `ingest` block + /metrics family source:
+        batcher queue/coalesce counters merged with the executor-level
+        apply/WAL/patch counters."""
+        from pilosa_tpu.parallel.ingest import ingest_env_enabled
+        out = self.ingest.snapshot()
+        with self._ingest_lock:
+            out.update(self.ingest_stats)
+        out["enabled"] = ingest_env_enabled()
+        out["windowS"] = self.ingest.admission_s
+        out["maxBatch"] = self.ingest.max_batch
+        return out
 
     def _reduce(self, call: Call, partials: list, index: Optional[Index] = None,
                 shards: Optional[list[int]] = None):

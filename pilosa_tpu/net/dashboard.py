@@ -139,6 +139,8 @@ const LOCAL_SERIES = [
   ["ici.slice_local_per_s", "ICI slice-local / s", fmtNum],
   ["hybrid.sparse_share", "hybrid sparse upload share (window)", fmtRatio],
   ["hybrid.sparse_bytes", "hybrid sparse resident bytes", fmtBytes],
+  ["ingest.sets_per_s", "ingest mutations / s", fmtNum],
+  ["ingest.wal_appends_per_s", "ingest WAL group commits / s", fmtNum],
   ["usage.queries_per_s", "accounted queries / s", fmtNum],
   ["qos.admitted_per_s", "QoS admitted / s", fmtNum],
   ["qos.shed_per_s", "QoS shed / s", fmtNum],

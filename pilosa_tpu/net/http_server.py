@@ -632,6 +632,12 @@ class Handler:
             # the operator's view of how much HBM the sparse rows return
             if hasattr(ex, "hybrid_snapshot"):
                 snap["hybrid"] = ex.hybrid_snapshot()
+            # coalesced streaming ingest (parallel/ingest.py +
+            # executor._apply_ingest_*): batch/coalesce economics, WAL
+            # group-commit ratio (mutations per fsync-able append), and
+            # the in-place resident-leaf patch counters
+            if hasattr(ex, "ingest_snapshot"):
+                snap["ingest"] = ex.ingest_snapshot()
             # fragment heat map (utils/heat.py): top hot/cold fragments,
             # totals, skew — the expvar mirror of GET /debug/heat
             tracker = getattr(ex, "heat", None)
@@ -934,6 +940,25 @@ class Handler:
             counts["hedges/fired"] = getattr(ex, "hedges_fired", 0)
             counts["hedges/won"] = getattr(ex, "hedges_won", 0)
             counts["hedges/cancelled"] = getattr(ex, "hedges_cancelled", 0)
+            # coalesced streaming ingest: the full keyspace emitted
+            # unconditionally (zeros included) so an "ingest stalled" or
+            # "fsync ratio collapsed" alert never races the first write
+            # for the family to exist
+            if hasattr(ex, "ingest_snapshot"):
+                ing = ex.ingest_snapshot()
+                counts["ingest,op:set"] = ing["setMutations"]
+                counts["ingest,op:clear"] = ing["clearMutations"]
+                counts["ingestBatches,kind:applied"] = ing["appliedBatches"]
+                counts["ingestBatches,kind:remote"] = ing["remoteBatches"]
+                counts["ingestWal/appends"] = ing["walAppends"]
+                counts["ingestWal/ops"] = ing["walOps"]
+                counts["ingestPatch,kind:dense"] = ing["patchedDense"]
+                counts["ingestPatch,kind:sparse"] = ing["patchedSparse"]
+                counts["ingestPatch,kind:dropped"] = ing["patchDropped"]
+                counts["ingest/hinted"] = ing["hintedMutations"]
+                counts["ingest/errors"] = ing["errors"]
+                gauges["ingest/queueDepth"] = ing["queue_depth"]
+                gauges["ingest/enabled"] = 1.0 if ing["enabled"] else 0.0
             # ICI slice-local routing: the full route keyspace emitted
             # unconditionally (zeros included) like the planner families,
             # so a "slice-local share collapsed" alert never races the

@@ -421,6 +421,51 @@ def sparse_from_columns(columns: np.ndarray, slots: int) -> np.ndarray:
     return out
 
 
+# ---------------------------------------------------------------------------
+# Batched ingest patch kernels (ISSUE 16): apply one coalesced write batch
+# to a RESIDENT leaf in place of evicting it. The host pre-reduces the
+# batch to per-word masks (dense) or per-shard sorted add/remove arrays
+# (sparse), so the device work is one gather+bitwise+scatter — a few KiB
+# over the link instead of a full 128 KiB-per-shard re-upload on the next
+# read of a freshly-written row.
+# ---------------------------------------------------------------------------
+
+
+@counted_jit("ingest")
+def patch_dense_words(plane: jax.Array, sidx: jax.Array, widx: jax.Array,
+                      set_mask: jax.Array, clear_mask: jax.Array) -> jax.Array:
+    """Patch a dense row leaf uint32[S', W] at (sidx, widx) word slots:
+    new = (old | set_mask) & ~clear_mask. The masks are per-word
+    reductions of the whole batch (host-side bitwise_or accumulation), so
+    each (shard, word) coordinate appears at most once — a scatter-add
+    would corrupt already-set bits with carries; gather-modify-set is
+    exact. Pad entries carry sidx == S' (one past the shard axis) with
+    zero masks: the gather clamps to a real word it leaves unchanged and
+    mode="drop" discards the out-of-range write."""
+    cur = plane[sidx, widx]
+    new = (cur | set_mask) & ~clear_mask
+    return plane.at[sidx, widx].set(new, mode="drop")
+
+
+@counted_jit("ingest")
+def patch_sparse_rows(sp: jax.Array, adds: jax.Array,
+                      removes: jax.Array) -> jax.Array:
+    """Patch a sparse row leaf int32[S', K] with per-shard sorted
+    sentinel-padded add[S', A] / remove[S', R] column arrays: the
+    sorted-dedup union of the adds minus the removes, re-padded back to
+    the SAME K slots (the caller verified the post-batch cardinality
+    still fits K, else it drops the entry and lets the next read
+    re-upload through the hybrid chooser)."""
+    k = sp.shape[-1]
+    srt = jnp.sort(jnp.concatenate([sp, adds], axis=-1), axis=-1)
+    edge = jnp.full(srt.shape[:-1] + (1,), -1, dtype=srt.dtype)
+    dup_prev = srt == jnp.concatenate([edge, srt[..., :-1]], axis=-1)
+    merged = jnp.sort(jnp.where(dup_prev, SPARSE_SENTINEL, srt), axis=-1)
+    keep = ~_member_in_sorted(merged, removes) & (merged < SPARSE_SENTINEL)
+    return jnp.sort(jnp.where(keep, merged, SPARSE_SENTINEL),
+                    axis=-1)[..., :k]
+
+
 def eval_hybrid(program, leaves: list, kinds: list,
                 n_words: int = SHARD_WIDTH // WORD_BITS,
                 sparse_dense_fn=None):

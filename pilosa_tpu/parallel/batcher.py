@@ -135,6 +135,17 @@ class ContinuousBatcher:
     # the per-principal device attribution admission control acts on)
     ACCOUNT_DEVICE_MS = True
 
+    # whether leadership hands off at the CUT (before dispatch) or after
+    # the batch completes. At-cut is right for read dispatches: the next
+    # leader's admission overlaps this batch's device round trip. The
+    # write-side IngestBatcher overrides to False — group commit only
+    # coalesces if arrivals ACCUMULATE while the in-flight apply runs;
+    # handing off at the cut would let every arrival lead its own
+    # singleton batch concurrently and no batch would ever exceed one
+    # payload (one fsync per client write, the exact cost the batcher
+    # exists to amortize)
+    HANDOFF_AT_CUT = True
+
     def __init__(self, max_batch: int = MAX_BATCH):
         self.max_batch = max_batch
         self.admission_s = _ADMISSION_S
@@ -263,26 +274,48 @@ class ContinuousBatcher:
             # leader's admission+dispatch overlaps this batch's dispatch
             # AND its result round trip (dispatch itself costs ~a link
             # transfer on a tunneled chip; serializing dispatches caps the
-            # dispatch rate and with it the whole serving throughput)
-            if q:
-                q[0].promoted = True
-                q[0].event.set()  # leadership stays marked; they continue
-            else:
-                self._leaders.discard(key)
-                self._leader_threads.pop(key, None)
-                # drop the drained queue entry: id()-based keys (plane
-                # slabs) are unbounded over a server's life, and a retired
-                # slab's key would otherwise linger forever
-                del self._pending[key]
-        handle = _FAILED
-        t_cut = time.perf_counter()  # dispatch+finalize wall (attribution)
-        if batch:
-            try:
-                handle = self._dispatch(key, [r.payload for r in batch])
-            except BaseException as e:  # noqa: BLE001 — waiters must wake
-                self._deliver_exc(batch, e)
-        if batch and handle is not _FAILED:
-            self._run(key, batch, handle, t_cut)
+            # dispatch rate and with it the whole serving throughput).
+            # Hold-through-apply batchers defer this to the finally below.
+            if self.HANDOFF_AT_CUT:
+                if q:
+                    q[0].promoted = True
+                    q[0].event.set()  # leadership stays marked; continue
+                else:
+                    self._leaders.discard(key)
+                    self._leader_threads.pop(key, None)
+                    # drop the drained queue entry: id()-based keys (plane
+                    # slabs) are unbounded over a server's life, and a
+                    # retired slab's key would otherwise linger forever
+                    del self._pending[key]
+        try:
+            handle = _FAILED
+            t_cut = time.perf_counter()  # dispatch+finalize wall
+            if batch:
+                try:
+                    handle = self._dispatch(key,
+                                            [r.payload for r in batch])
+                except BaseException as e:  # noqa: BLE001 — waiters wake
+                    self._deliver_exc(batch, e)
+            if batch and handle is not _FAILED:
+                self._run(key, batch, handle, t_cut)
+        finally:
+            if not self.HANDOFF_AT_CUT:
+                # post-apply handoff: arrivals that queued during the
+                # apply are cut as ONE batch by the promoted follower.
+                # MUST run on every exit path — this thread stays marked
+                # leader through the apply, and since it returns to
+                # application code alive, followers' dead-leader reclaim
+                # would never fire: skipping this release deadlocks them.
+                with self._lock:
+                    q = self._pending.get(key)
+                    if q:
+                        q[0].promoted = True
+                        q[0].event.set()
+                    else:
+                        self._leaders.discard(key)
+                        self._leader_threads.pop(key, None)
+                        if q is not None:
+                            del self._pending[key]
 
     def _run(self, key: tuple, batch: list[_Req], handle,
              t_cut: Optional[float] = None) -> None:

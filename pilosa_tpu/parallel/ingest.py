@@ -1,0 +1,122 @@
+"""Write-side continuous batching: coalesced streaming ingest (ISSUE 16).
+
+The read path already coalesces concurrent queries into single device
+dispatches (parallel/batcher.py). This module applies the same leadership
+protocol to the MUTATION plane: concurrent client Set/Clear calls queue
+under one compatibility key per index, the first arrival leads, and the
+whole batch is applied as per-(fragment, shard) bulk operations — one WAL
+group-commit (one framed record batch + one fsync, storage/roaring.py
+append_ops), one sorted-dedup container merge, and one generation bump
+per fragment per batch instead of per bit (the bulk-operation argument of
+the roaring line, arXiv:1709.07821 / arXiv:1402.6407, applied online).
+
+Group commit is self-clocked: the default admission window is ZERO — a
+lone writer cuts immediately and pays one per-bit-equivalent apply, while
+under concurrency arrivals accumulate behind the in-flight apply (batch
+N+1's leader blocks on the fragment locks behind batch N), so the steady-
+state batch size tracks arrival_rate x apply_time, the classic database
+group-commit dynamic. `[ingest] batch-window` trades lone-writer latency
+for larger batches on fsync-heavy configs.
+
+Ingest rides the QoS `batch` class: the executor submits under a `batch`
+priority token, so when an overflowing queue is cut by priority,
+interactive traffic is served first and ingest never moves interactive
+p99 through queue position. PILOSA_TPU_INGEST=0 is the kill switch (read
+per call in the executor): mutations fall back to the per-bit write path
+with identical semantics — the parity fuzz flips it at runtime.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Callable
+
+from pilosa_tpu.constants import SHARD_WIDTH
+from pilosa_tpu.parallel.batcher import ContinuousBatcher
+
+# per-batch mutation ceiling: bounds the host merge arrays and the WAL
+# record burst; far above the read batchers' 512 because a mutation is a
+# dozen bytes, not a device leaf
+DEFAULT_MAX_BATCH = 4096
+
+
+def ingest_env_enabled() -> bool:
+    """PILOSA_TPU_INGEST=0 disables write coalescing at the interception
+    site (read per decision: the emergency toggle needs no restart, and
+    the parity fuzz flips it at runtime). In-flight batches drain
+    normally; new mutations take the per-bit path."""
+    return os.environ.get("PILOSA_TPU_INGEST", "1") != "0"
+
+
+class Mutation:
+    """One pre-translated Set/Clear riding an ingest batch. Translation
+    (column/row key -> id) happens on the SUBMITTING thread before
+    enqueue — the leader must never pay a stranger's translator round
+    trip — so the batch apply is pure id-space work."""
+
+    __slots__ = ("is_set", "field_name", "row_id", "col", "call")
+
+    def __init__(self, is_set: bool, field_name: str, row_id: int,
+                 col: int, call):
+        self.is_set = is_set
+        self.field_name = field_name
+        self.row_id = row_id
+        self.col = col
+        self.call = call  # original parsed Call: remote fan-out / hints
+
+    @property
+    def shard(self) -> int:
+        return self.col // SHARD_WIDTH
+
+
+class IngestBatcher(ContinuousBatcher):
+    """Continuous batcher over mutation payloads. A payload is one
+    client request's list of Mutations; `apply_fn(index_name, muts)`
+    (the executor's distributed batch apply) returns one outcome per
+    mutation — ("ok", changed_bool) or ("err", exception) — and the
+    batcher slices the flat outcome list back per request. Per-request
+    errors therefore stay per-request: one mutation whose replicas are
+    all down fails only its submitter, not the co-batched strangers."""
+
+    # the apply is host-side WAL + container-merge work (plus a small
+    # optional patch kernel); charging its wall as device-ms would
+    # poison the per-principal device attribution, same as NodeCoalescer
+    ACCOUNT_DEVICE_MS = False
+
+    # hold leadership THROUGH the apply: group commit is self-clocked by
+    # arrivals accumulating behind the in-flight apply, which only
+    # happens if the key stays led for its duration (see base class)
+    HANDOFF_AT_CUT = False
+
+    def __init__(self, apply_fn: Callable, max_batch: int = DEFAULT_MAX_BATCH,
+                 window_s: float = 0.0):
+        super().__init__(max_batch=max_batch)
+        # self-clocked group commit by default (see module docstring);
+        # overrides the read batchers' shared admission default
+        self.admission_s = float(window_s)
+        self._apply = apply_fn
+        self.mutations = 0
+        self.set_mutations = 0
+        self.clear_mutations = 0
+
+    def _compute(self, key: tuple, payloads: list) -> list:
+        muts: list[Mutation] = []
+        spans = []
+        for p in payloads:
+            spans.append((len(muts), len(p)))
+            muts.extend(p)
+        outcomes = self._apply(key[0], muts)
+        n_sets = sum(1 for m in muts if m.is_set)
+        with self._lock:
+            self.mutations += len(muts)
+            self.set_mutations += n_sets
+            self.clear_mutations += len(muts) - n_sets
+        return [outcomes[off:off + n] for off, n in spans]
+
+    def snapshot(self) -> dict:
+        out = super().snapshot()
+        with self._lock:
+            out["mutations"] = self.mutations
+            out["setMutations"] = self.set_mutations
+            out["clearMutations"] = self.clear_mutations
+        return out

@@ -166,6 +166,50 @@ class DeviceResidency:
                     # residency-transition history: the fragment left HBM
                     tracker.touch_many(fkeys, evictions=1)
 
+    def patch_entries(self, matcher: Callable[[tuple], bool],
+                      patcher: Callable) -> tuple[int, int]:
+        """In-place batch write-through (ISSUE 16 ingest): rewrite every
+        resident entry whose key `matcher` selects. `patcher(key, arr)`
+        runs OUTSIDE the lock (it launches a device kernel) and returns
+        (new_key, new_arr) — the patched array under its post-write
+        generation key — or None to just drop the stale entry. Either
+        way the OLD key is removed: matched entries carry pre-write
+        generations, so they can never be hit again. A clear() landing
+        mid-patch (index/field deletion) aborts the swap — the epoch
+        fence, same as leaf(). Returns (patched, dropped)."""
+        with self._lock:
+            keys = [k for k in self._lru if matcher(k)]
+            epoch = self.epoch
+        patched = dropped = 0
+        for k in keys:
+            with self._lock:
+                arr = self._lru.get(k)
+            if arr is None:
+                continue
+            try:
+                res = patcher(k, arr)
+            except Exception:  # noqa: BLE001 — patching is an optimization
+                res = None  # drop: the next read re-uploads correctly
+            with self._lock:
+                if self.epoch != epoch:
+                    break
+                old = self._lru.pop(k, None)
+                if old is None:
+                    continue
+                self.bytes -= old.nbytes
+                if res is None:
+                    dropped += 1
+                    continue
+                new_key, new_arr = res
+                displaced = self._lru.pop(new_key, None)
+                if displaced is not None:
+                    self.bytes -= displaced.nbytes
+                self._lru[new_key] = new_arr
+                self.bytes += new_arr.nbytes
+                patched += 1
+                self._evict_over_budget_locked(new_key)
+        return patched, dropped
+
     def peek(self, key: tuple) -> Optional[jax.Array]:
         """The resident array for `key`, or None — WITHOUT hit/miss
         accounting (a representation probe by the hybrid manager is not
@@ -295,25 +339,20 @@ class HybridManager:
             return False
         return max(scores, default=0.0) < _heat.HOT_SCORE
 
-    def choose(self, row_key: tuple, max_card: int,
-               frag_keys=None) -> tuple[str, int]:
-        """(representation, padded slots) for one row leaf whose largest
-        per-shard cardinality is `max_card`. Hysteresis: crossing the
-        threshold upward promotes immediately (correct sizing matters
-        more than churn); inside the band a previously-dense row stays
-        dense while any covered fragment is hot, demoting only when cold
-        or when the cardinality falls below the band floor."""
-        if not self.active():
-            return "dense", 0
+    def _transition(self, prev, max_card: int, frag_keys) -> str:
+        """The hysteresis rule shared by the read-side choose() and the
+        write-side observe(): crossing the threshold upward promotes
+        immediately; inside the band a previously-dense row stays dense
+        while any covered fragment is hot, demoting only when cold or
+        when the cardinality falls below the band floor."""
         lo = self.threshold * (1.0 - self.hysteresis)
-        with self._lock:
-            prev = self._rep.get(row_key)
         if max_card > self.threshold:
-            rep = "dense"
-        elif prev == "dense" and max_card > lo:
-            rep = "sparse" if self._cold(frag_keys) else "dense"
-        else:
-            rep = "sparse"
+            return "dense"
+        if prev == "dense" and max_card > lo:
+            return "sparse" if self._cold(frag_keys) else "dense"
+        return "sparse"
+
+    def _remember(self, row_key: tuple, prev, rep: str) -> None:
         with self._lock:
             if prev is not None and prev != rep:
                 if rep == "dense":
@@ -324,7 +363,36 @@ class HybridManager:
             self._rep.move_to_end(row_key)
             while len(self._rep) > REP_MEMORY_BOUND:
                 self._rep.popitem(last=False)
+
+    def choose(self, row_key: tuple, max_card: int,
+               frag_keys=None) -> tuple[str, int]:
+        """(representation, padded slots) for one row leaf whose largest
+        per-shard cardinality is `max_card` (hysteresis: _transition)."""
+        if not self.active():
+            return "dense", 0
+        with self._lock:
+            prev = self._rep.get(row_key)
+        rep = self._transition(prev, max_card, frag_keys)
+        self._remember(row_key, prev, rep)
         return rep, self.pad_slots(max(int(max_card), 1))
+
+    def observe(self, row_key: tuple, max_card: int,
+                frag_keys=None) -> None:
+        """Write-side hysteresis tick (ISSUE 16 satellite): the batched
+        ingest path calls this ONCE per touched row per applied batch —
+        instead of re-evaluating threshold crossings mutation by mutation
+        — so under sustained churn the representation memory advances at
+        batch granularity with the exact same transition rule the read
+        path applies. Rows with no history are left alone: the next
+        read's choose() decides fresh, as it always did."""
+        if not self.active():
+            return
+        with self._lock:
+            prev = self._rep.get(row_key)
+        if prev is None:
+            return
+        rep = self._transition(prev, max_card, frag_keys)
+        self._remember(row_key, prev, rep)
 
     def record_upload(self, rep: str, nbytes: int) -> None:
         with self._lock:

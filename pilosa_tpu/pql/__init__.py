@@ -11,7 +11,11 @@ of a generated PEG parser.
 import functools
 
 from pilosa_tpu.pql.ast import Call, Condition, Query  # noqa: F401
-from pilosa_tpu.pql.parser import PQLError, parse_string  # noqa: F401
+from pilosa_tpu.pql.parser import (  # noqa: F401
+    PQLError,
+    parse_mutations_fast,
+    parse_string,
+)
 
 
 @functools.lru_cache(maxsize=1024)

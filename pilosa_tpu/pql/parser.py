@@ -459,3 +459,44 @@ class _Parser:
 def parse_string(src: str) -> Query:
     """Parse a PQL string into a Query (pql.ParseString, pql/parser.go:44)."""
     return _Parser(src).parse()
+
+
+# One whole integer-arg Set/Clear call. Anything this doesn't cover —
+# keyed ids, floats, bools, timestamps, conditions (the `==` in `f==3`
+# fails the row-id group, so conditions can't be mistaken for
+# assignments) — drops to the full parser.
+_MUTATION_RE = re.compile(
+    r"[ \t\n]*(Set|Clear)\([ \t\n]*(0|[1-9][0-9]*)[ \t\n]*,[ \t\n]*"
+    r"([A-Za-z][A-Za-z0-9_-]*)[ \t\n]*=[ \t\n]*(0|[1-9][0-9]*)[ \t\n]*\)"
+)
+
+
+def parse_mutations_fast(src: str):
+    """Linear-scan parse of an all-Set/Clear mutation envelope.
+
+    Bulk ingest arrives as long runs of `Set(col, field=row)` calls; the
+    recursive-descent parser spends ~45us per call on them, which caps a
+    single core well below the streaming-ingest target before a single
+    bit is written. This scanner builds the exact same AST (same Call
+    name/args/pos) in one regex pass. Returns None unless the ENTIRE
+    string is integer-arg Set/Clear calls — the caller then falls back
+    to parse_string, so every non-trivial query keeps full-grammar
+    behavior.
+    """
+    pos, n = 0, len(src)
+    calls = []
+    append = calls.append
+    match = _MUTATION_RE.match
+    while pos < n:
+        m = match(src, pos)
+        if m is None:
+            if src[pos:].isspace():
+                break
+            return None
+        name, col, field, row = m.group(1, 2, 3, 4)
+        append(Call(name, {"_col": int(col), field: int(row)},
+                    pos=m.start(1)))
+        pos = m.end()
+    if not calls:
+        return None
+    return Query(calls)

@@ -116,7 +116,9 @@ class Server:
                  drain_timeout: float = 30.0,
                  eviction: str = "lru",
                  events_ring: int = 2048,
-                 events_spool: int = 0):
+                 events_spool: int = 0,
+                 ingest_batch_window: float = 0.0,
+                 ingest_max_batch: int = 4096):
         self.data_dir = data_dir
         # [storage] wal-fsync, plumbed down the model tree to every
         # Fragment (PILOSA_TPU_WAL_FSYNC env overrides per fragment —
@@ -252,6 +254,15 @@ class Server:
             self.executor.coalescer.admission_s = fanout_coalesce_window
             self.executor.coalescer.max_batch = max(
                 1, fanout_coalesce_max_batch)
+        # [ingest] — write-side continuous batching (docs/operations.md
+        # "Streaming ingest"); window 0 = self-clocked group commit. The
+        # PILOSA_TPU_INGEST=0 kill switch is read per call and wins.
+        if ingest_batch_window < 0:
+            raise ValueError(
+                f"invalid [ingest] batch-window {ingest_batch_window!r} "
+                "(expected >= 0)")
+        self.executor.ingest.admission_s = float(ingest_batch_window)
+        self.executor.ingest.max_batch = max(1, ingest_max_batch)
         # [storage] eviction = lru|heat: heat steers DeviceResidency to
         # evict coldest-by-fragment-heat instead of LRU (utils/heat.py).
         # The PILOSA_TPU_HEAT=0 kill switch wins structurally: with it
@@ -2185,6 +2196,14 @@ class Server:
         raw["hybrid.sparse_uploads"] = hy["sparseUploads"]
         raw["hybrid.row_uploads"] = (hy["sparseUploads"]
                                      + hy["denseUploads"])
+        # streaming ingest: coalesced write plane — mutation throughput
+        # plus the WAL group-commit ratio (mutations per fsync-able WAL
+        # append, the headline fsync-reduction evidence)
+        ing = ex.ingest_snapshot()
+        raw["ingest.mutations"] = ing["mutations"]
+        raw["ingest.batches"] = ing["appliedBatches"]
+        raw["ingest.wal_appends"] = ing["walAppends"]
+        g["ingest.queue_depth"] = float(ing["queue_depth"])
         # hinted handoff + drain lifecycle + rejoin read fence
         hsnap = self.hints.snapshot()
         g["hints.pending_bytes"] = float(hsnap["pendingBytes"])
@@ -2278,6 +2297,9 @@ class Server:
         g["qos.admitted_per_s"] = rate("qos.admitted")
         g["qos.shed_per_s"] = rate("qos.shed")
         g["qos.throttled_per_s"] = rate("qos.throttled")
+        g["ingest.sets_per_s"] = rate("ingest.mutations")
+        g["ingest.batches_per_s"] = rate("ingest.batches")
+        g["ingest.wal_appends_per_s"] = rate("ingest.wal_appends")
         g["hints.queued_per_s"] = rate("hints.queued")
         g["hints.replayed_per_s"] = rate("hints.replayed")
         g["hints.dropped_per_s"] = rate("hints.dropped")

@@ -359,11 +359,26 @@ def test_residency_cache_hits_and_invalidation(ex):
     m1 = ex.residency.snapshot()
     assert m1["hits"] > m0["hits"]
     assert m1["misses"] == m0["misses"]
-    # write -> new generation -> miss, correct new count
+    # batched write -> resident leaf patched IN PLACE (new generation in
+    # the key, net masks applied on-device): the next read hits, with the
+    # correct new count
     ex.execute("i", "Set(9, f=1)")
     assert ex.execute("i", "Count(Row(f=1))")[0] == 4
     m2 = ex.residency.snapshot()
-    assert m2["misses"] > m1["misses"]
+    snap = ex.ingest_snapshot()
+    assert snap["patchedDense"] + snap["patchedSparse"] >= 1
+    assert m2["misses"] == m1["misses"]
+    # per-bit write path (ingest kill switch): generation bump with no
+    # patch -> the stranded entry forces a re-upload miss, correct count
+    monkey = pytest.MonkeyPatch()
+    try:
+        monkey.setenv("PILOSA_TPU_INGEST", "0")
+        ex.execute("i", "Set(10, f=1)")
+    finally:
+        monkey.undo()
+    assert ex.execute("i", "Count(Row(f=1))")[0] == 5
+    m3 = ex.residency.snapshot()
+    assert m3["misses"] > m2["misses"]
 
 
 def test_residency_eviction():

@@ -322,6 +322,48 @@ def test_live_metrics_hybrid_families(pair):
                and v == 1.0 for n, l, v in samples)
 
 
+def test_live_metrics_ingest_families(pair):
+    """Streaming ingest PR satellite: the coalesced write plane's
+    counters (mutations by op, applied batches, WAL group commits,
+    resident-leaf patches) are scrapeable — emitted unconditionally
+    (zeros included) so an "ingest stalled" alert never races the first
+    write — and the /debug/vars `ingest` block carries the full batcher
+    snapshot. Real writes through the HTTP plane back the counters."""
+    servers, uris = pair
+    for pql in (b"Set(77, f=3)", b"Set(78, f=3)", b"Clear(77, f=3)"):
+        req = urllib.request.Request(
+            uris[0] + "/index/m/query", data=pql, method="POST")
+        urllib.request.urlopen(req, timeout=30).read()
+    with urllib.request.urlopen(uris[0] + "/metrics", timeout=10) as r:
+        text = r.read().decode()
+    types, samples = check_conformance(text)
+    assert types["pilosa_ingest_total"] == "counter"
+    ops = {l.get("op"): v for n, l, v in samples
+           if n == "pilosa_ingest_total" and "op" in l}
+    assert ops.get("set", 0) >= 2 and ops.get("clear", 0) >= 1
+    kinds = {l.get("kind") for n, l, _ in samples
+             if n == "pilosa_ingestBatches_total"}
+    assert {"applied", "remote"} <= kinds
+    patch_kinds = {l.get("kind") for n, l, _ in samples
+                   if n == "pilosa_ingestPatch_total"}
+    assert {"dense", "sparse", "dropped"} <= patch_kinds
+    assert any(n == "pilosa_ingest" and l.get("key") == "enabled"
+               and v == 1.0 for n, l, v in samples)
+    # the apply lands on whichever replica owns the shard: batch + WAL
+    # group-commit evidence is asserted cluster-wide via the expvar
+    # blocks, which mirror each executor's full ingest snapshot
+    blocks = []
+    for uri in uris:
+        with urllib.request.urlopen(uri + "/debug/vars", timeout=10) as r:
+            blocks.append(json.loads(r.read())["ingest"])
+    assert all(b["enabled"] is True for b in blocks)
+    assert sum(b["mutations"] for b in blocks) >= 3  # coordinator-side
+    assert sum(b["appliedBatches"] for b in blocks) >= 1
+    applied_wal = sum(b["walAppends"] for b in blocks)
+    assert applied_wal >= 1
+    assert sum(b["walOps"] for b in blocks) >= applied_wal
+
+
 def test_live_metrics_ici_families(pair):
     """ICI serving PR satellite: the slice-local routing decision
     counters and the serving-mode program-cache economics are scrapeable
