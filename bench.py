@@ -69,6 +69,10 @@ N_ROWS = 16          # resident rows: 16 x 134MB = 2.1GB HBM
 K_BATCH = int(os.environ.get("PILOSA_BENCH_K", "512"))
 N_DISPATCH = 4       # chained dispatches measured
 
+# per-kernel representation A/B microbench (`kernels` stage)
+KERNELS_SHARDS = int(os.environ.get("PILOSA_BENCH_KERNELS_SHARDS", "32"))
+KERNELS_LOOPS = int(os.environ.get("PILOSA_BENCH_KERNELS_LOOPS", "20"))
+
 # engine-path scales (kept moderate: fragment data is built on HOST and the
 # leaves ride the tunnel into HBM once at warmup)
 EXEC_SHARDS = int(os.environ.get("PILOSA_BENCH_EXEC_SHARDS", "128"))
@@ -373,6 +377,181 @@ def bench_kernel() -> dict:
             out["pallas_hbm_gb_per_s"] = round(2 * cols / 8 / pl_s / 1e9, 1)
         except Exception as e:  # noqa: BLE001 — optional measurement
             out["pallas_error"] = f"{type(e).__name__}: {e}"
+    return out
+
+
+def bench_kernels() -> dict:
+    """Representation A/B microbench (run-container PR): the SAME logical
+    row timed as a dense plane, a sorted-index sparse array and padded
+    [start, last] run intervals, plus the TopN-counts / BSI kernels with
+    their Pallas twins off/on. Go-proxy rows are container-level numbers
+    (65536 columns/op); device seconds are normalized to per-container
+    (or per-shard for the fragment-level bench) before the ratio so
+    vs_go_reference stays apples-to-apples."""
+    import jax
+    import jax.numpy as jnp
+
+    import pilosa_tpu.ops.bitvector as bv
+    from pilosa_tpu.ops import bsi as bsiops
+    from pilosa_tpu.ops import pallas_kernels
+    from pilosa_tpu.ops import topn as topnops
+
+    S = KERNELS_SHARDS
+    W = WORDS_PER_SHARD
+    containers = S * (SHARD_WIDTH // 65536)
+    on_tpu = jax.default_backend() == "tpu"
+
+    # runny twins: 64 runs x 2048 bits per shard; operand b shifted half a
+    # run so every overlap is partial (the merge kernel's general case)
+    R = 256
+    n_runs, run_len, stride = 64, 2048, 8192
+    starts = np.arange(n_runs, dtype=np.int64) * stride
+
+    def run_row(shift):
+        iv = np.stack([starts + shift, starts + shift + run_len - 1], 1)
+        return np.broadcast_to(
+            bv.runs_from_intervals(iv, R), (S, 2, R)).copy()
+
+    ra = jnp.asarray(run_row(0))
+    rb = jnp.asarray(run_row(run_len // 2))
+    da = bv.run_to_dense(ra, W)
+    db = bv.run_to_dense(rb, W)
+
+    # sparse twins (their own regime: 2048 set bits per shard)
+    K = 4096
+
+    def sparse_row(seed):
+        cols = np.sort(np.random.default_rng(seed).choice(
+            SHARD_WIDTH, size=2048, replace=False)).astype(np.int32)
+        sp = np.full((S, K), bv.SPARSE_SENTINEL, np.int32)
+        sp[:, :2048] = cols
+        return jnp.asarray(sp)
+
+    sa, sb = sparse_row(1), sparse_row(2)
+
+    # compose count pipelines under ONE jit each so the A/B times one
+    # fused program per representation, not a chain of dispatch overheads
+    f_dense = jax.jit(lambda a, b: jnp.sum(bv.intersect_count(a, b)))
+    f_run = jax.jit(lambda a, b: jnp.sum(bv.run_intersect_count(a, b)))
+    f_run_2step = jax.jit(
+        lambda a, b: jnp.sum(bv.run_count(bv.run_intersect(a, b))))
+    f_run_dense = jax.jit(
+        lambda r, d: jnp.sum(bv.run_dense_count(r, d, W)), static_argnums=())
+    f_sparse = jax.jit(
+        lambda a, b: jnp.sum(bv.sparse_count(bv.sparse_intersect(a, b))))
+    f_sparse_dense = jax.jit(
+        lambda s, d: jnp.sum(bv.sparse_dense_count(s, d)))
+    f_sparse_run = jax.jit(
+        lambda s, r: jnp.sum(bv.sparse_count(bv.sparse_intersect_run(s, r))))
+
+    # cross-representation parity before timing anything
+    expect = int(f_dense(da, db))
+    assert int(f_run(ra, rb)) == expect, (int(f_run(ra, rb)), expect)
+    assert int(f_run_2step(ra, rb)) == expect
+    assert int(f_run_dense(ra, db)) == expect
+    sp_expect = int(f_sparse(sa, sb))
+    assert int(f_sparse_dense(sa, db)) == int(f_sparse_run(sa, rb))
+    assert sp_expect >= 0
+
+    def us(fn, *a):
+        jax.block_until_ready(fn(*a))  # compile + warm
+        t0 = time.perf_counter()
+        for _ in range(KERNELS_LOOPS):
+            r = fn(*a)
+        jax.block_until_ready(r)
+        return (time.perf_counter() - t0) / KERNELS_LOOPS * 1e6
+
+    kernels = []
+
+    def row(name, t_us, proxy=None, per_shard=False, note=""):
+        e = {"kernel": name, "us_per_op": round(t_us, 1)}
+        if note:
+            e["note"] = note
+        if proxy:
+            _attach_go_ref(e, proxy,
+                           t_us / 1e6 / (S if per_shard else containers))
+            e["go_ref_normalization"] = ("per-shard" if per_shard
+                                         else "per-container")
+        kernels.append(e)
+        return e
+
+    t_dense = us(f_dense, da, db)
+    t_run = us(f_run, ra, rb)
+    row("count_dense_dense", t_dense, "Fragment_IntersectionCount",
+        per_shard=True)
+    row("count_run_run", t_run,
+        note="fused run_intersect_count; no container-level run-by-run "
+             "proxy bench published")
+    row("count_run_run_2step", us(f_run_2step, ra, rb),
+        note="run_count(run_intersect(...)) — pays the argsort the fused "
+             "count skips")
+    row("count_run_dense", us(f_run_dense, ra, db),
+        "IntersectionCount_BitmapRun")
+    row("count_sparse_sparse", us(f_sparse, sa, sb),
+        "IntersectionCount_ArrayArray")
+    row("count_sparse_dense", us(f_sparse_dense, sa, db),
+        "IntersectionCount_ArrayBitmap")
+    row("count_sparse_run", us(f_sparse_run, sa, rb),
+        "IntersectionCount_ArrayRun")
+
+    out = {
+        "metric": "kernels_run_vs_dense_count_speedup",
+        "value": round(t_dense / t_run, 2),
+        "unit": "x (dense us / run us, same logical row)",
+        "vs_baseline": round(t_dense / t_run, 2),
+        "run_capacity_ratio": round(da.nbytes / ra.nbytes, 2),
+        "shards": S,
+        "run_slots": R,
+        "runs_per_shard": n_runs,
+    }
+
+    # TopN fused-counts kernel, XLA vs Pallas. Parity always (interpret
+    # mode); timing only on a real chip — a CPU emulation number would
+    # masquerade as a kernel measurement.
+    TR, TS = 64, 4
+    flat = jax.random.bits(jax.random.key(5), (TR, TS * W), dtype=jnp.uint32)
+    src = jax.random.bits(jax.random.key(6), (TS * W,), dtype=jnp.uint32)
+    small, ssrc = flat[:8, :2048], src[:2048]
+    assert np.array_equal(
+        np.asarray(topnops.tanimoto_counts_packed(small, ssrc)),
+        np.asarray(pallas_kernels.topn_counts_packed(small, ssrc)))
+    t_topn = us(topnops.tanimoto_counts_packed, flat, src)
+    row("topn_counts_packed[xla]", t_topn)
+    if on_tpu:
+        t_topn_pl = us(pallas_kernels.topn_counts_packed, flat, src)
+        row("topn_counts_packed[pallas]", t_topn_pl)
+        out["topn_pallas_speedup"] = round(t_topn / t_topn_pl, 2)
+
+    # BSI compare + sum sweeps, XLA vs Pallas
+    depth = 16
+    planes = jax.random.bits(jax.random.key(8), (depth, S, W),
+                             dtype=jnp.uint32)
+    exists = jnp.asarray(np.full((S, W), 0xFFFFFFFF, dtype=np.uint32))
+    pred = jnp.asarray(bsiops.value_to_bits(23456, depth))
+    sm_p, sm_e = planes[:, :8, :512], exists[:8, :512]
+    assert np.array_equal(
+        np.asarray(bsiops.compare(sm_p, sm_e, pred, "lt")),
+        np.asarray(pallas_kernels.bsi_compare(sm_p, sm_e, pred, "lt")))
+    assert np.array_equal(
+        np.asarray(bsiops.sum_counts(sm_p, sm_e)),
+        np.asarray(pallas_kernels.bsi_sum_counts(sm_p, sm_e)))
+    t_cmp = us(lambda: bsiops.compare(planes, exists, pred, "lt"))
+    row("bsi_compare_lt[xla]", t_cmp)
+    t_sum = us(bsiops.sum_counts, planes, exists)
+    row("bsi_sum_counts[xla]", t_sum)
+    if on_tpu:
+        t_cmp_pl = us(
+            lambda: pallas_kernels.bsi_compare(planes, exists, pred, "lt"))
+        row("bsi_compare_lt[pallas]", t_cmp_pl)
+        out["bsi_compare_pallas_speedup"] = round(t_cmp / t_cmp_pl, 2)
+        t_sum_pl = us(pallas_kernels.bsi_sum_counts, planes, exists)
+        row("bsi_sum_counts[pallas]", t_sum_pl)
+        out["bsi_sum_pallas_speedup"] = round(t_sum / t_sum_pl, 2)
+
+    out["pallas"] = ("timed" if on_tpu else
+                     "parity-only: interpret mode off-TPU — timing the "
+                     "emulator is not a kernel number")
+    out["kernels"] = kernels
     return out
 
 
@@ -2809,6 +2988,7 @@ def worker() -> None:
               file=sys.stderr)
 
     stage("kernel", bench_kernel)
+    stage("kernels", bench_kernels)
 
     tmp = tempfile.mkdtemp(prefix="pilosa-bench-")
     try:
@@ -2876,8 +3056,10 @@ def worker() -> None:
 
 
 def _probe_backend(timeout_s: float):
-    """(ok, error_string): can jax.devices() return, within timeout_s? Cheap
-    subprocess — avoids burning the full worker on a dead tunnel."""
+    """(ok, error_string, platform): can jax.devices() return, within
+    timeout_s? Cheap subprocess — avoids burning the full worker on a
+    dead tunnel. `platform` is the probed backend name ("tpu"/"cpu"/...)
+    when ok, "" otherwise — the `--require-onchip` gate reads it."""
     code = (
         "import jax\n"
         + (f"jax.config.update('jax_platforms', {PLATFORM!r})\n" if PLATFORM
@@ -2888,12 +3070,13 @@ def _probe_backend(timeout_s: float):
             [sys.executable, "-c", code], timeout=timeout_s,
             capture_output=True, text=True)
     except subprocess.TimeoutExpired:
-        return False, "BackendInitTimeout: jax.devices() did not return"
+        return False, "BackendInitTimeout: jax.devices() did not return", ""
     if proc.returncode == 0:
-        return True, ""
+        out_lines = (proc.stdout or "").strip().splitlines()
+        return True, "", (out_lines[-1].strip() if out_lines else "unknown")
     tail = (proc.stderr or "").strip().splitlines()
     return False, "BackendInitError: " + (tail[-1][:300] if tail else
-                                          f"rc={proc.returncode}")
+                                          f"rc={proc.returncode}"), ""
 
 
 def _read_checkpoint(path: str = "") -> list:
@@ -3122,6 +3305,11 @@ _CRITERIA = [
      lambda m: (m["value"] >= 4.0 and m["dense_overhead_pct"] <= 15.0,
                 ">= 4x resident sparse rows at equal HBM budget AND "
                 "dense headline within the 15% gate with hybrid on")),
+    (r"^kernels_run_vs_dense_count_speedup$",
+     lambda m: (m["value"] >= 1.0 and m["run_capacity_ratio"] >= 4.0,
+                "run-by-run count no slower than dense on the same "
+                "logical row AND run leaf >= 4x smaller than its dense "
+                "plane (the runny-regime win)")),
     (r"^ingest_sets_per_s$",
      lambda m: (m["value"] >= 100_000.0
                 and m["read_p50_delta_pct"] <= 15.0
@@ -3144,6 +3332,7 @@ _HEADLINE_COMPARE = [
     (r"^http_count_qps$", "higher"),
     (r"^distributed_count_qps_16shard", "higher"),
     (r"^hybrid_capacity_ratio$", "higher"),
+    (r"^kernels_run_vs_dense_count_speedup$", "higher"),
     (r"^ingest_sets_per_s$", "higher"),
 ]
 
@@ -3294,6 +3483,11 @@ def main() -> None:
         worker()
         return
 
+    # --require-onchip: refuse to publish a CPU-backend number as if it
+    # were a chip measurement — capture runs (scripts/capture_onchip.sh)
+    # must fail loudly when the tunnel hands back CpuDevice
+    require_onchip = "--require-onchip" in sys.argv
+
     for p in (CKPT_PATH, CKPT_PATH + ".best"):  # drop stale prior-run state
         try:
             os.remove(p)
@@ -3308,7 +3502,7 @@ def main() -> None:
         probe_budget = min(PROBE_TIMEOUT_S, t_end - time.monotonic() - 50)
         if probe_budget <= 5:
             break
-        ok, err = _probe_backend(probe_budget)
+        ok, err, platform = _probe_backend(probe_budget)
         if not ok:
             same_err_count = same_err_count + 1 if err == last_err else 1
             last_err = err
@@ -3318,6 +3512,11 @@ def main() -> None:
                 break  # deterministic crash — retrying won't help
             time.sleep(min(15, max(0, t_end - time.monotonic() - 45)))
             continue
+        if require_onchip and platform == "cpu":
+            print("[bench] --require-onchip: backend is CpuDevice only — "
+                  "refusing to measure (a CPU number is not an on-chip "
+                  "capture)", file=sys.stderr)
+            sys.exit(3)
         budget = t_end - time.monotonic() - 45
         if budget <= 30:
             break
@@ -3339,8 +3538,15 @@ def main() -> None:
                 last_err = f"WorkerBadOutput: {lines[-1][:200]}"
                 continue
             sys.stderr.write(proc.stderr[-3000:])
+            result = json.loads(lines[-1])
+            dev = str((result.get("detail") or {}).get("device", ""))
+            if require_onchip and dev.startswith("Cpu"):
+                # probe saw a chip but the worker fell back to CPU
+                print(f"[bench] --require-onchip: worker measured on "
+                      f"{dev!r} — refusing the artifact", file=sys.stderr)
+                sys.exit(3)
             print(lines[-1])
-            _write_bench_artifact(json.loads(lines[-1]))
+            _write_bench_artifact(result)
             _maybe_compare()
             return
         tail = (proc.stderr or proc.stdout or "").strip().splitlines()
@@ -3351,6 +3557,13 @@ def main() -> None:
             not _emit_from_committed(last_err):
         _emit_failure(last_err)
     _maybe_compare()
+    if require_onchip:
+        # reaching here means no live on-chip measurement completed —
+        # salvaged checkpoints are fine as artifacts, but a capture run
+        # demanded the chip and must say it never got one
+        print(f"[bench] --require-onchip: no live on-chip measurement "
+              f"completed ({last_err})", file=sys.stderr)
+        sys.exit(3)
 
 
 if __name__ == "__main__":

@@ -43,6 +43,13 @@ event-registry  a `.emit(...)` call on a flight-recorder journal
                 call sites and the docs glossary when every type is
                 statically visible (the inventory half lives in
                 analysis/inventories.py event_type_findings).
+raw-jit         `jax.jit` (dotted, aliased, or as a decorator) inside
+                pilosa_tpu/ops/ — a raw jit compiles outside the
+                per-family XLA telemetry (utils/telemetry.py
+                counted_jit), so its recompile storms and dispatch
+                counts are invisible to `/metrics` and the advisor.
+                Every ops kernel wraps with
+                counted_jit("<family>", ...) instead.
 """
 
 from __future__ import annotations
@@ -84,6 +91,14 @@ _EMIT_FORWARDERS = frozenset({"emit", "_journal_emit"})
 _LOCKISH = re.compile(r"(^|_)(r?lock|mu|mutex|cond)$", re.IGNORECASE)
 
 _WALL_OK = re.compile(r"#.*wall[- _]?clock", re.IGNORECASE)
+
+# the directory whose kernels must compile through counted_jit (the
+# `raw-jit` rule's scope) — everything the executor dispatches to device
+_OPS_PREFIX = "pilosa_tpu/ops/"
+_RAW_JIT_MSG = ("raw jax.jit compiles outside the per-family XLA "
+                "telemetry; wrap with utils.telemetry.counted_jit("
+                "\"<family>\", ...) so recompiles and dispatches are "
+                "observable")
 
 
 @dataclass(frozen=True)
@@ -147,6 +162,9 @@ class _FileLinter(ast.NodeVisitor):
         self.findings: list[Finding] = []
         # names bound by `from threading import Thread/Timer`
         self.thread_aliases: set[str] = set()
+        # names bound by `from jax import jit` (raw-jit rule)
+        self.jit_aliases: set[str] = set()
+        self.is_ops = relpath.replace(os.sep, "/").startswith(_OPS_PREFIX)
         # enclosing-function names (the event-registry forwarder exempt)
         self._func_stack: list[str] = []
         self.is_wrapper = relpath.replace("/", os.sep).endswith(
@@ -168,12 +186,30 @@ class _FileLinter(ast.NodeVisitor):
 
     # -- rules ------------------------------------------------------------
 
+    def _is_raw_jit(self, node: ast.expr) -> bool:
+        """`jax.jit` as a Name/Attribute expression (decorator or callee),
+        including `from jax import jit` aliases."""
+        if isinstance(node, ast.Name) and node.id in self.jit_aliases:
+            return True
+        return _dotted(node) == "jax.jit"
+
+    def _check_decorators(self, node) -> None:
+        # raw-jit: a BARE `@jax.jit` decorator is an Attribute, not a
+        # Call, so visit_Call never sees it — check decorator lists here
+        # (`@jax.jit(...)` / `jax.jit(fn)` forms go through visit_Call)
+        if self.is_ops:
+            for dec in node.decorator_list:
+                if self._is_raw_jit(dec):
+                    self._emit(dec, "raw-jit", _RAW_JIT_MSG)
+
     def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self._check_decorators(node)
         self._func_stack.append(node.name)
         self.generic_visit(node)
         self._func_stack.pop()
 
     def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef) -> None:
+        self._check_decorators(node)
         self._func_stack.append(node.name)
         self.generic_visit(node)
         self._func_stack.pop()
@@ -183,10 +219,17 @@ class _FileLinter(ast.NodeVisitor):
             for alias in node.names:
                 if alias.name in ("Thread", "Timer"):
                     self.thread_aliases.add(alias.asname or alias.name)
+        if node.module == "jax":
+            for alias in node.names:
+                if alias.name == "jit":
+                    self.jit_aliases.add(alias.asname or alias.name)
         self.generic_visit(node)
 
     def visit_Call(self, node: ast.Call) -> None:
         dotted = _dotted(node.func)
+        # raw-jit: `jax.jit(fn)` / `@jax.jit(static_argnames=...)` forms
+        if self.is_ops and self._is_raw_jit(node.func):
+            self._emit(node, "raw-jit", _RAW_JIT_MSG)
         # ctx-thread
         if not self.is_wrapper and (
                 dotted in ("threading.Thread", "threading.Timer")

@@ -102,10 +102,17 @@ class QueryConfig:
     (docs/operations.md "Hybrid containers") — rows at or below this many
     set bits per shard upload to HBM as padded sorted-index arrays
     instead of 128 KiB dense planes; 0 keeps every row dense. The
-    PILOSA_TPU_HYBRID=0 env kill switch wins over any threshold."""
+    PILOSA_TPU_HYBRID=0 env kill switch wins over any threshold.
+
+    run-threshold: run (interval-pair) device containers — rows ABOVE
+    sparse-threshold whose write-maintained interval count is at or
+    below this upload as sorted [start, last] pairs instead of dense
+    planes; 0 keeps such rows dense. Same PILOSA_TPU_HYBRID=0 kill
+    switch."""
     plan: str = "on"
     plan_cache_bytes: int = 256 * 1024 * 1024
     sparse_threshold: int = 4096
+    run_threshold: int = 2048
 
 
 @dataclass
@@ -426,6 +433,7 @@ class Config:
             f'plan = "{self.query.plan}"',
             f"plan-cache-bytes = {self.query.plan_cache_bytes}",
             f"sparse-threshold = {self.query.sparse_threshold}",
+            f"run-threshold = {self.query.run_threshold}",
             "",
             "[qos]",
             f'mode = "{self.qos.mode}"',

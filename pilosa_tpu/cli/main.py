@@ -186,6 +186,7 @@ def cmd_server(args) -> int:
         plan=cfg.query.plan,
         plan_cache_bytes=cfg.query.plan_cache_bytes,
         sparse_threshold=cfg.query.sparse_threshold,
+        run_threshold=cfg.query.run_threshold,
         max_writes_per_request=cfg.max_writes_per_request,
         metric_service=cfg.metric.service,
         metric_host=cfg.metric.host,

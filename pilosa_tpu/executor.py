@@ -644,10 +644,10 @@ class Executor:
         recounts. `gens` skips the per-shard generation scan when the
         caller already computed it (GroupBy slab keys).
 
-        When the row is already HBM-resident in its SPARSE hybrid form, a
-        dense consumer gets the plane by materializing ON DEVICE from the
-        resident index array (one small kernel, zero host->device bytes)
-        instead of re-uploading 128 KiB per shard."""
+        When the row is already HBM-resident in its SPARSE or RUN hybrid
+        form, a dense consumer gets the plane by materializing ON DEVICE
+        from the resident index/interval array (one small kernel, zero
+        host->device bytes) instead of re-uploading 128 KiB per shard."""
         if gens is None:
             gens = self._leaf_gens(index, field_name, view_name, shards,
                                    row_id)
@@ -678,6 +678,19 @@ class Executor:
                 if sp is not None:
                     hyb.record_materialize()
                     return bv.sparse_to_dense(sp, WORDS)
+                if hyb.run_threshold > 0:
+                    # same probe for a resident RUN twin (interval-pair
+                    # array): slot bucket comes from the write-maintained
+                    # interval count, generation-cached like cardinality
+                    n_iv, _ = self._row_run_stats_max(
+                        index, field_name, view_name, shards, row_id)
+                    rkey = ("run", index.name, field_name, view_name,
+                            row_id, tuple(shards),
+                            hyb.pad_slots(max(n_iv, 1)), gens)
+                    rn = self.residency.peek(rkey)
+                    if rn is not None:
+                        hyb.record_materialize()
+                        return bv.run_to_dense(rn, WORDS)
             return np.stack([
                 self._cached_row(index, field_name, view_name, s, row_id)
                 for s in shards])
@@ -704,6 +717,70 @@ class Executor:
                 if c > best:
                     best = c
         return best
+
+    def _row_run_stats_max(self, index: Index, field_name: str,
+                           view_name: str, shards, row_id: int):
+        """(max interval count, max run length) across shards — the run
+        sizing statistic (storage/fragment.py row_run_stats, generation-
+        cached: repeat reads are dict probes)."""
+        f = index.field(field_name)
+        view = f.view(view_name) if f is not None else None
+        if view is None:
+            return 0, 0
+        n_iv = max_run = 0
+        for s in shards:
+            frag = view.fragment(s)
+            if frag is not None:
+                n, m = frag.row_run_stats(row_id)
+                n_iv = max(n_iv, n)
+                max_run = max(max_run, m)
+        return n_iv, max_run
+
+    def _row_leaf_run_dev(self, index: Index, field_name: str,
+                          view_name: str, shards, row_id: int,
+                          gens: tuple, slots: int):
+        """HBM-resident RUN row leaf: int32[S(padded), 2, slots] of sorted
+        inclusive [start, last] shard-local interval pairs, sentinel-padded
+        (ops/bitvector.py run kernels) — the hybrid representation for
+        long-run rows above the sparse threshold. Intervals come STRAIGHT
+        from the storage run containers (Fragment.row_runs walks each
+        container's native run encoding) — no densify→re-encode round trip
+        on upload, the TYPE_RUN regime of arXiv:1603.06549 carried to the
+        device tier. Byte cost is the real padded allocation
+        (S · 2 · slots · 4); pad shards fill with the sentinel in both
+        interval planes so they read as empty."""
+        from pilosa_tpu.ops import bitvector as bv
+        key = ("run", index.name, field_name, view_name, row_id,
+               tuple(shards), slots, gens)
+        tracker = self.heat
+        if tracker is not None and tracker.enabled:
+            tracker.touch_many([(index.name, field_name, view_name, s)
+                                for s in shards], reads=1)
+        f = index.field(field_name)
+        view = f.view(view_name) if f is not None else None
+
+        def make():
+            arr = np.full((len(shards), 2, slots), bv.RUN_SENTINEL,
+                          dtype=np.int32)
+            for i, s in enumerate(shards):
+                frag = view.fragment(s) if view is not None else None
+                if frag is None:
+                    continue
+                # a write racing between the sizing read and this one can
+                # exceed the slot bucket; runs_from_intervals truncates,
+                # which stays inside the engine's read-consistency
+                # envelope (per-shard rows tear the same way on the dense
+                # path) and the generation bump re-keys the next lookup
+                arr[i] = bv.runs_from_intervals(frag.row_runs(row_id),
+                                                slots)
+            return arr
+
+        hyb = self.hybrid
+        return self.residency.leaf(
+            key, make,
+            put=lambda h: (hyb.record_upload("run", h.nbytes),
+                           self.runner.put_leaf(
+                               h, fill=bv.RUN_SENTINEL))[1])
 
     def _row_leaf_sparse_dev(self, index: Index, field_name: str,
                              view_name: str, shards, row_id: int,
@@ -756,9 +833,12 @@ class Executor:
         out = self.hybrid.snapshot()
         by_kind = self.residency.snapshot()["by_kind"]
         sp = by_kind.get("sparse", {})
+        rn = by_kind.get("run", {})
         dn = by_kind.get("row", {})
         out["residentSparseLeaves"] = sp.get("entries", 0)
         out["residentSparseBytes"] = sp.get("bytes", 0)
+        out["residentRunLeaves"] = rn.get("entries", 0)
+        out["residentRunBytes"] = rn.get("bytes", 0)
         out["residentDenseRowLeaves"] = dn.get("entries", 0)
         out["residentDenseRowBytes"] = dn.get("bytes", 0)
         return out
@@ -766,9 +846,9 @@ class Executor:
     def _compile(self, index: Index, call: Call, shards: list[int]):
         """Walk the call tree -> (program, leaves, kinds) where leaves are
         HBM-resident device arrays from the residency manager and kinds[i]
-        marks leaf i "dense" ([S, W] uint32 plane) or "sparse" ([S, slots]
-        int32 sorted-index array — the hybrid representation the planner
-        chose for a low-cardinality row)."""
+        marks leaf i "dense" ([S, W] uint32 plane), "sparse" ([S, slots]
+        int32 sorted-index array) or "run" ([S, 2, slots] int32 interval
+        pairs) — the hybrid representation the planner chose per row."""
         leaves: list = []
         kinds: list = []
         shards_t = tuple(shards)
@@ -802,6 +882,10 @@ class Executor:
                 return leaf_arr(self._row_leaf_sparse_dev(
                     index, field_name, VIEW_STANDARD, shards, row_id,
                     gens, slots), "sparse")
+            if rep == "run":
+                return leaf_arr(self._row_leaf_run_dev(
+                    index, field_name, VIEW_STANDARD, shards, row_id,
+                    gens, slots), "run")
             return leaf_arr(self._row_leaf_dev(
                 index, field_name, VIEW_STANDARD, shards, row_id,
                 gens=gens))
@@ -845,8 +929,24 @@ class Executor:
             if index.existence_field() is None:
                 raise ExecutionError(
                     f"index {index.name} does not support existence tracking")
+            # the existence row is the archetypal run-container row (long
+            # contiguous column ranges) — route it through the planner's
+            # representation choice so it can upload as interval pairs
+            from pilosa_tpu import planner as _planner
+            rep, slots, gens = _planner.choose_representation(
+                self, index, None, EXISTENCE_FIELD_NAME, VIEW_STANDARD,
+                shards, 0)
+            if rep == "sparse":
+                return leaf_arr(self._row_leaf_sparse_dev(
+                    index, EXISTENCE_FIELD_NAME, VIEW_STANDARD, shards, 0,
+                    gens, slots), "sparse")
+            if rep == "run":
+                return leaf_arr(self._row_leaf_run_dev(
+                    index, EXISTENCE_FIELD_NAME, VIEW_STANDARD, shards, 0,
+                    gens, slots), "run")
             return leaf_arr(self._row_leaf_dev(
-                index, EXISTENCE_FIELD_NAME, VIEW_STANDARD, shards, 0))
+                index, EXISTENCE_FIELD_NAME, VIEW_STANDARD, shards, 0,
+                gens=gens))
 
         def walk(c: Call):
             if c.name == "Row":
@@ -941,11 +1041,11 @@ class Executor:
     def _eval_program_dense(self, program, leaves, kinds):
         """Dense [S', W] result of a compiled program. All-dense programs
         take the runner's fused path (XLA / Pallas / ICI shard_map);
-        hybrid programs evaluate through the sparse kernel family and
-        materialize the root to a plane only if it is still sparse —
-        downstream consumers (plan cache, Row segments, BSI/GroupBy
+        hybrid programs evaluate through the sparse/run kernel families
+        and materialize the root to a plane only if it is still sparse or
+        run — downstream consumers (plan cache, Row segments, BSI/GroupBy
         filter folds) all expect planes."""
-        if "sparse" not in kinds:
+        if "sparse" not in kinds and "run" not in kinds:
             return self.runner.row_leaves_dev(leaves, program)
         from pilosa_tpu.ops import bitvector as bv
         kind, arr = bv.eval_hybrid(
@@ -954,6 +1054,9 @@ class Executor:
         if kind == "sparse":
             self.hybrid.record_materialize()
             return bv.sparse_to_dense(arr, WORDS)
+        if kind == "run":
+            self.hybrid.record_materialize()
+            return bv.run_to_dense(arr, WORDS)
         return arr
 
     def _sparse_dense_fn(self):
@@ -1088,11 +1191,12 @@ class Executor:
 
         from pilosa_tpu.utils import accounting
         program, leaves, kinds = self._compile(index, child, shards)
-        if "sparse" in kinds:
-            # hybrid program: count through the sparse kernel family — a
-            # sparse root counts its live slots with no plane ever
-            # materialized (the sparse-count pushdown). Skips the batcher
-            # and the dense chain kernel, which both assume uint32 planes.
+        if "sparse" in kinds or "run" in kinds:
+            # hybrid program: count through the sparse/run kernel
+            # families — a sparse root counts its live slots, a run root
+            # sums its interval lengths, with no plane ever materialized
+            # (the hybrid-count pushdown). Skips the batcher and the
+            # dense chain kernel, which both assume uint32 planes.
             from pilosa_tpu.ops import bitvector as bv
             acct = accounting.current_account.get()
             heat_on = self.heat is not None and self.heat.enabled
@@ -1273,8 +1377,12 @@ class Executor:
                 return fetch(exists)
             blo = max(lo - f.base, 0)
             bhi = min(hi, f.options.max) - f.base
-            dlo = bsi_ops.compare(planes, exists, bsi_ops.value_to_bits(blo, depth), bsi_ops.GTE)
-            dhi = bsi_ops.compare(planes, exists, bsi_ops.value_to_bits(bhi, depth), bsi_ops.LTE)
+            dlo = bsi_ops.compare(planes, exists,
+                                  bsi_ops.value_to_bits(blo, depth),
+                                  bsi_ops.GTE, pallas=self.runner.use_pallas)
+            dhi = bsi_ops.compare(planes, exists,
+                                  bsi_ops.value_to_bits(bhi, depth),
+                                  bsi_ops.LTE, pallas=self.runner.use_pallas)
             return fetch(jax.numpy.bitwise_and(dlo, dhi))
 
         value = cond.value
@@ -1299,7 +1407,8 @@ class Executor:
             return fetch(exists)
         base_value = min(max(value - f.base, 0), f.options.max - f.base)
         pred = bsi_ops.value_to_bits(base_value, depth)
-        return fetch(bsi_ops.compare(planes, exists, pred, op_map[op]))
+        return fetch(bsi_ops.compare(planes, exists, pred, op_map[op],
+                                     pallas=self.runner.use_pallas))
 
     def _bsi_filter(self, index: Index, call: Call, shards):
         """Optional filter child for Sum/Min/Max — a device array [S', W]
@@ -1327,8 +1436,14 @@ class Executor:
             counts_per_plane, n = totals[:-1], int(totals[-1])
         else:
             # one dispatch + one fetch: per-plane counts with the exists
-            # count packed as the last row (bsi_ops.sum_counts)
-            packed = np.asarray(bsi_ops.sum_counts(planes, exists))
+            # count packed as the last row (bsi_ops.sum_counts, or the
+            # Pallas blocked plane sweep behind PILOSA_TPU_PALLAS)
+            if self.runner.use_pallas and planes.ndim == 3:
+                from pilosa_tpu.ops import pallas_kernels
+                packed = np.asarray(
+                    pallas_kernels.bsi_sum_counts(planes, exists))
+            else:
+                packed = np.asarray(bsi_ops.sum_counts(planes, exists))
             counts_per_plane, n = packed[:-1].sum(axis=1), int(packed[-1].sum())
         raw_sum = bsi_ops.counts_to_sum(counts_per_plane)
         # add base back per counted value (val = raw + base*count)
@@ -1521,7 +1636,7 @@ class Executor:
         import jax.numpy as jnp
 
         from pilosa_tpu.ops.bitvector import intersect_count, popcount
-        from pilosa_tpu.ops.topn import tanimoto_counts, tanimoto_mask
+        from pilosa_tpu.ops.topn import tanimoto_counts_packed
 
         src_flat = src_dense.reshape(-1)
         scount = 0
@@ -1562,17 +1677,31 @@ class Executor:
                 for rid, _ in block])
             self.topn_recount_rows += len(block)
             flat = slab.reshape(len(block), -1)
+            # single-dispatch packed counts (XLA or the Pallas blocked
+            # kernel behind PILOSA_TPU_PALLAS): one pass over the slab,
+            # one host fetch, instead of tanimoto_counts' three popcounts
+            pack_fn = tanimoto_counts_packed
+            if self.runner.use_pallas:
+                from pilosa_tpu.ops import pallas_kernels
+                pack_fn = pallas_kernels.topn_counts_packed
             if tanimoto:
-                inter, rcounts, scount = tanimoto_counts(flat, src_flat)
-                keep = np.asarray(tanimoto_mask(
-                    inter, rcounts, scount, jnp.int32(tanimoto)))
-                counts = np.where(keep, np.asarray(inter), 0)
-            else:
+                packed = np.asarray(pack_fn(flat, src_flat))
+                inter, rcounts = packed[0], packed[1]
+                scount = int(packed[2, 0])
+                # the strict reference mask (ops/topn.tanimoto_mask) on
+                # the fetched counts: 100·inter > T·(union)
+                keep = (100 * inter.astype(np.int64)
+                        > tanimoto * (rcounts.astype(np.int64)
+                                      + scount - inter))
+                counts = np.where(keep, inter, 0)
+            elif self.runner.use_pallas:
                 # all block counts come back (B int32s — trivial transfer)
                 # rather than a device top_k: lax.top_k breaks ties by
                 # position (= cached-count order), which would cut a tied
                 # smaller row id and violate Pairs order; the host heap's
                 # (count, -id) key keeps tie-breaking exact
+                counts = np.asarray(pack_fn(flat, src_flat))[0]
+            else:
                 counts = np.asarray(intersect_count(flat, src_flat[None]))
             block_pairs = [(block[i][0], int(counts[i]))
                            for i in range(len(block))]
@@ -3045,8 +3174,16 @@ class Executor:
                 if hyb is not None and hyb.active():
                     fk = [(index.name, fname, vname, shard)]
                     for r in changed_rows:
+                        card = frag.row_cardinality(r)
+                        # run stats only when the run band is reachable:
+                        # below the sparse threshold the transition rule
+                        # never reads them, and row_run_stats on a fresh
+                        # generation walks containers
+                        rs = (frag.row_run_stats(r)
+                              if (card > hyb.threshold
+                                  and hyb.run_threshold > 0) else None)
                         hyb.observe((index.name, fname, vname, r),
-                                    frag.row_cardinality(r), frag_keys=fk)
+                                    card, frag_keys=fk, run_stats=rs)
                     with self._ingest_lock:
                         self.ingest_stats["hybridEvals"] += \
                             len(changed_rows)
@@ -3141,7 +3278,7 @@ class Executor:
                 return None
             if key[0] == "row" and len(key) == 7:
                 out = key[2], key[3], key[4], key[5], key[6], 0
-            elif key[0] == "sparse" and len(key) == 8:
+            elif key[0] in ("sparse", "run") and len(key) == 8:
                 out = key[2], key[3], key[4], key[5], key[7], key[6]
             else:
                 return None
@@ -3202,6 +3339,15 @@ class Executor:
                     self.ingest_stats["patchedDense"] += 1
                 return (("row", iname, fld, vw, row, shards_t, new_gens),
                         new_arr)
+            if key[0] == "run":
+                # run leaves are interval-encoded: a point write can
+                # split/merge/extend intervals, which has no in-place
+                # device patch — drop the stale entry so its HBM frees
+                # NOW instead of stranding until LRU; the next read
+                # re-encodes straight from the storage run containers
+                with self._ingest_lock:
+                    self.ingest_stats["patchDropped"] += 1
+                return None
             # sparse: only while the row stays in the SAME slot bucket —
             # the read path probes with pad_slots(current card), so a
             # bucket move would strand the entry anyway

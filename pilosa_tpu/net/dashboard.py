@@ -138,6 +138,7 @@ const LOCAL_SERIES = [
   ["ici.slice_local_share", "ICI slice-local share (window)", fmtRatio],
   ["ici.slice_local_per_s", "ICI slice-local / s", fmtNum],
   ["hybrid.sparse_share", "hybrid sparse upload share (window)", fmtRatio],
+  ["hybrid.run_share", "hybrid run upload share (window)", fmtRatio],
   ["hybrid.sparse_bytes", "hybrid sparse resident bytes", fmtBytes],
   ["ingest.sets_per_s", "ingest mutations / s", fmtNum],
   ["ingest.wal_appends_per_s", "ingest WAL group commits / s", fmtNum],

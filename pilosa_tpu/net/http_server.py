@@ -1001,20 +1001,27 @@ class Handler:
             if hasattr(ex, "hybrid_snapshot"):
                 hy = ex.hybrid_snapshot()
                 counts["hybrid,rep:sparse"] = hy["sparseUploads"]
+                counts["hybrid,rep:run"] = hy["runUploads"]
                 counts["hybrid,rep:dense"] = hy["denseUploads"]
                 counts["hybrid,transition:promoted"] = hy["promoted"]
                 counts["hybrid,transition:demoted"] = hy["demoted"]
                 counts["hybrid,transition:materialized"] = \
                     hy["materialized"]
+                counts["hybrid,transition:run"] = hy["runTransitions"]
                 gauges["hybridLeaves,rep:sparse"] = \
                     hy["residentSparseLeaves"]
+                gauges["hybridLeaves,rep:run"] = \
+                    hy["residentRunLeaves"]
                 gauges["hybridLeaves,rep:dense"] = \
                     hy["residentDenseRowLeaves"]
                 gauges["hybridBytes,rep:sparse"] = \
                     hy["residentSparseBytes"]
+                gauges["hybridBytes,rep:run"] = \
+                    hy["residentRunBytes"]
                 gauges["hybridBytes,rep:dense"] = \
                     hy["residentDenseRowBytes"]
                 gauges["hybrid/threshold"] = float(hy["threshold"])
+                gauges["hybrid/runThreshold"] = float(hy["runThreshold"])
                 gauges["hybrid/enabled"] = 1.0 if hy["enabled"] else 0.0
             # hinted handoff + rejoin fence: emitted unconditionally
             # (zeros included) like the planner families — "hint log

@@ -18,6 +18,8 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from pilosa_tpu.utils.telemetry import counted_jit
+
 # one shard row = 32768 uint32 lanes = [256, 128] tiles; block 16 shards
 # deep to amortize grid overhead (16 * 128 KiB * 2 operands * 2 pipeline
 # buffers = 8 MiB of VMEM, inside the 16 MiB scoped limit; measured r3:
@@ -54,7 +56,7 @@ def _pad_shards(x: jax.Array, axis: int) -> jax.Array:
     return jnp.pad(x, widths)
 
 
-@jax.jit
+@counted_jit("pallas")
 def intersect_count(a: jax.Array, b: jax.Array) -> jax.Array:
     """[S, W] x [S, W] -> int32[S] per-shard intersection counts."""
     s, w = a.shape
@@ -103,7 +105,7 @@ def _program_count_kernel(program, n_leaves, blk, *refs):
     out_ref[...] = jnp.broadcast_to(counts[:, None], (blk, 128))
 
 
-@functools.partial(jax.jit, static_argnames=("program",))
+@counted_jit("pallas", static_argnames=("program",))
 def program_count(leaves, program) -> jax.Array:
     """leaves (tuple of [S, W], or stacked [L, S, W]) -> int32[S]: whole
     bitmap-expression popcount in one pass, no HBM intermediates
@@ -176,7 +178,7 @@ def _pad_axis_to(x: jax.Array, axis: int, mult: int) -> jax.Array:
     return jnp.pad(x, widths)
 
 
-@jax.jit
+@counted_jit("pallas")
 def cross_count_matrix(prefix: jax.Array, axis: jax.Array) -> jax.Array:
     """prefix [P, ..., W] x axis [R, ..., W] -> int32[P, R] cross-count
     matrix (leading axes flattened into the word axis). The Pallas form of
@@ -229,7 +231,7 @@ def _pair_stream_kernel(ii_ref, jj_ref, a_ref, b_ref, out_ref):
     out_ref[...] += partial
 
 
-@jax.jit
+@counted_jit("pallas")
 def pair_stream_counts(rows: jax.Array, ii: jax.Array,
                        jj: jax.Array) -> jax.Array:
     """[R, S, W] x int32[K] x int32[K] -> int32[K] per-query intersection
@@ -289,7 +291,7 @@ def _sparse_dense_kernel(a_ref, b_ref, out_ref):
     out_ref[...] = jnp.where(hit, idx, SPARSE_SENTINEL)
 
 
-@jax.jit
+@counted_jit("pallas")
 def sparse_intersect_dense(sp: jax.Array, dense: jax.Array) -> jax.Array:
     """int32[S, K] sparse row x uint32[S, W] dense plane -> sorted
     sentinel-padded int32[S, K] intersection — the Pallas form of
@@ -317,6 +319,218 @@ def sparse_intersect_dense(sp: jax.Array, dense: jax.Array) -> jax.Array:
     return jnp.sort(masked[:s], axis=-1)
 
 
+# -- TopN: fused popcount-rank over the candidate slab ------------------------
+# The XLA recount path (ops/topn.py tanimoto_counts) dispatches three
+# popcounts over the same [R, W] slab — three passes over the operands in
+# HBM. This kernel is the popcount-audit form: ONE blocked pass computes
+# the intersection counts, row counts and src count together, packed into
+# a single int32 output (single dispatch, single host fetch). Ranking
+# stays outside (lax.top_k / the host heap): TopN tie-breaking is
+# (count, -row_id) exact and a device top_k would break ties by slab
+# position (executor.py _topn_src_walk rationale).
+
+TN_R_BLK = 128   # candidate-row tile: int32 lane width of the output
+TN_W_BLK = 2048  # word tile per step (rows: 1 MiB, src: 8 KiB in VMEM)
+
+
+def _topn_counts_kernel(rows_ref, src_ref, out_ref):
+    wb = pl.program_id(1)
+    rows = rows_ref[...]                               # [TN_R_BLK, W_BLK]
+    src = src_ref[...]                                 # [1, W_BLK]
+    inter = jnp.sum(jax.lax.population_count(
+        jnp.bitwise_and(rows, src)).astype(jnp.int32), axis=-1)
+    rcnt = jnp.sum(jax.lax.population_count(rows).astype(jnp.int32),
+                   axis=-1)
+    scnt = jnp.sum(jax.lax.population_count(src).astype(jnp.int32))
+    # pack the three count families into one [8, TN_R_BLK] tile via
+    # select-by-row-index (TPU-safe; no scatter): row 0 = |row ∩ src|,
+    # row 1 = |row|, row 2 = |src| broadcast. Each row block owns its own
+    # output columns, so scnt is charged in EVERY row block; only the
+    # word axis accumulates (wb), summing the per-word-block partials to
+    # the full |src| exactly once per column.
+    ridx = jax.lax.broadcasted_iota(jnp.int32, (8, TN_R_BLK), 0)
+    partial = jnp.where(ridx == 0, inter[None, :], 0)
+    partial = partial + jnp.where(ridx == 1, rcnt[None, :], 0)
+    partial = partial + jnp.where(ridx == 2, scnt, 0)
+
+    @pl.when(wb == 0)
+    def _init():
+        out_ref[...] = jnp.zeros_like(out_ref)
+
+    out_ref[...] += partial
+
+
+@counted_jit("pallas")
+def topn_counts_packed(rows: jax.Array, src: jax.Array) -> jax.Array:
+    """uint32[R, W] candidate slab x uint32[W] src row -> int32[3, R]
+    packed counts: [0] = |row ∩ src| per row, [1] = |row| per row,
+    [2] = |src| broadcast. The Pallas form of the TopN recount's count harvest
+    (parity tested in tests/test_pallas.py); zero padding (rows to 128,
+    words to 2048) contributes no counts and is sliced off."""
+    r, w = rows.shape
+    rows_p = _pad_axis_to(_pad_axis_to(rows, 0, TN_R_BLK), 1, TN_W_BLK)
+    src_p = _pad_axis_to(src.reshape(1, -1), 1, TN_W_BLK)
+    rp, wp = rows_p.shape
+    out = pl.pallas_call(
+        _topn_counts_kernel,
+        grid=(rp // TN_R_BLK, wp // TN_W_BLK),
+        in_specs=[
+            pl.BlockSpec((TN_R_BLK, TN_W_BLK), lambda i, j: (i, j)),
+            pl.BlockSpec((1, TN_W_BLK), lambda i, j: (0, j)),
+        ],
+        out_specs=pl.BlockSpec((8, TN_R_BLK), lambda i, j: (0, i)),
+        out_shape=jax.ShapeDtypeStruct((8, rp), jnp.int32),
+        interpret=_interpret(),
+    )(rows_p, src_p)
+    return out[:3, :max(r, 1)]
+
+
+def top_rows(rows: jax.Array, k: int):
+    """(counts, indices) of the k highest-popcount rows — the Pallas form
+    of ops/topn.top_rows: counts come from the blocked single-pass kernel
+    (src = 0 so only the row-count lane is live), ranking is lax.top_k on
+    the device-resident count vector."""
+    packed = topn_counts_packed(rows, jnp.zeros_like(rows[0]))
+    return jax.lax.top_k(packed[1], min(k, rows.shape[0]))
+
+
+# -- BSI compare/sum: the plane sweep as one blocked kernel -------------------
+# The XLA compare (ops/bsi.py _compare) unrolls the depth sweep into fused
+# bitwise ops, but `matched`/`remaining` are XLA values the compiler may
+# spill between plane steps. Here the sweep runs per (shard, word) block
+# with both accumulators pinned in VMEM across the whole static-depth
+# unroll — each plane word streams HBM->VMEM exactly once. The predicate
+# enters as a scalar-prefetched per-plane bit vector (SMEM reads inside
+# the kernel), NOT as a static value: predicates change per query and must
+# not recompile the kernel.
+
+BSI_S_BLK = 8    # shard tile: int32 sublane minimum
+BSI_W_BLK = 512  # word tile (depth≤64: planes ≤ 1 MiB per block in VMEM)
+
+# op codes duplicated from ops/bsi.py to avoid a circular import
+_LT, _LTE, _GT, _GTE, _EQ, _NEQ = "lt", "lte", "gt", "gte", "eq", "neq"
+
+
+def _bsi_compare_kernel(op, depth, pred_ref, planes_ref, exists_ref,
+                        out_ref):
+    exists = exists_ref[...]                        # [S_BLK, W_BLK] uint32
+
+    def m(i):
+        # all-ones / all-zeros uint32 scalar mask from predicate bit i
+        return jnp.uint32(0) - pred_ref[i].astype(jnp.uint32)
+
+    if op in (_EQ, _NEQ):
+        r = exists
+        for i in range(depth):
+            r = jnp.bitwise_and(
+                r, jnp.bitwise_xor(planes_ref[i],
+                                   jnp.bitwise_not(m(i))))
+        if op == _NEQ:
+            r = jnp.bitwise_and(exists, jnp.bitwise_not(r))
+        out_ref[...] = r
+        return
+    matched = jnp.zeros_like(exists)
+    remaining = exists
+    for i in range(depth - 1, -1, -1):
+        mask = m(i)
+        plane = planes_ref[i]
+        if op in (_LT, _LTE):
+            matched = jnp.bitwise_or(matched, jnp.bitwise_and(
+                jnp.bitwise_and(remaining, jnp.bitwise_not(plane)), mask))
+        else:
+            matched = jnp.bitwise_or(matched, jnp.bitwise_and(
+                jnp.bitwise_and(remaining, plane), jnp.bitwise_not(mask)))
+        remaining = jnp.bitwise_and(
+            remaining, jnp.bitwise_xor(plane, jnp.bitwise_not(mask)))
+    if op in (_LTE, _GTE):
+        matched = jnp.bitwise_or(matched, remaining)
+    out_ref[...] = matched
+
+
+@counted_jit("pallas", static_argnames=("op",))
+def bsi_compare(planes: jax.Array, exists: jax.Array, pred_bits,
+                op: str) -> jax.Array:
+    """uint32[depth, S, W] planes x uint32[S, W] exists x int32[depth]
+    predicate bits -> uint32[S, W] match mask — the Pallas form of
+    ops/bsi.compare (parity tested in tests/test_pallas.py). Zero-padded
+    shards/words carry zero exists bits, so they match nothing."""
+    pred_bits = jnp.asarray(pred_bits, dtype=jnp.int32)
+    depth, s, w = planes.shape
+    planes_p = _pad_axis_to(_pad_axis_to(planes, 1, BSI_S_BLK), 2,
+                            BSI_W_BLK)
+    exists_p = _pad_axis_to(_pad_axis_to(exists, 0, BSI_S_BLK), 1,
+                            BSI_W_BLK)
+    sp, wp = exists_p.shape
+    spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(sp // BSI_S_BLK, wp // BSI_W_BLK),
+        in_specs=[
+            pl.BlockSpec((depth, BSI_S_BLK, BSI_W_BLK),
+                         lambda i, j, pred: (0, i, j)),
+            pl.BlockSpec((BSI_S_BLK, BSI_W_BLK),
+                         lambda i, j, pred: (i, j)),
+        ],
+        out_specs=pl.BlockSpec((BSI_S_BLK, BSI_W_BLK),
+                               lambda i, j, pred: (i, j)),
+    )
+    out = pl.pallas_call(
+        functools.partial(_bsi_compare_kernel, op, depth),
+        grid_spec=spec,
+        out_shape=jax.ShapeDtypeStruct((sp, wp), jnp.uint32),
+        interpret=_interpret(),
+    )(pred_bits, planes_p, exists_p)
+    return out[:s, :w]
+
+
+def _bsi_sum_kernel(depth, planes_ref, filt_ref, out_ref):
+    wb = pl.program_id(1)
+    filt = filt_ref[...]                            # [S_BLK, W_BLK]
+    cols = [jnp.sum(jax.lax.population_count(
+        jnp.bitwise_and(planes_ref[i], filt)).astype(jnp.int32), axis=-1)
+        for i in range(depth)]
+    cols.append(jnp.sum(jax.lax.population_count(filt).astype(jnp.int32),
+                        axis=-1))
+    partial = jnp.stack(cols, axis=-1)              # [S_BLK, depth + 1]
+    partial = jnp.pad(partial, ((0, 0), (0, 128 - depth - 1)))
+
+    @pl.when(wb == 0)
+    def _init():
+        out_ref[...] = jnp.zeros_like(out_ref)
+
+    out_ref[...] += partial
+
+
+@counted_jit("pallas")
+def bsi_sum_counts(planes: jax.Array, filter_row: jax.Array) -> jax.Array:
+    """uint32[depth, S, W] planes x uint32[S, W] filter -> int32[depth+1,
+    S]: per-plane filtered popcounts with the filter's own count as the
+    last row — the Pallas form of ops/bsi.sum_counts, one blocked pass
+    over the plane slab with every per-plane AND+popcount sharing the
+    filter tile in VMEM (the XLA form reloads it per plane unless fusion
+    saves it). depth+1 must fit the 128-lane count tile."""
+    depth, s, w = planes.shape
+    if depth + 1 > 128:
+        raise ValueError(f"bit depth {depth} exceeds the packed-count tile")
+    planes_p = _pad_axis_to(_pad_axis_to(planes, 1, BSI_S_BLK), 2,
+                            BSI_W_BLK)
+    filt_p = _pad_axis_to(_pad_axis_to(filter_row, 0, BSI_S_BLK), 1,
+                          BSI_W_BLK)
+    sp, wp = filt_p.shape
+    out = pl.pallas_call(
+        functools.partial(_bsi_sum_kernel, depth),
+        grid=(sp // BSI_S_BLK, wp // BSI_W_BLK),
+        in_specs=[
+            pl.BlockSpec((depth, BSI_S_BLK, BSI_W_BLK),
+                         lambda i, j: (0, i, j)),
+            pl.BlockSpec((BSI_S_BLK, BSI_W_BLK), lambda i, j: (i, j)),
+        ],
+        out_specs=pl.BlockSpec((BSI_S_BLK, 128), lambda i, j: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((sp, 128), jnp.int32),
+        interpret=_interpret(),
+    )(planes_p, filt_p)
+    return out[:s, :depth + 1].T
+
+
 def available() -> bool:
     """Pallas compiles on this backend (real TPU or interpret fallback)."""
     try:
@@ -342,7 +556,7 @@ def _program_count_mesh_fn(mesh, program, n_leaves: int):
 
     from pilosa_tpu.parallel.mesh import SHARD_AXIS
 
-    @jax.jit
+    @counted_jit("pallas")
     @functools.partial(
         shard_map, mesh=mesh,
         in_specs=(tuple(P(SHARD_AXIS, None) for _ in range(n_leaves)),),
@@ -373,7 +587,7 @@ def _pair_stream_mesh_fn(mesh):
 
     rep_spec = P(REPLICA_AXIS) if REPLICA_AXIS in mesh.shape else P()
 
-    @jax.jit
+    @counted_jit("pallas")
     @functools.partial(
         shard_map, mesh=mesh,
         in_specs=(P(None, SHARD_AXIS, None), rep_spec, rep_spec),

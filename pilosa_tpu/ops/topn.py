@@ -62,6 +62,21 @@ def tanimoto_counts(rows: jax.Array, src: jax.Array):
 
 
 @counted_jit("topn")
+def tanimoto_counts_packed(rows: jax.Array, src: jax.Array) -> jax.Array:
+    """tanimoto_counts folded into ONE dispatch and ONE host fetch:
+    int32[3, R] with [0] = |row ∩ src|, [1] = |row|, [2] = |src|
+    broadcast. The popcount-audit form (arXiv:1611.07612's fused-harvest
+    idea applied at the dispatch level): the three separate popcounts of
+    tanimoto_counts cost three device round trips on high-latency links.
+    The Pallas twin is ops/pallas_kernels.topn_counts_packed."""
+    inter = popcount(jnp.bitwise_and(rows, src[None]))
+    rcounts = popcount(rows)
+    scount = popcount(src)
+    return jnp.stack(
+        [inter, rcounts, jnp.broadcast_to(scount, inter.shape)], axis=0)
+
+
+@counted_jit("topn")
 def tanimoto_mask(inter: jax.Array, rcounts: jax.Array, scount: jax.Array,
                   threshold: jax.Array) -> jax.Array:
     """Boolean keep-mask: 100·inter > threshold·(rcounts + scount − inter).

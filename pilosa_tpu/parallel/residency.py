@@ -260,9 +260,23 @@ class DeviceResidency:
 # 256x). 0 disables — every row uploads dense.
 DEFAULT_SPARSE_THRESHOLD = 4096
 
+# default [query] run-threshold: rows ABOVE the sparse cardinality
+# threshold upload as sorted [start, last] interval pairs (ops/bitvector.py
+# run kernels) when their write-maintained interval count
+# (storage/fragment.py row_run_stats) is at or below this. 2048 intervals
+# cost 16 KiB against the 128 KiB dense plane (8x) — and the rows run
+# containers exist for (existence/time-range rows, arXiv:1603.06549's
+# TYPE_RUN regime) sit orders of magnitude below it. 0 disables: rows
+# above the sparse threshold always upload dense.
+DEFAULT_RUN_THRESHOLD = 2048
+
 # smallest sparse allocation (slots); uploads bucket to powers of two so
 # cardinality drift re-keys through a handful of XLA shapes, not one per row
 SPARSE_SLOT_MIN = 8
+
+# byte weight order of the three representations — transitions toward
+# heavier count as promotions, toward lighter as demotions
+_REP_ORDER = {"sparse": 0, "run": 1, "dense": 2}
 
 # representation-memory bound: (index, field, view, row) -> last chosen
 # representation, the hysteresis state. Eviction forgets the row's history
@@ -279,36 +293,44 @@ def hybrid_env_enabled() -> bool:
 
 
 class HybridManager:
-    """Per-row representation chooser: sparse (padded sorted-index array)
-    below the cardinality threshold, dense plane above — with promote/
-    demote hysteresis so a row flapping around the threshold doesn't
-    thrash re-uploads, and heat-informed demotion so a COLD dense row
-    re-enters sparse (the array/bitmap container flip of the roaring
-    taxonomy, arXiv:1402.6407, decided from write-maintained exact
-    cardinalities — storage/fragment.py row_cardinality).
+    """Per-row representation chooser across the full roaring taxonomy
+    (arXiv:1402.6407, 1603.06549) applied at shard granularity: sparse
+    (padded sorted-index array) below the cardinality threshold, run
+    (sorted [start, last] interval pairs) above it while the row's
+    write-maintained interval count (storage/fragment.py row_run_stats)
+    stays below the run threshold, dense plane otherwise — with
+    promote/demote hysteresis so a row flapping around either threshold
+    doesn't thrash re-uploads, and heat-informed demotion so a COLD
+    dense row re-enters the cheaper representation.
 
-    The decision is advisory and never affects results: both
+    The decision is advisory and never affects results: all three
     representations evaluate bit-identically (ops/bitvector.eval_hybrid;
-    the parity fuzz in tests/test_hybrid_fuzz.py churns rows across the
-    threshold in both directions). State here is only the hysteresis
+    the parity fuzz in tests/test_hybrid_fuzz.py churns rows across both
+    thresholds in both directions). State here is only the hysteresis
     memory plus counters for /debug/vars `hybrid` and the
     pilosa_hybrid_total metric families."""
 
     def __init__(self, threshold: int = DEFAULT_SPARSE_THRESHOLD,
-                 hysteresis: float = 0.25, heat=None):
+                 hysteresis: float = 0.25, heat=None,
+                 run_threshold: int = DEFAULT_RUN_THRESHOLD):
         self.threshold = int(threshold)
+        self.run_threshold = int(run_threshold)
         # the demote band: a dense row stays dense until its cardinality
-        # falls below threshold*(1-hysteresis) OR its fragments go cold
+        # (or interval count, for the run band) falls below
+        # threshold*(1-hysteresis) OR its fragments go cold
         self.hysteresis = float(hysteresis)
         self.heat = heat  # utils/heat.py HeatTracker or None
         self._lock = threading.Lock()
         self._rep: "OrderedDict[tuple, str]" = OrderedDict()
         self.sparse_uploads = 0
+        self.run_uploads = 0
         self.dense_uploads = 0
-        self.promoted = 0      # sparse -> dense (cardinality crossed up)
-        self.demoted = 0       # dense -> sparse (fell below band / went cold)
-        self.materialized = 0  # sparse leaves expanded to planes on device
+        self.promoted = 0      # transition to a heavier rep (_REP_ORDER)
+        self.demoted = 0       # transition to a lighter rep
+        self.run_transitions = 0  # transitions entering or leaving "run"
+        self.materialized = 0  # sparse/run leaves expanded to device planes
         self.sparse_bytes_uploaded = 0
+        self.run_bytes_uploaded = 0
         self.dense_bytes_uploaded = 0
 
     def active(self) -> bool:
@@ -339,45 +361,67 @@ class HybridManager:
             return False
         return max(scores, default=0.0) < _heat.HOT_SCORE
 
-    def _transition(self, prev, max_card: int, frag_keys) -> str:
+    def _transition(self, prev, max_card: int, frag_keys,
+                    run_stats=None) -> str:
         """The hysteresis rule shared by the read-side choose() and the
-        write-side observe(): crossing the threshold upward promotes
-        immediately; inside the band a previously-dense row stays dense
+        write-side observe(): crossing a threshold upward promotes
+        immediately; inside a band a previously-heavier row keeps its rep
         while any covered fragment is hot, demoting only when cold or
-        when the cardinality falls below the band floor."""
+        when the signal falls below the band floor. `run_stats` is the
+        (interval count, max run length) pair from Fragment.row_run_stats,
+        or None when the caller has no run statistics — in which case a
+        row already run-resident stays run (the advisory signal is
+        missing, not changed) and everything else decides sparse/dense."""
         lo = self.threshold * (1.0 - self.hysteresis)
         if max_card > self.threshold:
-            return "dense"
-        if prev == "dense" and max_card > lo:
-            return "sparse" if self._cold(frag_keys) else "dense"
+            # above the sparse cardinality band entirely: run vs dense,
+            # decided by interval count against the run threshold
+            n_iv = None if run_stats is None else int(run_stats[0])
+            if n_iv is None or self.run_threshold <= 0:
+                return "run" if prev == "run" else "dense"
+            run_lo = self.run_threshold * (1.0 - self.hysteresis)
+            if n_iv > self.run_threshold:
+                return "dense"
+            if prev == "dense" and n_iv > run_lo:
+                return "run" if self._cold(frag_keys) else "dense"
+            return "run"
+        if prev in ("dense", "run") and max_card > lo:
+            return "sparse" if self._cold(frag_keys) else prev
         return "sparse"
 
     def _remember(self, row_key: tuple, prev, rep: str) -> None:
         with self._lock:
             if prev is not None and prev != rep:
-                if rep == "dense":
+                if _REP_ORDER[rep] > _REP_ORDER.get(prev, 0):
                     self.promoted += 1
                 else:
                     self.demoted += 1
+                if prev == "run" or rep == "run":
+                    self.run_transitions += 1
             self._rep[row_key] = rep
             self._rep.move_to_end(row_key)
             while len(self._rep) > REP_MEMORY_BOUND:
                 self._rep.popitem(last=False)
 
     def choose(self, row_key: tuple, max_card: int,
-               frag_keys=None) -> tuple[str, int]:
+               frag_keys=None, run_stats=None) -> tuple[str, int]:
         """(representation, padded slots) for one row leaf whose largest
-        per-shard cardinality is `max_card` (hysteresis: _transition)."""
+        per-shard cardinality is `max_card` (hysteresis: _transition).
+        Slots are interval-pair slots for "run" (padded from the interval
+        count), index slots for "sparse", 0 for "dense"."""
         if not self.active():
             return "dense", 0
         with self._lock:
             prev = self._rep.get(row_key)
-        rep = self._transition(prev, max_card, frag_keys)
+        rep = self._transition(prev, max_card, frag_keys, run_stats)
         self._remember(row_key, prev, rep)
+        if rep == "run":
+            n_iv = 1 if run_stats is None else int(run_stats[0])
+            return rep, self.pad_slots(max(n_iv, 1))
         return rep, self.pad_slots(max(int(max_card), 1))
 
     def observe(self, row_key: tuple, max_card: int,
-                frag_keys=None) -> None:
+                frag_keys=None, run_stats=None) -> None:
         """Write-side hysteresis tick (ISSUE 16 satellite): the batched
         ingest path calls this ONCE per touched row per applied batch —
         instead of re-evaluating threshold crossings mutation by mutation
@@ -391,7 +435,7 @@ class HybridManager:
             prev = self._rep.get(row_key)
         if prev is None:
             return
-        rep = self._transition(prev, max_card, frag_keys)
+        rep = self._transition(prev, max_card, frag_keys, run_stats)
         self._remember(row_key, prev, rep)
 
     def record_upload(self, rep: str, nbytes: int) -> None:
@@ -399,6 +443,9 @@ class HybridManager:
             if rep == "sparse":
                 self.sparse_uploads += 1
                 self.sparse_bytes_uploaded += int(nbytes)
+            elif rep == "run":
+                self.run_uploads += 1
+                self.run_bytes_uploaded += int(nbytes)
             else:
                 self.dense_uploads += 1
                 self.dense_bytes_uploaded += int(nbytes)
@@ -412,13 +459,17 @@ class HybridManager:
             return {
                 "enabled": self.active(),
                 "threshold": self.threshold,
+                "runThreshold": self.run_threshold,
                 "hysteresis": self.hysteresis,
                 "sparseUploads": self.sparse_uploads,
+                "runUploads": self.run_uploads,
                 "denseUploads": self.dense_uploads,
                 "promoted": self.promoted,
                 "demoted": self.demoted,
+                "runTransitions": self.run_transitions,
                 "materialized": self.materialized,
                 "sparseBytesUploaded": self.sparse_bytes_uploaded,
+                "runBytesUploaded": self.run_bytes_uploaded,
                 "denseBytesUploaded": self.dense_bytes_uploaded,
                 "trackedRows": len(self._rep),
             }

@@ -550,14 +550,33 @@ def choose_representation(executor, index, call: Optional[Call],
                 c = frag.row_cardinality(row_id)
                 if c > max_card:
                     max_card = c
+    run_stats = None
+    if (view is not None and max_card > hyb.threshold
+            and hyb.run_threshold > 0):
+        # above the sparse band: the run-vs-dense decision needs the
+        # write-maintained interval statistics (storage/fragment.py
+        # row_run_stats — generation-cached, so repeat plans pay dict
+        # probes). Max across shards: the padded run leaf must cover the
+        # interval-richest shard.
+        n_iv = max_run = 0
+        for s in shards:
+            frag = view.fragment(s)
+            if frag is not None:
+                n, m = frag.row_run_stats(row_id)
+                n_iv = max(n_iv, n)
+                max_run = max(max_run, m)
+        run_stats = (n_iv, max_run)
     rep, slots = hyb.choose(
         (index.name, field_name, view_name, row_id), max_card,
-        frag_keys=[(index.name, field_name, view_name, s) for s in shards])
+        frag_keys=[(index.name, field_name, view_name, s) for s in shards],
+        run_stats=run_stats)
     plan = current_plan.get()
     if plan is not None and call is not None:
         reps = plan.setdefault("hybrid", [])
         if len(reps) < 48:
             reps.append({"expr": truncate_pql(call.to_pql(), _EXPR_LIMIT),
                          "rep": rep, "maxShardCardinality": int(max_card),
-                         "slots": slots})
+                         "slots": slots,
+                         "runIntervals":
+                             int(run_stats[0]) if run_stats else 0})
     return rep, slots, gens

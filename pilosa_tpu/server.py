@@ -84,6 +84,7 @@ class Server:
                  plan: str = "on",
                  plan_cache_bytes: int = 256 << 20,
                  sparse_threshold: int = 4096,
+                 run_threshold: int = 2048,
                  usage_max_principals: int = 256,
                  usage_ring: int = 360,
                  slo_read_latency_ms: float = 0.0,
@@ -250,6 +251,13 @@ class Server:
                 f"invalid [query] sparse-threshold {sparse_threshold!r} "
                 "(expected >= 0)")
         self.executor.hybrid.threshold = sparse_threshold
+        # [query] run-threshold: run (interval-pair) device containers
+        # for long-run rows above the sparse threshold; 0 = never run.
+        if run_threshold < 0:
+            raise ValueError(
+                f"invalid [query] run-threshold {run_threshold!r} "
+                "(expected >= 0)")
+        self.executor.hybrid.run_threshold = run_threshold
         if self.executor.coalescer is not None:
             self.executor.coalescer.admission_s = fanout_coalesce_window
             self.executor.coalescer.max_batch = max(
@@ -390,6 +398,7 @@ class Server:
         self._last_plan_hit_rate = 0.0  # plan cache starts cold
         self._last_ici_share = 0.0  # slice-local share of routed reads
         self._last_hybrid_share = 0.0  # sparse share of row-leaf uploads
+        self._last_hybrid_run_share = 0.0  # run share of row-leaf uploads
         self.api.health_fn = self.node_health
         self.api.node_stats_fn = self.node_stats
         self.api.cluster_stats_fn = self.cluster_stats
@@ -2193,8 +2202,12 @@ class Server:
         hy = ex.hybrid_snapshot()
         g["hybrid.sparse_bytes"] = float(hy["residentSparseBytes"])
         g["hybrid.sparse_leaves"] = float(hy["residentSparseLeaves"])
+        g["hybrid.run_bytes"] = float(hy["residentRunBytes"])
+        g["hybrid.run_leaves"] = float(hy["residentRunLeaves"])
         raw["hybrid.sparse_uploads"] = hy["sparseUploads"]
+        raw["hybrid.run_uploads"] = hy["runUploads"]
         raw["hybrid.row_uploads"] = (hy["sparseUploads"]
+                                     + hy["runUploads"]
                                      + hy["denseUploads"])
         # streaming ingest: coalesced write plane — mutation throughput
         # plus the WAL group-commit ratio (mutations per fsync-able WAL
@@ -2320,9 +2333,13 @@ class Server:
                 "hybrid.row_uploads", 0)
             dsp = raw["hybrid.sparse_uploads"] - prev.get(
                 "hybrid.sparse_uploads", 0)
+            drn = raw["hybrid.run_uploads"] - prev.get(
+                "hybrid.run_uploads", 0)
             if dups > 0:
                 self._last_hybrid_share = max(0.0, dsp) / dups
+                self._last_hybrid_run_share = max(0.0, drn) / dups
         g["hybrid.sparse_share"] = self._last_hybrid_share
+        g["hybrid.run_share"] = self._last_hybrid_run_share
         g["http.errors_per_s"] = rate("http.errors")
         g["xla.compiles_per_s"] = rate("xla.compiles")
         g["usage.queries_per_s"] = rate("usage.queries")

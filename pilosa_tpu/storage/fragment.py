@@ -182,6 +182,10 @@ class Fragment:
         self._row_counts_cache = None
         # (generation, ascending distinct row ids) — see row_ids()
         self._row_ids_cache = None
+        # {row_id: (gen, n_intervals, max_run)} — see row_run_stats().
+        # max_run < 0 marks "recompute on next read": a merge-add grew a
+        # run by an amount a neighbor probe cannot see.
+        self._row_run_stats: dict[int, tuple[int, int, int]] = {}
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -397,17 +401,21 @@ class Fragment:
     def set_bit(self, row_id: int, column: int) -> bool:
         """Set one bit; appends to the WAL and snapshots at MAX_OP_N
         (fragment.go:382-433 setBit + incrementOpN)."""
+        prev_gen = self.row_generation(row_id)
         changed = self.storage.add(pos(row_id, column))
         if changed:
             self._touch(row_id)
+            self._run_stats_update(row_id, column, prev_gen, added=True)
         self._increment_op_n()
         return changed
 
     @_locked
     def clear_bit(self, row_id: int, column: int) -> bool:
+        prev_gen = self.row_generation(row_id)
         changed = self.storage.remove(pos(row_id, column))
         if changed:
             self._touch(row_id)
+            self._run_stats_update(row_id, column, prev_gen, added=False)
         self._increment_op_n()
         return changed
 
@@ -476,6 +484,9 @@ class Fragment:
             for rid in changed_rows:
                 self._row_gen[rid] = gen
                 self._block_checksums.pop(rid // HASH_BLOCK_SIZE, None)
+                # run stats recompute lazily on the next planner read —
+                # a batch's net effect can split/merge arbitrarily many runs
+                self._row_run_stats.pop(rid, None)
             if self._volatile:
                 self.volatile_mutations += n_changed
         if n_net and not self._volatile:
@@ -705,6 +716,90 @@ class Fragment:
         heuristic."""
         return int(self.row_counts([row_id])[0])
 
+    def row_runs(self, row_id: int) -> np.ndarray:
+        """int64[n, 2] inclusive shard-local [start, last] intervals of a
+        row, built DIRECTLY from its containers: run containers contribute
+        their interval arrays verbatim (offset by container position),
+        array/bitmap containers via the consecutive-diff break scan, and
+        intervals adjacent across a container boundary merge. No dense
+        plane is ever materialized — this is the storage->device upload
+        path for run leaves (the device analog of the reference's
+        runnable containers, roaring/roaring.go:56-62)."""
+        kpr = CONTAINERS_PER_SHARD
+        base = row_id * kpr
+        get = self.storage.containers.get
+        parts = []
+        for j in range(kpr):
+            c = get(base + j)
+            if c is None or not c.n:
+                continue
+            iv = c._runs().astype(np.int64)
+            if iv.shape[0]:
+                parts.append(iv + (j << 16))
+        if not parts:
+            return np.empty((0, 2), dtype=np.int64)
+        iv = np.concatenate(parts)
+        if iv.shape[0] > 1:
+            gap = iv[1:, 0] > iv[:-1, 1] + 1
+            starts = iv[np.concatenate(([True], gap)), 0]
+            lasts = iv[np.concatenate((gap, [True])), 1]
+            iv = np.stack([starts, lasts], axis=1)
+        return iv
+
+    def row_run_stats(self, row_id: int) -> tuple[int, int]:
+        """(interval count, max run length) of one row — the planner's run
+        statistic (pilosa_tpu/planner.py choose_representation), cached
+        per row generation like row_counts. Per-bit writes maintain the
+        interval count incrementally with two neighbor probes (see
+        _run_stats_update); a merge-add marks max_run for recompute, and
+        bulk/batch writes drop the entry so this read rebuilds from the
+        containers. max_run can transiently be an UPPER bound after
+        clears (a split run keeps the old maximum until the next full
+        rebuild) — the chooser only uses it as a coarse runniness signal,
+        so overstating it briefly never affects correctness, only which
+        faithful representation is picked."""
+        gen = self.row_generation(row_id)
+        entry = self._row_run_stats.get(row_id)
+        if entry is not None and entry[0] == gen and entry[2] >= 0:
+            return entry[1], entry[2]
+        iv = self.row_runs(row_id)
+        n = int(iv.shape[0])
+        maxr = int((iv[:, 1] - iv[:, 0] + 1).max()) if n else 0
+        self._row_run_stats[row_id] = (gen, n, maxr)
+        return n, maxr
+
+    def _run_stats_update(self, row_id: int, column: int, prev_gen: int,
+                          added: bool) -> None:
+        """Incremental run-stat maintenance for one changed bit: the
+        interval-count delta is fully determined by the two neighbor
+        bits (probed AFTER the write — the write never changes them).
+        An isolated add creates a run (+1), an add touching one neighbor
+        extends one (0), an add bridging two merges them (−1); clears
+        are the mirror image. Only applies to an entry that was current
+        for the row's pre-write generation; anything else recomputes
+        lazily on the next row_run_stats read."""
+        entry = self._row_run_stats.get(row_id)
+        if entry is None:
+            return
+        if entry[0] != prev_gen:
+            self._row_run_stats.pop(row_id, None)
+            return
+        col = column % SHARD_WIDTH
+        left = col > 0 and self.storage.contains(
+            pos(row_id, col - 1))
+        right = col < SHARD_WIDTH - 1 and self.storage.contains(
+            pos(row_id, col + 1))
+        _, n, maxr = entry
+        if added:
+            n += 1 - int(left) - int(right)
+            # isolated: a length-1 run; touching a neighbor: the grown
+            # run's length is unknowable from two probes -> recompute
+            maxr = max(maxr, 1) if not (left or right) else -1
+        else:
+            n += int(left) + int(right) - 1
+        self._row_run_stats[row_id] = (
+            self.row_generation(row_id), n, maxr)
+
     def max_row_id(self) -> int:
         m = self.storage.max()
         return 0 if m is None else m // SHARD_WIDTH
@@ -912,6 +1007,7 @@ class Fragment:
         self._block_checksums.clear()
         self._row_counts_cache = None
         self._row_ids_cache = None
+        self._row_run_stats.clear()
 
     @_locked
     def import_roaring(self, data: bytes, clear: bool = False) -> None:
@@ -950,6 +1046,7 @@ class Fragment:
         self._row_gen.clear()  # all rows considered dirty
         self._bulk_gen = self.generation
         self._block_checksums.clear()
+        self._row_run_stats.clear()
         if self._volatile:
             # bulk writes bypass _touch: count them so /debug/vars'
             # volatileFragments reflects EVERY acknowledged-but-not-
@@ -1224,6 +1321,7 @@ class Fragment:
         self._row_gen.clear()
         self._bulk_gen = self.generation
         self._block_checksums.clear()
+        self._row_run_stats.clear()
         if self._volatile:
             self.volatile_mutations += 1  # see import_roaring
         self._maybe_snapshot()

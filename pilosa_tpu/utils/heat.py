@@ -136,9 +136,10 @@ def leaf_frag_keys(key) -> list[tuple]:
         if kind == "row" and len(key) >= 7:
             _, index, field, view, _row, shards, _gens = key[:7]
             return [(index, field, view, int(s)) for s in shards]
-        if kind == "sparse" and len(key) >= 8:
-            # hybrid sparse row leaf (parallel/residency.py HybridManager):
-            # same fragment coverage as "row", one extra slot-count field
+        if kind in ("sparse", "run") and len(key) >= 8:
+            # hybrid sparse/run row leaf (parallel/residency.py
+            # HybridManager): same fragment coverage as "row", one extra
+            # slot-count field
             _, index, field, view, _row, shards, _slots, _gens = key[:8]
             return [(index, field, view, int(s)) for s in shards]
         if kind == "timerange" and len(key) >= 7:

@@ -1,0 +1,45 @@
+#!/usr/bin/env bash
+# capture_onchip.sh — run the bench suite against a REAL accelerator and
+# refuse to publish anything measured on the CPU fallback.
+#
+# The axon tunnel fails soft: when the backend is down, jax silently hands
+# back CpuDevice and every "TPU" number in the artifact is actually a Xeon.
+# `bench.py --require-onchip` turns that into a hard exit(3); this wrapper
+# adds round bookkeeping so a capture lands as BENCH_<round>.json plus the
+# per-stage checkpoint under benches/.
+#
+# Usage:
+#   scripts/capture_onchip.sh [round] [extra bench.py args...]
+#   PILOSA_BENCH_STAGES=kernels scripts/capture_onchip.sh r09
+#
+# Env (all optional, forwarded to bench.py):
+#   PILOSA_BENCH_STAGES      comma list to filter stages (e.g. kernels)
+#   PILOSA_BENCH_DEADLINE_S  overall budget (default 1800)
+#   PILOSA_BENCH_COMPARE     prior BENCH_*.json to gate against
+set -euo pipefail
+
+cd "$(dirname "$0")/.."
+
+ROUND="${1:-${PILOSA_BENCH_ROUND:-}}"
+if [ -n "${ROUND}" ]; then
+    shift || true
+    export PILOSA_BENCH_ROUND="${ROUND}"
+fi
+
+ARGS=(--require-onchip)
+if [ -n "${PILOSA_BENCH_COMPARE:-}" ]; then
+    ARGS+=(--compare "${PILOSA_BENCH_COMPARE}")
+fi
+
+echo "[capture] round=${PILOSA_BENCH_ROUND:-r08} stages=${PILOSA_BENCH_STAGES:-all}" >&2
+if python bench.py "${ARGS[@]}" "$@"; then
+    echo "[capture] on-chip artifact written" >&2
+else
+    rc=$?
+    if [ "$rc" -eq 3 ]; then
+        echo "[capture] FAILED: no accelerator (CpuDevice only) — nothing published" >&2
+    else
+        echo "[capture] FAILED: bench exited rc=$rc" >&2
+    fi
+    exit "$rc"
+fi

@@ -127,6 +127,22 @@ def test_lint_stats_registry():
     assert lint_source("pilosa_tpu/server.py", bad) == []
 
 
+def test_lint_raw_jit():
+    bare = "import jax\n@jax.jit\ndef f(a):\n    return a\n"
+    configured = ("import jax\n@jax.jit(static_argnames=('k',))\n"
+                  "def f(a, k):\n    return a\n")
+    call_form = "import jax\ng = jax.jit(lambda a: a)\n"
+    aliased = "from jax import jit as J\n@J\ndef f(a):\n    return a\n"
+    counted = ("from pilosa_tpu.utils.telemetry import counted_jit\n"
+               "@counted_jit('bsi')\ndef f(a):\n    return a\n")
+    for bad in (bare, configured, call_form, aliased):
+        assert rules(lint_source("pilosa_tpu/ops/x.py", bad)) == ["raw-jit"]
+    # counted_jit is the sanctioned wrapper
+    assert lint_source("pilosa_tpu/ops/x.py", counted) == []
+    # scope is pilosa_tpu/ops/ only — jit elsewhere is someone else's call
+    assert lint_source("pilosa_tpu/executor.py", bare) == []
+
+
 # ------------------------------------------------------------- the tree gate
 
 

@@ -1,19 +1,25 @@
-"""Hybrid sparse/dense parity fuzz (ISSUE 15 satellite).
+"""Hybrid sparse/run/dense parity fuzz (ISSUE 15 satellite; ISSUE 17
+extended it three-way).
 
 Two executors share one holder: `hybrid` runs with the default sparse
 threshold AND the plan cache deliberately left warm (the interleaved
 writes must invalidate it through generation keys even as rows change
 representation), `plain` runs with sparse-threshold 0 — pure dense.
 Rounds interleave randomized nested PQL trees with set/clear churn that
-drives rows across the threshold in BOTH directions (a sparse row bulks
-up past it, a dense row is cleared below it), so the promote/demote
-hysteresis, the generation-keyed residency entries of both kinds, and
-the mixed-representation kernels are all exercised against the dense
-oracle. Any divergence — results, or error-vs-result behavior — is a
-hybrid bug.
+drives rows across BOTH thresholds in BOTH directions: a sparse row
+bulks up past the cardinality threshold, a dense row is cleared below
+it, a runny row's runs are SPLIT by mid-run clears (interval count
+crossing the run threshold promotes it dense) and MERGED back by
+adjacent contiguous sets (demoting it to runs again). The promote/
+demote hysteresis, the generation-keyed residency entries of all three
+kinds, and the mixed-representation kernels are all exercised against
+the dense oracle. Any divergence — results, or error-vs-result
+behavior — is a hybrid bug.
 
 A final phase flips the PILOSA_TPU_HYBRID=0 kill switch at runtime and
-asserts the hybrid executor immediately behaves purely dense.
+asserts the hybrid executor immediately behaves purely dense; a Pallas
+phase re-runs the parity with PILOSA_TPU_PALLAS-style kernels on
+(interpret mode off-TPU).
 """
 
 import numpy as np
@@ -29,6 +35,14 @@ SHARDS = 2
 # the hybrid executor's threshold for this test: small enough that churn
 # rounds can push rows across it both ways quickly
 THRESHOLD = 512
+# interval-count threshold for the run representation — small so a few
+# dozen mid-run clears (splits) push a runny row across it
+RUN_THRESHOLD = 48
+# row N_ROWS-2 is the dedicated RUNNY row: seeded as contiguous blocks
+# (cardinality above THRESHOLD, interval count far below RUN_THRESHOLD)
+RUNNY_ROW = N_ROWS - 2
+RUNNY_BASE = {"f": 70_000, "g": SHARD_WIDTH + 90_000}
+RUNNY_LEN = 1500
 
 
 @pytest.fixture(scope="module")
@@ -40,15 +54,26 @@ def setup(tmp_path_factory):
     for fname in FIELDS:
         f = idx.create_field(fname)
         for rid in range(N_ROWS - 1):  # last row starts empty
-            # rows straddle the threshold: some well under, some over
-            n = int(rng.integers(16, 96) * (8 ** (rid % 3)))
-            cols = rng.choice(SHARDS * SHARD_WIDTH,
-                              size=min(n, 6000), replace=False)
+            if rid == RUNNY_ROW:
+                # the runny row: two contiguous blocks — cardinality
+                # well past THRESHOLD but only 2 intervals, so the
+                # three-way planner picks the run representation
+                base = RUNNY_BASE[fname]
+                cols = np.concatenate([
+                    np.arange(base, base + RUNNY_LEN),
+                    np.arange(base + 50_000, base + 50_000 + RUNNY_LEN),
+                ])
+            else:
+                # rows straddle the threshold: some well under, some over
+                n = int(rng.integers(16, 96) * (8 ** (rid % 3)))
+                cols = rng.choice(SHARDS * SHARD_WIDTH,
+                                  size=min(n, 6000), replace=False)
             f.import_bits([rid] * len(cols), cols.tolist())
             for c in cols[:32]:
                 idx.mark_exists(int(c))
     hybrid = Executor(h)
     hybrid.hybrid.threshold = THRESHOLD
+    hybrid.hybrid.run_threshold = RUN_THRESHOLD
     assert hybrid.hybrid.active() and hybrid.plan_cache is not None
     plain = Executor(h)
     plain.hybrid.threshold = 0
@@ -107,26 +132,52 @@ def _both(hybrid, plain, pql):
 
 def _churn(h, hybrid, plain, rng):
     """Interleaved writes through BOTH executors' shared holder — chosen
-    to cross the threshold in both directions: bulk imports fatten a
-    sparse row past it, clears thin a dense row below it."""
+    to cross BOTH thresholds in both directions: bulk imports fatten a
+    sparse row past the cardinality threshold, clears thin a dense row
+    below it, mid-run single-bit clears SPLIT the runny row's intervals
+    past the run threshold (run -> dense), and a contiguous re-import
+    MERGES them back under it (dense -> run)."""
     idx = h.index("z")
     fname = FIELDS[int(rng.integers(len(FIELDS)))]
     f = idx.field(fname)
     rid = int(rng.integers(N_ROWS))
+    if rid == RUNNY_ROW:
+        # keep scattered writes off the runny row: its interval count
+        # is owned by the split/merge arms below, and random scatter
+        # would inflate it past RUN_THRESHOLD permanently
+        rid = N_ROWS - 1
     action = rng.random()
-    if action < 0.45:
+    if action < 0.35:
         # fatten: push toward/past the threshold
         cols = rng.choice(SHARDS * SHARD_WIDTH,
                           size=int(rng.integers(64, 2 * THRESHOLD)),
                           replace=False)
         f.import_bits([rid] * len(cols), cols.tolist())
-    elif action < 0.55:
+    elif action < 0.45:
         # empty the row outright: the decisive downward crossing (a
         # dense row's next upload must come back sparse — demotion)
         from pilosa_tpu.pql import Call
         hybrid._execute_clear_row(idx, Call("ClearRow", {fname: rid}),
                                   None)
-    elif action < 0.8:
+    elif action < 0.6:
+        # run SPLIT: scattered single-bit clears inside the runny row's
+        # contiguous block — each interior clear splits an interval, a
+        # couple of these actions push the count past RUN_THRESHOLD
+        from pilosa_tpu.pql import Call
+        base = RUNNY_BASE[fname]
+        offs = rng.choice(RUNNY_LEN, size=int(rng.integers(16, 48)),
+                          replace=False)
+        for o in offs.tolist():
+            hybrid._execute_clear(
+                idx, Call("Clear", {"_col": int(base + o),
+                                    fname: RUNNY_ROW}), None)
+    elif action < 0.7:
+        # run MERGE: contiguous re-import heals the splits back to one
+        # interval (and restores cardinality a ClearRow may have zeroed)
+        base = RUNNY_BASE[fname]
+        cols = np.arange(base, base + RUNNY_LEN)
+        f.import_bits([RUNNY_ROW] * len(cols), cols.tolist())
+    elif action < 0.85:
         # thin: single-bit clears through the write path
         cols = rng.integers(0, SHARDS * SHARD_WIDTH,
                             size=int(rng.integers(8, 64)))
@@ -153,10 +204,12 @@ def test_hybrid_parity_under_threshold_churn(setup):
             _both(hybrid, plain, _rand_query(rng))
         _churn(h, hybrid, plain, rng)
     snap = hybrid.hybrid.snapshot()
-    # the churn really drove representation both ways
+    # the churn really drove representation across all three kinds
     assert snap["sparseUploads"] > 0 and snap["denseUploads"] > 0
+    assert snap["runUploads"] > 0, snap
     assert snap["promoted"] > 0, snap
     assert snap["demoted"] > 0, snap
+    assert snap["runTransitions"] > 0, snap
 
 
 def test_hybrid_kill_switch_parity(setup, monkeypatch):
@@ -182,3 +235,34 @@ def test_zero_threshold_restores_pure_dense(setup):
         assert hybrid.hybrid.snapshot()["sparseUploads"] == before
     finally:
         hybrid.hybrid.threshold = old
+
+
+def test_pallas_executor_threeway_parity(setup):
+    """The Pallas kernel family (interpret mode off-TPU) under the same
+    three-way hybrid config: a fresh Pallas-on executor against the
+    plain dense XLA oracle, with the runny rows healed first so the run
+    representation is actually in play. Rounds are short — interpret
+    mode runs the kernel body in Python."""
+    from pilosa_tpu.parallel.mesh import DeviceRunner
+
+    h, hybrid, plain, rng = setup
+    idx = h.index("z")
+    for fname in FIELDS:  # heal: contiguous block -> few intervals
+        base = RUNNY_BASE[fname]
+        cols = np.arange(base, base + RUNNY_LEN)
+        idx.field(fname).import_bits([RUNNY_ROW] * len(cols),
+                                     cols.tolist())
+    hp = Executor(h, runner=DeviceRunner(use_pallas=True))
+    hp.hybrid.threshold = THRESHOLD
+    hp.hybrid.run_threshold = RUN_THRESHOLD
+    assert hp.hybrid.active()
+    # force run-leaf traffic, then randomized trees + a TopN (the
+    # fused popcount-rank Pallas path)
+    _both(hp, plain,
+          f"Count(Intersect(Row(f={RUNNY_ROW}), Row(g={RUNNY_ROW})))")
+    _both(hp, plain, f"Union(Row(f={RUNNY_ROW}), Row(g=0))")
+    for _ in range(6):
+        _both(hp, plain, _rand_query(rng))
+    _both(hp, plain, f"TopN(f, Row(f={RUNNY_ROW}), n=4)")
+    _both(hp, plain, "TopN(g, Union(Row(g=0), Row(g=1)), n=4)")
+    assert hp.hybrid.snapshot()["runUploads"] > 0

@@ -303,21 +303,25 @@ def test_live_metrics_hybrid_families(pair):
     assert types["pilosa_hybrid_total"] == "counter"
     reps = {l.get("rep") for n, l, _ in samples
             if n == "pilosa_hybrid_total" and "rep" in l}
-    assert {"sparse", "dense"} <= reps
+    assert {"sparse", "run", "dense"} <= reps
     transitions = {l.get("transition") for n, l, _ in samples
                    if n == "pilosa_hybrid_total" and "transition" in l}
-    assert {"promoted", "demoted", "materialized"} <= transitions
+    assert {"promoted", "demoted", "materialized", "run"} <= transitions
     sparse_ups = next(v for n, l, v in samples
                       if n == "pilosa_hybrid_total"
                       and l.get("rep") == "sparse")
     assert sparse_ups >= 1  # real sparse traffic uploaded
     for fam in ("pilosa_hybridLeaves", "pilosa_hybridBytes"):
         assert types[fam] == "gauge"
-        assert {"sparse", "dense"} <= {
+        assert {"sparse", "run", "dense"} <= {
             l.get("rep") for n, l, _ in samples if n == fam}
     thr = next(v for n, l, v in samples
                if n == "pilosa_hybrid" and l.get("key") == "threshold")
     assert thr == 4096.0  # the default [query] sparse-threshold
+    run_thr = next(v for n, l, v in samples
+                   if n == "pilosa_hybrid"
+                   and l.get("key") == "runThreshold")
+    assert run_thr == 2048.0  # the default [query] run-threshold
     assert any(n == "pilosa_hybrid" and l.get("key") == "enabled"
                and v == 1.0 for n, l, v in samples)
 

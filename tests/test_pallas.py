@@ -214,3 +214,91 @@ def test_pair_stream_counts_mesh_parity(replicas):
     for q in range(k):
         expect = int(np.bitwise_count(host[ii[q]] & host[jj[q]]).sum())
         assert got[q] == expect, (q, got[q], expect)
+
+
+# -- run-container PR kernels (ISSUE 17): fused TopN counts, BSI sweeps
+
+
+def test_topn_counts_packed_parity():
+    """Packed [3, R] = (|row∩src|, |row|, |src|) against numpy and the
+    XLA twin, across shapes that force row AND word padding."""
+    from pilosa_tpu.ops.topn import tanimoto_counts_packed as xla_packed
+
+    for r, w in ((1, 512), (8, 2048), (100, 2048), (130, 4096)):
+        rows = RNG.integers(0, 2**32, size=(r, w), dtype=np.uint32)
+        src = RNG.integers(0, 2**32, size=(w,), dtype=np.uint32)
+        got = np.asarray(pk.topn_counts_packed(rows, src))
+        assert got.shape == (3, r)
+        np.testing.assert_array_equal(
+            got[0], np.bitwise_count(rows & src).sum(axis=1))
+        np.testing.assert_array_equal(
+            got[1], np.bitwise_count(rows).sum(axis=1))
+        assert np.all(got[2] == np.bitwise_count(src).sum())
+        np.testing.assert_array_equal(got, np.asarray(xla_packed(rows, src)))
+
+
+def test_top_rows_pallas_matches_xla():
+    from pilosa_tpu.ops.topn import top_rows as xla_top_rows
+
+    rows = RNG.integers(0, 2**32, size=(12, 512), dtype=np.uint32)
+    for k in (1, 5, 50):
+        gc, gi = pk.top_rows(rows, k)
+        ec, ei = xla_top_rows(rows, k)
+        np.testing.assert_array_equal(np.asarray(gc), np.asarray(ec))
+        np.testing.assert_array_equal(np.asarray(gi), np.asarray(ei))
+
+
+def test_bsi_compare_all_ops_parity():
+    """Blocked VMEM sweep vs the XLA unrolled form: every op, values that
+    exercise strict/equal boundaries, ragged shard/word padding."""
+    from pilosa_tpu.ops import bsi as bsiops
+
+    depth, s, w = 6, 3, 640  # pads S->8 and W->1024
+    rng = np.random.default_rng(11)
+    vals = rng.integers(0, 2**depth, size=(s, w * 32), dtype=np.int64)
+    planes = np.stack([
+        np.packbits(((vals >> i) & 1).astype(np.uint8), axis=-1,
+                    bitorder="little").view(np.uint32).reshape(s, w)
+        for i in range(depth)]).astype(np.uint32)
+    exists = np.full((s, w), 0xFFFFFFFF, dtype=np.uint32)
+    for op in ("lt", "lte", "gt", "gte", "eq", "neq"):
+        for pred in (0, 1, 17, 2**depth - 1):
+            bits = bsiops.value_to_bits(pred, depth)
+            got = np.asarray(pk.bsi_compare(planes, exists, bits, op))
+            expect = np.asarray(bsiops.compare(planes, exists, bits, op))
+            np.testing.assert_array_equal(got, expect, err_msg=f"{op} {pred}")
+
+
+def test_bsi_compare_respects_exists():
+    """Columns outside the existence row never match, whatever the op."""
+    from pilosa_tpu.ops import bsi as bsiops
+
+    depth, s, w = 4, 2, 512
+    planes = RNG.integers(0, 2**32, size=(depth, s, w), dtype=np.uint32)
+    exists = RNG.integers(0, 2**32, size=(s, w), dtype=np.uint32)
+    bits = bsiops.value_to_bits(5, depth)
+    for op in ("lt", "gte", "neq"):
+        got = np.asarray(pk.bsi_compare(planes, exists, bits, op))
+        assert not np.any(got & ~exists)
+        np.testing.assert_array_equal(
+            got, np.asarray(bsiops.compare(planes, exists, bits, op)))
+
+
+def test_bsi_sum_counts_parity():
+    """Packed [depth+1, S] per-plane counts + filter count in one kernel
+    vs the XLA sum_counts row layout."""
+    from pilosa_tpu.ops import bsi as bsiops
+
+    for depth, s, w in ((1, 1, 512), (8, 3, 640), (24, 9, 512)):
+        planes = RNG.integers(0, 2**32, size=(depth, s, w), dtype=np.uint32)
+        filt = RNG.integers(0, 2**32, size=(s, w), dtype=np.uint32)
+        got = np.asarray(pk.bsi_sum_counts(planes, filt))
+        expect = np.asarray(bsiops.sum_counts(planes, filt))
+        np.testing.assert_array_equal(got, expect)
+
+
+def test_bsi_sum_counts_depth_cap():
+    planes = RNG.integers(0, 2**32, size=(128, 1, 512), dtype=np.uint32)
+    filt = RNG.integers(0, 2**32, size=(1, 512), dtype=np.uint32)
+    with pytest.raises(ValueError):
+        pk.bsi_sum_counts(planes, filt)

@@ -1,0 +1,216 @@
+"""Run-container kernel family + three-way hybrid manager (ISSUE 17).
+
+Kernel half: every run op against a set-algebra oracle built from the
+same column sets — intersection (run∩run, run∩dense, sparse∩run), the
+fused run_intersect_count, counts, densify, and the host-side builders.
+Manager half: the three-way sparse/run/dense transition rule — both
+thresholds, both hysteresis bands, the run_stats=None advisory-missing
+case, and the transition counters the fuzz asserts on.
+"""
+
+import numpy as np
+import pytest
+
+import pilosa_tpu.ops.bitvector as bv
+from pilosa_tpu.parallel.residency import HybridManager
+
+W = 64  # words per test row (2048 bits — full shard width not needed)
+WIDTH = W * 32
+
+
+def runs_of(cols, slots=16):
+    return bv.runs_from_columns(np.asarray(sorted(cols), dtype=np.int64),
+                                slots)
+
+
+def sparse_of(cols, slots=64):
+    return bv.sparse_from_columns(np.asarray(sorted(cols), dtype=np.int64),
+                                  slots)
+
+
+def dense_of(cols):
+    return bv.dense_from_columns(np.asarray(sorted(cols), dtype=np.int64),
+                                 width=WIDTH)
+
+
+SETS = [
+    set(),
+    set(range(5, 40)),
+    set(range(0, 200)) | set(range(900, 1000)),
+    set(range(30, 35)) | set(range(37, 60)) | {100, 101, 102, 2047},
+    set(range(0, WIDTH, 7)) & set(range(0, 512)),  # many 1-bit runs
+]
+
+
+def test_runs_from_columns_roundtrip():
+    for s in SETS:
+        runs = runs_of(s, slots=256)
+        back = np.asarray(bv.run_to_dense(runs, W))
+        np.testing.assert_array_equal(back, dense_of(s))
+        assert int(bv.run_count(runs)) == len(s)
+
+
+def test_intervals_from_sorted():
+    iv = bv.intervals_from_sorted(np.array([1, 2, 3, 7, 9, 10]))
+    np.testing.assert_array_equal(iv, [[1, 3], [7, 7], [9, 10]])
+    assert bv.intervals_from_sorted(np.array([], dtype=np.int64)).shape == \
+        (0, 2)
+
+
+def test_runs_from_intervals_overflow_drops():
+    """Intervals past `slots` drop (stale-stat case): lossy but sized by
+    the caller from fragment stats, so the build stays bounded."""
+    iv = np.array([[0, 1], [4, 5], [8, 9]])
+    runs = bv.runs_from_intervals(iv, 2)
+    assert runs.shape == (2, 2)
+    assert int(bv.run_count(runs)) == 4
+
+
+@pytest.mark.parametrize("ai", range(len(SETS)))
+@pytest.mark.parametrize("bi", range(len(SETS)))
+def test_run_ops_match_set_algebra(ai, bi):
+    a, b = SETS[ai], SETS[bi]
+    ra, rb = runs_of(a, 128), runs_of(b, 128)
+    inter = a & b
+
+    got = np.asarray(bv.run_to_dense(bv.run_intersect(ra, rb), W))
+    np.testing.assert_array_equal(got, dense_of(inter))
+    # the fused count never sorts or materializes the overlap list
+    assert int(bv.run_intersect_count(ra, rb)) == len(inter)
+
+    dm = np.asarray(bv.run_intersect_dense(ra, dense_of(b), W))
+    np.testing.assert_array_equal(dm, dense_of(inter))
+    assert int(bv.run_dense_count(ra, dense_of(b), W)) == len(inter)
+
+    sa = sparse_of(a, 4096)
+    got_sp = np.asarray(bv.sparse_intersect_run(sa, rb))
+    live = got_sp[got_sp < bv.SPARSE_SENTINEL]
+    assert set(live.tolist()) == inter
+    diff = np.asarray(bv.sparse_difference_run(sa, rb))
+    assert set(diff[diff < bv.SPARSE_SENTINEL].tolist()) == a - b
+
+
+def test_run_intersect_keeps_sorted_sentinel_contract():
+    """Output runs are sorted with sentinel padding at the tail — the
+    contract every downstream kernel assumes."""
+    out = np.asarray(bv.run_intersect(runs_of(SETS[2], 16),
+                                      runs_of(SETS[3], 16)))
+    starts = out[0]
+    assert np.all(np.diff(starts.astype(np.int64)) >= 0)
+    valid = starts < bv.RUN_SENTINEL
+    assert valid.any()
+    last_valid = int(np.max(np.flatnonzero(valid)))
+    # sentinels only after the last valid slot — no interleaved holes
+    assert np.all(valid[:last_valid + 1])
+    assert np.all(starts[last_valid + 1:] == bv.RUN_SENTINEL)
+
+
+def test_run_ops_batch_over_shards():
+    """Shard-batched layout [S, 2, R]: per-shard results independent."""
+    ra = np.stack([runs_of(SETS[1], 32), runs_of(SETS[2], 32)])
+    rb = np.stack([runs_of(SETS[3], 32), runs_of(SETS[1], 32)])
+    counts = np.asarray(bv.run_intersect_count(ra, rb))
+    assert counts.tolist() == [len(SETS[1] & SETS[3]),
+                               len(SETS[2] & SETS[1])]
+    cnt = np.asarray(bv.run_count(ra))
+    assert cnt.tolist() == [len(SETS[1]), len(SETS[2])]
+
+
+def test_eval_hybrid_mixed_tree_with_runs():
+    a, b, c = SETS[1], SETS[2], SETS[3]
+    leaves = [runs_of(a, 64), dense_of(b), sparse_of(c, 64)]
+    kinds = ["run", "dense", "sparse"]
+    prog = ("and", ("or", ("leaf", 0), ("leaf", 1)), ("leaf", 2))
+    kind, arr = bv.eval_hybrid(prog, leaves, kinds, n_words=W)
+    expect = (a | b) & c
+    if kind == "sparse":
+        got = set(np.asarray(arr)[np.asarray(arr) < bv.SPARSE_SENTINEL]
+                  .tolist())
+    elif kind == "run":
+        got = set(bv.columns_from_dense(
+            np.asarray(bv.run_to_dense(arr, W))).tolist())
+    else:
+        got = set(bv.columns_from_dense(np.asarray(arr)).tolist())
+    assert got == expect
+    assert bv.hybrid_count(prog, leaves, kinds, n_words=W) == len(expect)
+
+
+def test_hybrid_count_fused_all_run_and():
+    """The all-run AND pushdown takes the fused no-argsort path; parity
+    with the generic evaluator on 2- and 3-operand programs."""
+    leaves = [runs_of(SETS[1], 64), runs_of(SETS[2], 64),
+              runs_of(SETS[3], 64)]
+    kinds = ["run", "run", "run"]
+    p2 = ("and", ("leaf", 0), ("leaf", 1))
+    p3 = ("and", ("leaf", 0), ("leaf", 1), ("leaf", 2))
+    assert bv.hybrid_count(p2, leaves, kinds) == len(SETS[1] & SETS[2])
+    assert bv.hybrid_count(p3, leaves, kinds) == \
+        len(SETS[1] & SETS[2] & SETS[3])
+
+
+# ------------------------------------------------ three-way manager rule
+
+
+def mgr(threshold=1000, run_threshold=100, hysteresis=0.25):
+    return HybridManager(threshold=threshold, hysteresis=hysteresis,
+                         run_threshold=run_threshold)
+
+
+def test_choose_three_way_by_regime():
+    m = mgr()
+    assert m.choose(("r", 1), 500)[0] == "sparse"
+    # above the cardinality threshold, few intervals -> run
+    rep, slots = m.choose(("r", 2), 5000, run_stats=(8, 2048))
+    assert rep == "run" and slots >= 8
+    # above both thresholds -> dense
+    assert m.choose(("r", 3), 5000, run_stats=(500, 4))[0] == "dense"
+    # run stats missing entirely (no container walk) -> dense
+    assert m.choose(("r", 4), 5000)[0] == "dense"
+
+
+def test_run_stats_missing_keeps_run_resident_row():
+    """run_stats=None means the signal is MISSING, not changed: a row
+    already run-resident stays run instead of flapping dense."""
+    m = mgr()
+    assert m.choose(("r", 1), 5000, run_stats=(8, 2048))[0] == "run"
+    assert m.choose(("r", 1), 5000)[0] == "run"
+    assert m.run_transitions == 0
+
+
+def test_run_hysteresis_band():
+    m = mgr()  # run_threshold 100, band floor 75
+    # dense row whose interval count falls into the band stays dense
+    assert m.choose(("r", 1), 5000, run_stats=(500, 4))[0] == "dense"
+    assert m.choose(("r", 1), 5000, run_stats=(90, 50))[0] == "dense"
+    # below the band floor it demotes to run
+    assert m.choose(("r", 1), 5000, run_stats=(40, 200))[0] == "run"
+    assert m.demoted == 1 and m.run_transitions == 1
+    # interval count crossing the threshold promotes immediately
+    assert m.choose(("r", 1), 5000, run_stats=(101, 30))[0] == "dense"
+    assert m.promoted == 1 and m.run_transitions == 2
+
+
+def test_sparse_band_keeps_run_rep():
+    """A run row whose cardinality falls into the sparse band keeps its
+    rep (hot or no heat tracker); below the floor it demotes sparse."""
+    m = mgr()  # threshold 1000, band floor 750
+    assert m.choose(("r", 1), 5000, run_stats=(8, 700))[0] == "run"
+    assert m.choose(("r", 1), 900, run_stats=(8, 120))[0] == "run"
+    assert m.choose(("r", 1), 500)[0] == "sparse"
+    # first choose has no history (not a transition); leaving run is one
+    assert m.run_transitions == 1 and m.demoted == 1
+
+
+def test_run_threshold_zero_disables_runs():
+    m = mgr(run_threshold=0)
+    assert m.choose(("r", 1), 5000, run_stats=(2, 2500))[0] == "dense"
+    snap = m.snapshot()
+    assert snap["runThreshold"] == 0 and snap["runUploads"] == 0
+
+
+def test_record_upload_run_counters():
+    m = mgr()
+    m.record_upload("run", 4096)
+    snap = m.snapshot()
+    assert snap["runUploads"] == 1
+    assert snap["runBytesUploaded"] == 4096
