@@ -50,6 +50,15 @@ raw-jit         `jax.jit` (dotted, aliased, or as a decorator) inside
                 counts are invisible to `/metrics` and the advisor.
                 Every ops kernel wraps with
                 counted_jit("<family>", ...) instead.
+kernel-family   a `counted_jit(...)` / `record_dispatch(...)` call (or a
+                `KERNEL_FAMILY = ...` batcher attribute) whose family is
+                not a string LITERAL registered in the import-free
+                kernel-family inventory (constants.KERNEL_FAMILY_REPS).
+                The inventory is what maps each family to its
+                representation label on the unconditional
+                pilosa_kernels* metric families — an unregistered family
+                would dispatch attributed to a rep label that zero-fill
+                never emits, so its absence could never alert.
 """
 
 from __future__ import annotations
@@ -99,6 +108,17 @@ _RAW_JIT_MSG = ("raw jax.jit compiles outside the per-family XLA "
                 "telemetry; wrap with utils.telemetry.counted_jit("
                 "\"<family>\", ...) so recompiles and dispatches are "
                 "observable")
+
+# the kernel-family inventory (import-free constants module, so the
+# linter never imports jax): every counted_jit / record_dispatch /
+# batcher KERNEL_FAMILY site must name a registered family
+from pilosa_tpu.constants import KERNEL_FAMILIES  # noqa: E402
+
+_KERNEL_FAMILY_FNS = frozenset({"counted_jit", "record_dispatch"})
+_KERNEL_FAMILY_MSG = (
+    "kernel family must be a string literal registered in "
+    "constants.KERNEL_FAMILY_REPS — unregistered families dispatch "
+    "under a rep label the /metrics zero-fill never emits")
 
 
 @dataclass(frozen=True)
@@ -273,6 +293,28 @@ class _FileLinter(ast.NodeVisitor):
                            "journal emit with a non-literal event "
                            "type; pass a string literal registered in "
                            "utils/events.py EVENT_TYPES")
+        # kernel-family: counted_jit / record_dispatch must attribute to
+        # a registered family (the definitions in utils/telemetry.py are
+        # defs, not calls, so they are naturally out of scope).
+        # record_dispatch only in its telemetry-module form — the name
+        # also exists on QueryProfile, where it records batch dispatch
+        # shares, not kernel families
+        fam_fn = _last_name(node.func)
+        is_family_call = fam_fn == "counted_jit" or (
+            fam_fn == "record_dispatch"
+            and (isinstance(node.func, ast.Name)
+                 or _dotted(node.func) in ("telemetry.record_dispatch",
+                                           "_telemetry.record_dispatch")))
+        if is_family_call and not self.relpath.endswith("analysis/lint.py"):
+            first = node.args[0] if node.args else None
+            if not (isinstance(first, ast.Constant)
+                    and isinstance(first.value, str)):
+                self._emit(node, "kernel-family",
+                           f"non-literal family: {_KERNEL_FAMILY_MSG}")
+            elif first.value not in KERNEL_FAMILIES:
+                self._emit(node, "kernel-family",
+                           f"unregistered family {first.value!r}: "
+                           f"{_KERNEL_FAMILY_MSG}")
         # stats-registry
         if (not self.is_stats_factory
                 and _last_name(node.func) in ("StatsClient", "StatsDClient",
@@ -281,6 +323,32 @@ class _FileLinter(ast.NodeVisitor):
                        "stats client constructed outside the registry "
                        "wiring (utils/stats.py, server.py); its metrics "
                        "would never reach /metrics")
+        self.generic_visit(node)
+
+    def _check_kernel_family_assign(self, target, value, node) -> None:
+        # kernel-family: a batcher's KERNEL_FAMILY attribute routes its
+        # queue-wait attribution; None is the explicit opt-out (host-side
+        # batchers like NodeCoalescer), anything else must be registered
+        if _last_name(target) != "KERNEL_FAMILY" or value is None:
+            return
+        if isinstance(value, ast.Constant) and value.value is None:
+            return
+        if not (isinstance(value, ast.Constant)
+                and isinstance(value.value, str)):
+            self._emit(node, "kernel-family",
+                       f"non-literal KERNEL_FAMILY: {_KERNEL_FAMILY_MSG}")
+        elif value.value not in KERNEL_FAMILIES:
+            self._emit(node, "kernel-family",
+                       f"unregistered family {value.value!r}: "
+                       f"{_KERNEL_FAMILY_MSG}")
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        for t in node.targets:
+            self._check_kernel_family_assign(t, node.value, node)
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign) -> None:
+        self._check_kernel_family_assign(node.target, node.value, node)
         self.generic_visit(node)
 
     def visit_Expr(self, node: ast.Expr) -> None:

@@ -161,6 +161,9 @@ class API:
         # the merged HLC-sorted cluster timeline, same degradation
         # contract (404 peers are "legacy", never an error)
         self.cluster_events_fn = None
+        # federation hook for GET /cluster/hbm (Server.cluster_hbm): the
+        # fleet's per-node HBM residency maps, same degradation contract
+        self.cluster_hbm_fn = None
         # multi-tenant QoS plane (pilosa_tpu/qos.py QosPlane); set by
         # Server. The HTTP layer runs admission against it; here it
         # collects execution-boundary sheds (expired deadlines — local
@@ -319,6 +322,13 @@ class API:
                 qprofile.current_profile.reset(prof_tok)
             if prof is not None:
                 prof.finish()
+                if ok and not remote:
+                    # EXPLAIN calibration: pair the profile's recorded
+                    # plan estimates with the scalar results they
+                    # predicted (planner.calibration ring — what makes
+                    # ?explain=true estimates auditable, ISSUE 18)
+                    from pilosa_tpu import planner as _planner
+                    _planner.record_calibration(prof, query.calls, results)
             qprofile.last_profile.set(prof)
             # per-principal query/error counts (the device/HBM/RPC
             # charges landed at their own sites while the query ran)
@@ -385,6 +395,46 @@ class API:
             if prof is not None:
                 out["profile"] = prof.to_dict()
         return out
+
+    def explain(self, index_name: str, pql: str,
+                shards: Optional[list[int]] = None) -> dict:
+        """POST /index/{index}/query?explain=true: plan the query and
+        return the planned tree — per-operand representation, residency
+        state, predicted kernel family and estimated h2d bytes — WITHOUT
+        executing it. No device program is dispatched, no row ids are
+        minted, no planner hysteresis advances (the executor's explain
+        walk peeks every decision), so EXPLAIN is safe against a
+        production node at any rate. Write calls plan to nothing."""
+        self._validate("query")
+        index = self.holder.index(index_name)
+        if index is None:
+            raise NotFoundError(f"index not found: {index_name}")
+        query = pql
+        if isinstance(pql, str):
+            from pilosa_tpu.pql import parse_string_cached
+            try:
+                query = parse_string_cached(pql)
+            except ValueError as e:
+                raise ApiError(str(e))
+        from pilosa_tpu import planner as _planner
+        out = []
+        for call in query.calls:
+            if call.name in self.executor.WRITE_CALLS:
+                out.append({"call": call.name, "planned": False,
+                            "note": "write call: nothing to plan"})
+                continue
+            if (call.name not in _planner.PLANNED_CALLS
+                    and call.name not in _planner.BITMAP_CALLS):
+                out.append({"call": call.name, "planned": False,
+                            "note": "call is executed host-side; no "
+                                    "device plan"})
+                continue
+            try:
+                out.append(self.executor.explain_call(index, call, shards))
+            except (ExecutionError, ValueError) as e:
+                raise ApiError(str(e))
+        return {"index": index_name, "explain": out,
+                "calibration": _planner.calibration.snapshot(limit=0)}
 
     def query_batch(self, entries: list[dict]) -> list[tuple]:
         """Execute a coalesced fan-out envelope (POST /internal/query-batch,

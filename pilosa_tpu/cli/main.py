@@ -108,6 +108,16 @@ def build_parser() -> argparse.ArgumentParser:
     tl.add_argument("--json", action="store_true", dest="as_json",
                     help="print the raw merged document instead of the "
                          "rendered timeline")
+
+    pcap = sub.add_parser(
+        "profile-capture", help="capture an on-demand XLA device profile "
+                                "on a live node (POST /debug/device-"
+                                "profile) and print the spool path")
+    pcap.add_argument("--host", default="http://localhost:10101")
+    pcap.add_argument("--seconds", type=float, default=2.0,
+                      help="trace window length (clamped server-side)")
+    pcap.add_argument("--json", action="store_true", dest="as_json",
+                      help="print the raw capture document")
     return p
 
 
@@ -506,6 +516,34 @@ def cmd_timeline(args) -> int:
     return 0
 
 
+def cmd_profile_capture(args) -> int:
+    """`pilosa-tpu profile-capture`: wrap ?seconds= of the node's live
+    traffic in jax.profiler.trace (POST /debug/device-profile) and print
+    where the capture spooled. "disabled" (PILOSA_TPU_DEVICE_PROFILE=0)
+    and "busy" (a capture is already running) are reported, not
+    errored — the node never blocks serving for a profile."""
+    url = f"{args.host}/debug/device-profile?seconds={args.seconds:g}"
+    try:
+        req = urllib.request.Request(url, data=b"", method="POST")
+        with urllib.request.urlopen(req, timeout=args.seconds + 30) as resp:
+            doc = json.loads(resp.read())
+    except (OSError, ValueError) as e:
+        raise SystemExit(f"error: capturing via {url}: {e}")
+    if args.as_json:
+        print(json.dumps(doc, indent=2, sort_keys=True))
+        return 0 if doc.get("status") == "ok" else 1
+    status = doc.get("status", "?")
+    if status == "ok":
+        print(f"captured {doc.get('seconds')}s device profile "
+              f"({doc.get('bytes', 0)} bytes) -> {doc.get('dir')}")
+        print("open with: tensorboard --logdir "
+              + str(doc.get("spoolDir", doc.get("dir"))))
+        return 0
+    print(f"capture not taken: {status}"
+          + (f" ({doc.get('error')})" if doc.get("error") else ""))
+    return 1
+
+
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     handler = {
@@ -518,6 +556,7 @@ def main(argv=None) -> int:
         "generate-config": cmd_generate_config,
         "advise": cmd_advise,
         "timeline": cmd_timeline,
+        "profile-capture": cmd_profile_capture,
     }[args.command]
     return handler(args)
 

@@ -43,3 +43,29 @@ EXISTENCE_FIELD_NAME = "_exists"
 # On-disk roaring format magic (reference: roaring/roaring.go:32).
 MAGIC_NUMBER = 12348
 STORAGE_VERSION = 0
+
+# Kernel-family inventory: every family string passed to
+# utils/telemetry.py counted_jit / record_dispatch must be registered
+# here, with the device representation its latency histograms are
+# attributed to. pilosa-lint's kernel-family rule (analysis/lint.py)
+# checks call sites against this table, so a new kernel cannot ship
+# unattributed in the pilosa_kernels* metric families. This lives in
+# constants (import-free) so the linter never has to import jax.
+KERNEL_FAMILY_REPS = {
+    "pallas": "dense",       # ops/pallas_kernels.py blocked kernels
+    "topn": "dense",         # ops/topn.py cache ranking
+    "bsi": "dense",          # ops/bsi.py bit-sliced planes
+    "bitwise": "dense",      # ops/bitvector.py dense plane programs
+    "count": "dense",        # ops/bitvector.py popcounts
+    "groupby": "dense",      # ops/bitvector.py GroupBy folds
+    "sparse": "sparse",      # ops/bitvector.py sorted-index kernels
+    "run": "run",            # ops/bitvector.py interval-pair kernels
+    "ingest": "dense",       # ops/bitvector.py bulk write patching
+    "program": "dense",      # parallel/mesh.py fused bitmap programs
+    "stream": "dense",       # parallel/mesh.py streaming folds
+    "batcher": "dense",      # parallel/batcher.py batched dispatches
+    "ici_program": "dense",  # parallel/mesh.py shard_map programs
+    "stream_mesh": "dense",  # parallel/mesh.py sharded streaming
+    "groupby_mesh": "dense",  # parallel/mesh.py sharded GroupBy
+}
+KERNEL_FAMILIES = frozenset(KERNEL_FAMILY_REPS)

@@ -843,6 +843,349 @@ class Executor:
         out["residentDenseRowBytes"] = dn.get("bytes", 0)
         return out
 
+    # ------------------------------------------------------------- HBM map
+
+    _LEAF_KIND_REP = {"row": "dense", "sparse": "sparse", "run": "run"}
+
+    def _leaf_waste(self, key: tuple, nbytes: int) -> int:
+        """Padding waste of one resident row leaf: allocated bytes beyond
+        what the row's actual cardinality / interval count needs. Dense
+        planes waste only their shard-dim padding (the plane itself is
+        the representation); sparse/run leaves waste their power-of-two
+        slot padding plus pad shards. Reads are write-maintained caches
+        (row_counts / row_run_stats) — dict probes, not container walks."""
+        kind, shards = key[0], key[5]
+        if kind == "row":
+            return max(0, nbytes - len(shards) * WORDS * 4)
+        index = self.holder.index(key[1])
+        f = index.field(key[2]) if index is not None else None
+        view = f.view(key[3]) if f is not None else None
+        useful = 0
+        if view is not None:
+            slots = key[6]
+            for s in shards:
+                frag = view.fragment(s)
+                if frag is None:
+                    continue
+                if kind == "sparse":
+                    useful += min(frag.row_cardinality(key[4]), slots) * 4
+                else:  # run: [start, last] int32 pairs
+                    n_iv, _ = frag.row_run_stats(key[4])
+                    useful += min(n_iv, slots) * 8
+        return max(0, nbytes - useful)
+
+    def hbm_snapshot(self, top: int = 64) -> dict:
+        """GET /debug/hbm source: what residency THINKS lives in HBM —
+        resident leaves grouped by (index, field, rep) with real padded
+        bytes and padding waste, non-row kinds (bsicmp masks, GroupBy
+        slabs, ...) by kind, plan-cache bytes, budget headroom and the
+        heat advisor's pin set — joined against the backend allocator's
+        memory_stats() when the backend provides it. `hbmDriftBytes` is
+        allocator live bytes minus accounted bytes: sustained growth
+        means device memory the accounting layer cannot see (leaked
+        handles, fragmentation, another tenant)."""
+        from pilosa_tpu.utils import telemetry as _telemetry
+        by_field: dict = {}
+        other: dict = {}
+        waste_by_rep = {"dense": 0, "sparse": 0, "run": 0}
+        for key, nbytes in self.residency.entries_snapshot():
+            kind = key[0] if isinstance(key, tuple) and key else "?"
+            rep = self._LEAF_KIND_REP.get(kind)
+            if rep is not None and len(key) >= 6:
+                g = by_field.setdefault(
+                    (key[1], key[2], rep),
+                    {"leaves": 0, "bytes": 0, "wasteBytes": 0})
+                g["leaves"] += 1
+                g["bytes"] += nbytes
+                try:
+                    w = self._leaf_waste(key, nbytes)
+                except Exception:  # noqa: BLE001 — schema churn mid-walk
+                    w = 0
+                g["wasteBytes"] += w
+                waste_by_rep[rep] += w
+            else:
+                o = other.setdefault(str(kind), {"entries": 0, "bytes": 0})
+                o["entries"] += 1
+                o["bytes"] += nbytes
+        fields = [
+            {"index": idx, "field": fld, "rep": rep, **g}
+            for (idx, fld, rep), g in by_field.items()]
+        fields.sort(key=lambda e: (-e["bytes"], e["index"], e["field"],
+                                   e["rep"]))
+        res = self.residency.snapshot()
+        pc = self.plan_cache.snapshot() if self.plan_cache is not None \
+            else None
+        accounted = res["bytes"] + (pc["bytes"] if pc else 0)
+        alloc = None
+        for dev in _telemetry.device_memory_stats():
+            ms = dev["memoryStats"]
+            if ms and "bytes_in_use" in ms:
+                if alloc is None:
+                    alloc = {"bytesInUse": 0, "bytesLimit": 0, "devices": 0}
+                alloc["bytesInUse"] += int(ms["bytes_in_use"])
+                alloc["bytesLimit"] += int(ms.get("bytes_limit", 0))
+                alloc["devices"] += 1
+        pins = []
+        if self.heat is not None and self.heat.enabled:
+            from pilosa_tpu.analysis import advisor as _advisor
+            try:
+                pins = _advisor.advise(
+                    self.heat.snapshot(top=0), residency=res,
+                    budget_bytes=self.residency.budget)["hbmPinSet"]
+            except Exception:  # noqa: BLE001 — advisory join only
+                pins = []
+        return {
+            "budgetBytes": self.residency.budget,
+            "residentBytes": res["bytes"],
+            "headroomBytes": max(0, self.residency.budget - res["bytes"]),
+            "entries": res["entries"],
+            "evictions": res["evictions"],
+            "planCacheBytes": pc["bytes"] if pc else 0,
+            "planCacheEntries": pc["entries"] if pc else 0,
+            "accountedBytes": accounted,
+            "allocator": alloc,
+            "hbmDriftBytes": (alloc["bytesInUse"] - accounted)
+            if alloc is not None else None,
+            "wasteByRep": waste_by_rep,
+            "byField": fields[:max(0, int(top))] if top else fields,
+            "byFieldTruncated": bool(top) and len(fields) > int(top),
+            "otherKinds": other,
+            "pinSet": pins,
+        }
+
+    # ------------------------------------------------------------- EXPLAIN
+
+    def explain_call(self, index: Index, call: Call, shards) -> dict:
+        """?explain=true: the planned tree — per-operand representation,
+        sizing statistics, predicted kernel family, per-leaf residency
+        state and estimated h2d bytes — WITHOUT dispatching a single
+        device program or mutating planner state. The walk mirrors
+        _compile's leaf discovery exactly; representation choices use
+        choose_representation's peek mode, so a subsequent execution of
+        the same query makes the same choices (pinned by the EXPLAIN
+        parity fuzz in tests/test_device_obs.py)."""
+        from pilosa_tpu import planner as _planner
+        from pilosa_tpu.constants import EXISTENCE_FIELD_NAME
+        from pilosa_tpu.utils.profile import truncate_pql
+        shards = self._query_shards(index, shards)
+        shards_t = tuple(shards)
+        info = None
+        planned = call
+        if self.planner is not None and call.name in _planner.PLANNED_CALLS:
+            planned, info = self.planner.plan_call(index, call, shards)
+        leaf_reps: list[str] = []
+
+        def probe_residency(field_name: str, view_name: str, row_id: int,
+                            gens: tuple) -> dict:
+            kinds = self._LEAF_KIND_REP
+
+            def match(key: tuple, need_gens: bool) -> bool:
+                return (isinstance(key, tuple) and len(key) >= 7
+                        and key[0] in kinds
+                        and key[1] == index.name and key[2] == field_name
+                        and key[3] == view_name and key[4] == row_id
+                        and key[5] == shards_t
+                        and (not need_gens or key[-1] == gens))
+
+            hit = self.residency.probe_where(lambda k: match(k, True))
+            if hit is not None:
+                return {"resident": True, "rep": kinds[hit[0][0]],
+                        "generationMatch": True, "bytes": hit[1]}
+            hit = self.residency.probe_where(lambda k: match(k, False))
+            if hit is not None:
+                # same row, stale generations: a write landed since the
+                # upload — the entry will never be hit again and ages out
+                return {"resident": True, "rep": kinds[hit[0][0]],
+                        "generationMatch": False, "bytes": hit[1]}
+            return {"resident": False, "rep": None,
+                    "generationMatch": False}
+
+        def est_bytes(rep: str, slots: int) -> int:
+            if rep == "sparse":
+                return len(shards) * slots * 4
+            if rep == "run":
+                return len(shards) * 2 * slots * 4
+            return len(shards) * WORDS * 4
+
+        def explain_row(field_name: str, row_id: int, c: Optional[Call],
+                        expr: str) -> dict:
+            stats: dict = {}
+            rep, slots, gens = _planner.choose_representation(
+                self, index, c, field_name, VIEW_STANDARD, shards, row_id,
+                peek=True, stats_out=stats)
+            leaf_reps.append(rep)
+            res = probe_residency(field_name, VIEW_STANDARD, row_id, gens)
+            return {
+                "kind": "row", "expr": expr, "field": field_name,
+                "rowId": row_id, "rep": rep, "slots": slots,
+                "maxShardCardinality": stats.get("maxShardCardinality"),
+                "runIntervals": stats.get("runIntervals"),
+                "residency": res,
+                "estimatedH2dBytes":
+                    0 if res["resident"] and res["generationMatch"]
+                    else est_bytes(rep, slots),
+            }
+
+        def row_leaf(c: Call) -> dict:
+            field_name = c.field_arg()
+            row_val = c.args[field_name]
+            f = index.field(field_name)
+            if f is None:
+                raise ExecutionError(f"field not found: {field_name}")
+            row_id = self._translate_row(index, f, row_val, create=False)
+            expr = truncate_pql(c.to_pql(), 96)
+            if row_id is None:
+                leaf_reps.append("dense")
+                return {"kind": "row", "expr": expr, "field": field_name,
+                        "rowId": None, "empty": True, "rep": "dense",
+                        "residency": {"resident": False, "rep": None,
+                                      "generationMatch": False},
+                        "estimatedH2dBytes": 0}
+            if f.options.type == FieldType.BOOL and isinstance(row_val,
+                                                               bool):
+                row_id = 1 if row_val else 0
+            return explain_row(field_name, row_id, c, expr)
+
+        def range_leaf(c: Call) -> dict:
+            expr = truncate_pql(c.to_pql(), 96)
+            if "_start" in c.args or "_end" in c.args:
+                field_name = c.field_arg()
+                f = index.field(field_name)
+                if f is None:
+                    raise ExecutionError(f"field not found: {field_name}")
+                # create=False: EXPLAIN must never mint row ids
+                row_id = self._translate_row(index, f, c.args[field_name],
+                                             create=False)
+                leaf_reps.append("dense")
+                if row_id is None:
+                    return {"kind": "timerange", "expr": expr,
+                            "field": field_name, "rowId": None,
+                            "empty": True, "rep": "dense",
+                            "residency": {"resident": False, "rep": None,
+                                          "generationMatch": False},
+                            "estimatedH2dBytes": 0}
+                start, end = c.args.get("_start"), c.args.get("_end")
+                if not isinstance(start, datetime) \
+                        or not isinstance(end, datetime):
+                    raise ExecutionError(
+                        "Range() requires start and end timestamps")
+                views = tuple(timequantum.views_by_time_range(
+                    VIEW_STANDARD, start, end, f.options.time_quantum))
+                gens = tuple(self._leaf_gens(index, field_name, v, shards,
+                                             row_id) for v in views)
+                key = ("timerange", index.name, field_name, row_id, views,
+                       shards_t, gens)
+                nbytes = self.residency.probe(key)
+                return {"kind": "timerange", "expr": expr,
+                        "field": field_name, "rowId": row_id,
+                        "views": len(views), "rep": "dense",
+                        "kernelFamily": "bitwise",
+                        "residency": {"resident": nbytes is not None,
+                                      "rep": "dense"
+                                      if nbytes is not None else None,
+                                      "generationMatch": nbytes is not None},
+                        "estimatedH2dBytes":
+                            0 if nbytes is not None
+                            else len(shards) * WORDS * 4}
+            cond_field, cond = None, None
+            for k, v in c.args.items():
+                if isinstance(v, Condition):
+                    cond_field, cond = k, v
+            if cond is None:
+                raise ExecutionError(
+                    "Range() requires a condition or time bounds")
+            f = self._bsi_field(index, cond_field)
+            depth = f.bit_depth
+            leaf_reps.append("dense")
+            gens = tuple(self._leaf_gens(index, cond_field, f.bsi_view_name,
+                                         shards, r)
+                         for r in range(depth + 1))
+            val = cond.value if not isinstance(cond.value, list) \
+                else tuple(cond.value)
+            key = ("bsicmp", index.name, cond_field, cond.op, val, depth,
+                   shards_t, gens)
+            nbytes = self.residency.probe(key)
+            return {"kind": "bsicmp", "expr": expr, "field": cond_field,
+                    "op": cond.op, "bitDepth": depth, "rep": "dense",
+                    "kernelFamily": "bsi", "composedOnDevice": True,
+                    "residency": {"resident": nbytes is not None,
+                                  "rep": "dense"
+                                  if nbytes is not None else None,
+                                  "generationMatch": nbytes is not None},
+                    # a miss re-composes from the BSI planes: depth+1
+                    # plane uploads when those are cold too (upper bound)
+                    "estimatedH2dBytes":
+                        0 if nbytes is not None
+                        else (depth + 1) * len(shards) * WORDS * 4}
+
+        def existence_leaf() -> dict:
+            if index.existence_field() is None:
+                raise ExecutionError(
+                    f"index {index.name} does not support existence "
+                    f"tracking")
+            return explain_row(EXISTENCE_FIELD_NAME, 0, None,
+                               f"Not() existence ({EXISTENCE_FIELD_NAME})")
+
+        def walk(c: Call) -> dict:
+            if c.name == "Row":
+                return row_leaf(c)
+            if c.name == "Range":
+                return range_leaf(c)
+            if c.name in ("Union", "Xor", "Intersect", "Difference"):
+                return {"kind": "op", "op": c.name,
+                        "children": [walk(ch) for ch in c.children]}
+            if c.name == "Not":
+                if len(c.children) != 1:
+                    raise ExecutionError("Not() takes exactly one argument")
+                return {"kind": "op", "op": "Not",
+                        "children": [existence_leaf(),
+                                     walk(c.children[0])]}
+            raise ExecutionError(f"expected bitmap call, got {c.name}")
+
+        doc: dict = {"call": call.name, "shards": len(shards),
+                     "planned": info is not None}
+        if info is not None:
+            doc["plan"] = info
+        if planned.name in _planner.BITMAP_CALLS:
+            doc["tree"] = walk(planned)
+        else:
+            operands = [walk(ch) for ch in planned.children
+                        if ch.name in _planner.BITMAP_CALLS]
+            if operands:
+                doc["tree"] = operands[0] if len(operands) == 1 \
+                    else {"kind": "op", "op": "operands",
+                          "children": operands}
+        # predicted kernel family per row leaf, decided tree-wide: an
+        # all-dense program takes the runner's fused path; any hybrid
+        # leaf routes evaluation through the sparse/run kernel families
+        all_dense = all(r == "dense" for r in leaf_reps)
+        fam_of = {"dense": "bitwise" if not all_dense else "program",
+                  "sparse": "sparse", "run": "run"}
+
+        def fill_family(node: dict) -> None:
+            if node.get("kind") == "op":
+                for ch in node.get("children", ()):
+                    fill_family(ch)
+            elif "kernelFamily" not in node and "rep" in node:
+                node["kernelFamily"] = fam_of.get(node["rep"], "bitwise")
+
+        if "tree" in doc:
+            fill_family(doc["tree"]
+                        if isinstance(doc["tree"], dict) else {})
+            est = 0
+
+            def sum_bytes(node: dict) -> None:
+                nonlocal est
+                if node.get("kind") == "op":
+                    for ch in node.get("children", ()):
+                        sum_bytes(ch)
+                else:
+                    est += int(node.get("estimatedH2dBytes") or 0)
+
+            sum_bytes(doc["tree"])
+            doc["estimatedH2dBytes"] = est
+        return doc
+
     def _compile(self, index: Index, call: Call, shards: list[int]):
         """Walk the call tree -> (program, leaves, kinds) where leaves are
         HBM-resident device arrays from the residency manager and kinds[i]

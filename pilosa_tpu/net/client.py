@@ -499,6 +499,15 @@ class InternalClient:
                             timeout=timeout)
         return json.loads(out) if out else {}
 
+    def debug_hbm(self, uri: str, timeout: Optional[float] = None) -> dict:
+        """One peer's HBM residency map (GET /debug/hbm?top=0 — the full
+        per-field breakdown, what the /cluster/hbm merge needs). Same
+        legacy contract as node_stats: a peer predating the route 404s
+        and the caller degrades it to "legacy"."""
+        out = self._request("GET", uri, "/debug/hbm?top=0",
+                            timeout=timeout)
+        return json.loads(out) if out else {}
+
     def translate_keys(self, uri: str, index: str, field: Optional[str],
                        keys: list[str], create: bool = True) -> list:
         out = self._json("POST", uri, "/internal/translate/keys",

@@ -66,6 +66,10 @@ class NodeCoalescer(ContinuousBatcher):
     # queue-wait share of the base-class accounting hook applies here
     ACCOUNT_DEVICE_MS = False
 
+    # an envelope is not a device dispatch — no kernel-family wait
+    # attribution (KernelStats tracks the device plane only)
+    KERNEL_FAMILY = None
+
     def __init__(self, client, window_s: float = 0.002, max_batch: int = 64,
                  legacy_ttl: float = 300.0, max_inflight: int = 2):
         super().__init__(max_batch=max_batch)

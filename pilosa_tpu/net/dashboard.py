@@ -153,6 +153,9 @@ const LOCAL_SERIES = [
   ["fence.fenced_shards", "read-fenced shards", fmtNum],
   ["fanout.queued", "fan-out queued", fmtNum],
   ["xla.compiles_per_s", "XLA compiles / s", fmtNum],
+  ["kernels.dispatches_per_s", "kernel dispatches / s", fmtNum],
+  ["kernels.avg_dispatch_ms", "kernel dispatch ms (window)", fmtNum],
+  ["device.hbm_bytes_in_use", "device HBM in use", fmtBytes],
   ["wal.bytes", "storage+WAL bytes", fmtBytes],
   ["process.rss_bytes", "process RSS", fmtBytes],
 ];

@@ -52,6 +52,9 @@ ROUTES: list[tuple[str, re.Pattern, str]] = [
     ("GET", re.compile(r"^/debug/vars$"), "get_debug_vars"),
     ("GET", re.compile(r"^/debug/usage$"), "get_debug_usage"),
     ("GET", re.compile(r"^/debug/heat$"), "get_debug_heat"),
+    ("GET", re.compile(r"^/debug/hbm$"), "get_debug_hbm"),
+    ("GET", re.compile(r"^/cluster/hbm$"), "get_cluster_hbm"),
+    ("POST", re.compile(r"^/debug/device-profile$"), "post_device_profile"),
     ("GET", re.compile(r"^/debug/query-history$"), "get_query_history"),
     ("GET", re.compile(r"^/debug/timeseries$"), "get_debug_timeseries"),
     ("GET", re.compile(r"^/debug/dashboard$"), "get_debug_dashboard"),
@@ -84,7 +87,7 @@ ROUTES: list[tuple[str, re.Pattern, str]] = [
 ALLOWED_QUERY_ARGS: dict[str, frozenset] = {
     "post_query": frozenset({"shards", "remote", "columnAttrs",
                              "excludeRowAttrs", "excludeColumns", "timeout",
-                             "profile"}),
+                             "profile", "explain"}),
     "get_export": frozenset({"index", "field", "shard"}),
     "get_fragment_blocks": frozenset({"index", "field", "view", "shard"}),
     "get_fragment_block_data": frozenset({"index", "field", "view", "shard",
@@ -97,6 +100,8 @@ ALLOWED_QUERY_ARGS: dict[str, frozenset] = {
     "get_debug_timeseries": frozenset({"since", "limit"}),
     "get_debug_usage": frozenset({"since", "limit", "top"}),
     "get_debug_heat": frozenset({"since", "limit", "top", "advice"}),
+    "get_debug_hbm": frozenset({"top"}),
+    "post_device_profile": frozenset({"seconds"}),
     "get_debug_events": frozenset({"since", "limit", "type", "severity"}),
     "get_cluster_events": frozenset({"since", "limit"}),
 }
@@ -460,6 +465,16 @@ class Handler:
             ex_cols = self._arg(query, "excludeColumns") in ("1", "true")
             want_profile = self._arg(query, "profile") in ("1", "true")
             pql = body.decode()
+            if self._arg(query, "explain") in ("1", "true"):
+                # ?explain=true: return the planned tree instead of
+                # executing — zero device dispatches (api.explain).
+                # JSON-only: the protobuf QueryResponse has no explain
+                # shape and legacy decoders would choke on one
+                if self._wants_proto():
+                    raise ApiError("explain=true requires a JSON response"
+                                   " (drop the protobuf Accept header)")
+                return self._json(self.api.explain(params["index"], pql,
+                                                   shards=shard_list))
         if self._wants_proto():
             results = self.api.query_results(params["index"], pql,
                                              shards=shard_list, remote=remote,
@@ -623,9 +638,29 @@ class Handler:
             pl = getattr(ex, "planner", None)
             if pl is not None:
                 snap["planner"] = pl.snapshot()
+                # EXPLAIN est-vs-actual calibration ring (planner.py
+                # CalibrationRing): recent estimate/result pairs and the
+                # aggregate relative-error stats
+                from pilosa_tpu import planner as _planner
+                snap["planner"]["calibration"] = \
+                    _planner.calibration.snapshot()
             pc = getattr(ex, "plan_cache", None)
             if pc is not None:
                 snap["planCache"] = pc.snapshot()
+            # HBM residency map (executor.hbm_snapshot): the compact
+            # summary rides the expvar dump; GET /debug/hbm carries the
+            # per-(index, field, rep) breakdown and the pin set
+            if hasattr(ex, "hbm_snapshot"):
+                try:
+                    hbm = ex.hbm_snapshot(top=0)
+                except Exception:  # noqa: BLE001 — never 500 the dump
+                    hbm = None
+                if hbm is not None:
+                    snap["hbm"] = {k: hbm[k] for k in
+                                   ("budgetBytes", "residentBytes",
+                                    "headroomBytes", "accountedBytes",
+                                    "planCacheBytes", "wasteByRep",
+                                    "allocator", "hbmDriftBytes")}
             # hybrid sparse/dense containers (parallel/residency.py
             # HybridManager): uploads and promote/demote transitions by
             # representation, plus live sparse/dense leaf occupancy —
@@ -712,7 +747,56 @@ class Handler:
         # per priority/reason/principal, the live wait estimate, mode
         if self.qos is not None:
             snap["qos"] = self.qos.snapshot()
+        # device kernel latency attribution (utils/telemetry.py
+        # KernelStats): per-(family, rep, arity) dispatch counts, log2
+        # latency histograms, batcher queue-wait split, h2d/d2h bytes
+        from pilosa_tpu.utils import telemetry as _telemetry
+        snap["kernels"] = _telemetry.kernels.snapshot()
+        # on-demand XLA profile capture state (POST /debug/device-profile)
+        snap["deviceProfiler"] = _telemetry.device_profiler.snapshot()
         return self._json(snap)
+
+    def get_debug_hbm(self, params, query, body):
+        """HBM residency map (executor.hbm_snapshot): what the residency
+        accounting says lives in device memory — resident leaves by
+        (index, field, representation) at real padded byte cost with
+        per-rep padding waste, non-row kinds by kind, plan-cache bytes,
+        budget headroom and the heat advisor's pin set — joined against
+        the backend allocator's memory_stats() with the accounted-vs-
+        allocator drift called out (`hbmDriftBytes`). `?top=` bounds the
+        per-field list (default 64, 0 = all)."""
+        ex = getattr(self.api, "executor", None)
+        if ex is None or not hasattr(ex, "hbm_snapshot"):
+            raise ApiError("hbm map not supported", status=501)
+        try:
+            top = int(self._arg(query, "top", "64"))
+        except ValueError:
+            raise ApiError("top must be an integer")
+        return self._json(ex.hbm_snapshot(top=top))
+
+    def get_cluster_hbm(self, params, query, body):
+        """The fleet's HBM residency maps: every live peer's /debug/hbm
+        document collected over the persistent fan-out pool
+        (Server.cluster_hbm — legacy peers that 404 the route degrade to
+        "legacy", never an error)."""
+        if self.api.cluster_hbm_fn is None:
+            raise ApiError("cluster hbm not supported", status=501)
+        return self._json(self.api.cluster_hbm_fn())
+
+    def post_device_profile(self, params, query, body):
+        """On-demand XLA profile capture (utils/telemetry.py
+        DeviceProfiler): wraps ?seconds= of live traffic in
+        jax.profiler.trace into a byte-capped spool dir and returns the
+        capture path. Never blocks serving — a concurrent capture
+        answers "busy", the PILOSA_TPU_DEVICE_PROFILE=0 kill switch
+        answers "disabled"; both are 409/403-free 200s so operator
+        tooling can poll without special-casing."""
+        from pilosa_tpu.utils import telemetry as _telemetry
+        try:
+            seconds = float(self._arg(query, "seconds", "2"))
+        except ValueError:
+            raise ApiError("seconds must be a number")
+        return self._json(_telemetry.device_profiler.capture(seconds))
 
     def get_query_history(self, params, query, body):
         """Structured slow-query history (the SLOW QUERY printf grown into
@@ -1079,6 +1163,57 @@ class Handler:
             counts[f"xlaCompiles/{fam}"] = f["compiles"]
             counts[f"xlaCachedDispatches/{fam}"] = f["cached"]
         counts["xlaRecompileStorms"] = xs["storms"]
+        # device kernel attribution families: the FULL registered
+        # (family, rep) keyspace from the import-free inventory
+        # (constants.KERNEL_FAMILY_REPS) emitted unconditionally (zeros
+        # included) like the planner families, so a "sparse kernels
+        # stalled" alert never races the first dispatch; live series
+        # (including the timing histograms) overlay the zero floor
+        from pilosa_tpu.constants import KERNEL_FAMILY_REPS
+        for fam, rep in sorted(KERNEL_FAMILY_REPS.items()):
+            counts.setdefault(f"kernelsDispatches/{fam},rep:{rep}", 0)
+            counts.setdefault(f"kernelsWaitMs/{fam},rep:{rep}", 0)
+            counts.setdefault(f"kernelsWaited/{fam},rep:{rep}", 0)
+            counts.setdefault(f"kernelsH2dBytes/{fam},rep:{rep}", 0)
+            counts.setdefault(f"kernelsD2hBytes/{fam},rep:{rep}", 0)
+        kcounts, ktimings = _telemetry.kernels.metrics_view()
+        counts.update(kcounts)
+        timings = dict(snap.get("timings", {}))
+        timings.update(ktimings)
+        # HBM residency families: accounted bytes per representation
+        # (zeros, plan cache and drift included) — the full rep keyspace
+        # emitted unconditionally so headroom/drift alerts need no
+        # family bootstrap. rep labels follow the residency kind map.
+        hbm_rep_of = {"row": "dense", "sparse": "sparse", "run": "run"}
+        for rep in ("dense", "sparse", "run", "other"):
+            gauges.setdefault(f"hbmResidentBytes,rep:{rep}", 0.0)
+            gauges.setdefault(f"hbmResidentEntries,rep:{rep}", 0.0)
+        if res is not None:
+            rs2 = res.snapshot()
+            for kind, e in rs2.get("by_kind", {}).items():
+                rep = hbm_rep_of.get(kind, "other")
+                gauges[f"hbmResidentBytes,rep:{rep}"] += float(e["bytes"])
+                gauges[f"hbmResidentEntries,rep:{rep}"] += \
+                    float(e["entries"])
+            pc2 = getattr(ex, "plan_cache", None)
+            pc_bytes = pc2.snapshot()["bytes"] if pc2 is not None else 0
+            accounted = rs2["bytes"] + pc_bytes
+            gauges["hbmPlanCacheBytes"] = float(pc_bytes)
+            gauges["hbmBudgetBytes"] = float(res.budget)
+            gauges["hbmHeadroomBytes"] = float(
+                max(0, res.budget - rs2["bytes"]))
+            drift = 0.0
+            for dev in _telemetry.device_memory_stats():
+                ms = dev["memoryStats"]
+                if ms and "bytes_in_use" in ms:
+                    drift = float(int(ms["bytes_in_use"]) - accounted)
+                    break
+            gauges["hbmDriftBytes"] = drift
+        else:
+            gauges.setdefault("hbmPlanCacheBytes", 0.0)
+            gauges.setdefault("hbmBudgetBytes", 0.0)
+            gauges.setdefault("hbmHeadroomBytes", 0.0)
+            gauges.setdefault("hbmDriftBytes", 0.0)
         # per-principal usage + SLO burn-rate families: emitted
         # unconditionally (zeros included) like the planner families, so
         # scrapers can alert on "a principal's spend spiked" / "an SLO is
@@ -1145,7 +1280,7 @@ class Handler:
                                         "red": 2.0}.get(score, 1.0)
             except Exception:  # noqa: BLE001
                 pass  # scrape must never 500 on a health-input failure
-        snap = dict(snap, counts=counts, gauges=gauges)
+        snap = dict(snap, counts=counts, gauges=gauges, timings=timings)
         body_out = prometheus_exposition(snap)
         return (200, "text/plain; version=0.0.4; charset=utf-8",
                 body_out.encode())

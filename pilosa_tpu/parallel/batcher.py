@@ -135,6 +135,12 @@ class ContinuousBatcher:
     # the per-principal device attribution admission control acts on)
     ACCOUNT_DEVICE_MS = True
 
+    # kernel family this batcher's queue wait is attributed to in the
+    # KernelStats dispatch-vs-wait split (utils/telemetry.py; must be a
+    # registered family, constants.KERNEL_FAMILY_REPS). None = the
+    # batches are not device dispatches (NodeCoalescer's HTTP envelopes)
+    KERNEL_FAMILY: Optional[str] = "batcher"
+
     # whether leadership hands off at the CUT (before dispatch) or after
     # the batch completes. At-cut is right for read dispatches: the next
     # leader's admission overlaps this batch's device round trip. The
@@ -329,14 +335,23 @@ class ContinuousBatcher:
                     f"batcher _compute returned {len(results)} results "
                     f"for {len(batch)} payloads (key={key[:1]})")
             t_done = time.perf_counter()
+            batch_wait_ms = sum(
+                (t_done - r.t_submit) * 1e3 for r in batch)
             with self._lock:
                 self.batches += 1
                 self.batched_queries += len(batch)
                 self.max_batch_seen = max(self.max_batch_seen, len(batch))
-                self.wait_ms_total += sum(
-                    (t_done - r.t_submit) * 1e3 for r in batch)
+                self.wait_ms_total += batch_wait_ms
                 self.waited += len(batch)
                 seq = self.batches
+            if self.KERNEL_FAMILY is not None:
+                # per-family queue-wait attribution: the batcher-side
+                # half of KernelStats' dispatch-vs-wait split (the
+                # dispatch half is timed inside counted_jit)
+                from pilosa_tpu.utils import telemetry as _telemetry
+                if _telemetry.kernel_stats_enabled():
+                    _telemetry.kernels.record_wait(
+                        self.KERNEL_FAMILY, batch_wait_ms, len(batch))
             if t_cut is not None:
                 wall_ms = (t_done - t_cut) * 1e3
                 share_ms = wall_ms / max(1, len(batch))

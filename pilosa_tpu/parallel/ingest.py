@@ -83,6 +83,10 @@ class IngestBatcher(ContinuousBatcher):
     # poison the per-principal device attribution, same as NodeCoalescer
     ACCOUNT_DEVICE_MS = False
 
+    # queue wait attributes to the ingest kernel family: the patch
+    # kernels this batcher dispatches are counted there
+    KERNEL_FAMILY = "ingest"
+
     # hold leadership THROUGH the apply: group commit is self-clocked by
     # arrivals accumulating behind the in-flight apply, which only
     # happens if the key stays led for its duration (see base class)

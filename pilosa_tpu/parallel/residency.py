@@ -222,6 +222,35 @@ class DeviceResidency:
                 self._lru.move_to_end(key)
             return arr
 
+    def probe(self, key: tuple) -> Optional[int]:
+        """Resident byte size for `key`, or None — no hit/miss accounting
+        AND no LRU touch: the EXPLAIN residency probe must observe the
+        cache without perturbing eviction order (a query that is only
+        being explained never reads the entry)."""
+        with self._lock:
+            arr = self._lru.get(key)
+            return None if arr is None else arr.nbytes
+
+    def probe_where(self, pred: Callable[[tuple], bool]) -> Optional[tuple]:
+        """First (key, nbytes) whose key satisfies `pred`, or None — the
+        EXPLAIN stale-generation probe (same key prefix, different
+        generation tuple). Read-only like probe(): no accounting, no LRU
+        reorder. O(entries) under the lock; EXPLAIN is not a hot path."""
+        with self._lock:
+            for key, arr in self._lru.items():
+                try:
+                    if pred(key):
+                        return key, arr.nbytes
+                except Exception:  # noqa: BLE001 — a malformed key must
+                    continue  # not break the walk
+            return None
+
+    def entries_snapshot(self) -> list[tuple]:
+        """[(key, nbytes)] of every resident entry — the GET /debug/hbm
+        walk's raw material (aggregation happens outside the lock)."""
+        with self._lock:
+            return [(key, arr.nbytes) for key, arr in self._lru.items()]
+
     def clear(self) -> None:
         with self._lock:
             self._lru.clear()
@@ -404,17 +433,23 @@ class HybridManager:
                 self._rep.popitem(last=False)
 
     def choose(self, row_key: tuple, max_card: int,
-               frag_keys=None, run_stats=None) -> tuple[str, int]:
+               frag_keys=None, run_stats=None,
+               peek: bool = False) -> tuple[str, int]:
         """(representation, padded slots) for one row leaf whose largest
         per-shard cardinality is `max_card` (hysteresis: _transition).
         Slots are interval-pair slots for "run" (padded from the interval
-        count), index slots for "sparse", 0 for "dense"."""
+        count), index slots for "sparse", 0 for "dense". `peek=True`
+        skips the hysteresis-memory update: EXPLAIN must report the exact
+        choice the executor will make next WITHOUT advancing the state
+        that choice depends on (the transition rule is a pure function of
+        (prev, stats), so peek-then-choose returns the same rep)."""
         if not self.active():
             return "dense", 0
         with self._lock:
             prev = self._rep.get(row_key)
         rep = self._transition(prev, max_card, frag_keys, run_stats)
-        self._remember(row_key, prev, rep)
+        if not peek:
+            self._remember(row_key, prev, rep)
         if rep == "run":
             n_iv = 1 if run_stats is None else int(run_stats[0])
             return rep, self.pad_slots(max(n_iv, 1))
@@ -449,6 +484,13 @@ class HybridManager:
             else:
                 self.dense_uploads += 1
                 self.dense_bytes_uploaded += int(nbytes)
+        # h2d byte attribution per kernel family (utils/telemetry.py
+        # KernelStats): leaf uploads are the dominant host->device
+        # traffic, charged to the family that consumes the representation
+        from pilosa_tpu.utils import telemetry as _telemetry
+        if _telemetry.kernel_stats_enabled():
+            fam = {"sparse": "sparse", "run": "run"}.get(rep, "bitwise")
+            _telemetry.kernels.record_bytes(fam, h2d=int(nbytes))
 
     def record_materialize(self) -> None:
         with self._lock:

@@ -57,6 +57,7 @@ from __future__ import annotations
 
 import contextvars
 import threading
+import time
 from datetime import datetime
 from typing import NamedTuple, Optional
 
@@ -520,7 +521,9 @@ def record_cache_event(call: Call, hit: bool) -> None:
 
 def choose_representation(executor, index, call: Optional[Call],
                           field_name: str, view_name: str, shards,
-                          row_id: int) -> tuple[str, int, tuple]:
+                          row_id: int, peek: bool = False,
+                          stats_out: Optional[dict] = None
+                          ) -> tuple[str, int, tuple]:
     """The planner's per-operand container decision (the hybrid
     sparse/dense tentpole): from the same exact write-maintained
     cardinalities the reorder pass reads (storage/fragment.py
@@ -534,11 +537,19 @@ def choose_representation(executor, index, call: Optional[Call],
     Returns (rep, padded slots, per-shard generations) — the generations
     ride along because both the decision and the residency key need them
     and the per-shard scan should run once. Hysteresis/heat state lives
-    in the executor's HybridManager (parallel/residency.py)."""
+    in the executor's HybridManager (parallel/residency.py).
+
+    `peek=True` is the EXPLAIN mode: the exact same decision WITHOUT
+    advancing the hysteresis memory (HybridManager.choose peek), so
+    explain-then-execute reports and then uses the same representation.
+    `stats_out`, when given, receives the sizing statistics the decision
+    read (maxShardCardinality, runIntervals) for the explain tree."""
     gens = executor._leaf_gens(index, field_name, view_name, shards,
                                row_id)
     hyb = getattr(executor, "hybrid", None)
     if hyb is None or not hyb.active():
+        if stats_out is not None:
+            stats_out.update(maxShardCardinality=None, runIntervals=None)
         return "dense", 0, gens
     f = index.field(field_name)
     view = f.view(view_name) if f is not None else None
@@ -569,7 +580,11 @@ def choose_representation(executor, index, call: Optional[Call],
     rep, slots = hyb.choose(
         (index.name, field_name, view_name, row_id), max_card,
         frag_keys=[(index.name, field_name, view_name, s) for s in shards],
-        run_stats=run_stats)
+        run_stats=run_stats, peek=peek)
+    if stats_out is not None:
+        stats_out.update(
+            maxShardCardinality=int(max_card),
+            runIntervals=int(run_stats[0]) if run_stats else 0)
     plan = current_plan.get()
     if plan is not None and call is not None:
         reps = plan.setdefault("hybrid", [])
@@ -580,3 +595,115 @@ def choose_representation(executor, index, call: Optional[Call],
                          "runIntervals":
                              int(run_stats[0]) if run_stats else 0})
     return rep, slots, gens
+
+
+# --------------------------------------------------------- calibration ring
+
+
+class CalibrationRing:
+    """Est-vs-actual cost-model calibration (`planner.calibration`).
+
+    Every executed PROFILED query feeds one entry per planned call
+    (api.query_results): the planner's cardinality estimate for the call
+    next to the count the execution actually returned, plus the query's
+    real host->device bytes. EXPLAIN predicts from the same estimates,
+    so drift visible here is drift in everything the planner decides —
+    operand order, short circuits, representation sizing — surfaced
+    BEFORE it misplans badly enough to show up as latency. Snapshot
+    rides /debug/vars `planner.calibration`; the aggregate mean absolute
+    relative error is the one number to watch (docs/operations.md
+    "Device observability" → calibration tuning)."""
+
+    def __init__(self, size: int = 256):
+        import collections
+        self._lock = threading.Lock()
+        self._buf: "collections.deque[dict]" = collections.deque(
+            maxlen=max(1, int(size)))
+        self.recorded = 0
+        self.compared = 0          # entries where est AND actual exist
+        self.abs_rel_err_sum = 0.0
+        self.max_abs_rel_err = 0.0
+
+    def record(self, entry: dict) -> None:
+        est, actual = entry.get("est"), entry.get("actual")
+        if est is not None and actual is not None:
+            # relative error against the actual (floor 1 so exact-zero
+            # actuals don't divide out): >0 = overestimate
+            err = (float(est) - float(actual)) / max(float(actual), 1.0)
+            entry = dict(entry, relErr=round(err, 4))
+        with self._lock:
+            self._buf.append(entry)
+            self.recorded += 1
+            if "relErr" in entry:
+                self.compared += 1
+                a = abs(entry["relErr"])
+                self.abs_rel_err_sum += a
+                self.max_abs_rel_err = max(self.max_abs_rel_err, a)
+
+    def snapshot(self, limit: int = 32) -> dict:
+        with self._lock:
+            # limit=0 is summary-only (the EXPLAIN response rides the
+            # aggregates; /debug/vars carries the recent entries)
+            entries = list(self._buf)[-int(limit):] if limit > 0 else []
+            return {
+                "size": self._buf.maxlen,
+                "recorded": self.recorded,
+                "compared": self.compared,
+                "meanAbsRelErr": round(
+                    self.abs_rel_err_sum / self.compared, 4)
+                if self.compared else None,
+                "maxAbsRelErr": round(self.max_abs_rel_err, 4)
+                if self.compared else None,
+                "entries": list(reversed(entries)),
+            }
+
+    def reset(self) -> None:
+        with self._lock:
+            self._buf.clear()
+            self.recorded = 0
+            self.compared = 0
+            self.abs_rel_err_sum = 0.0
+            self.max_abs_rel_err = 0.0
+
+
+# process-global, like executor counters: one ring per process — remote
+# sub-requests calibrate on their own nodes
+calibration = CalibrationRing()
+
+
+def record_calibration(prof, calls, results) -> None:
+    """Feed the calibration ring from one executed profiled query:
+    pairs each plan node the profiler captured (prof.plans, appended in
+    call order for planned calls only) with the call's actual result.
+    Scalar results (Count / pushdown counts) calibrate the cardinality
+    estimate directly; other result shapes record the estimate alone so
+    the ring still shows what the planner believed. Never raises — the
+    feed rides api.query_results' finally block."""
+    try:
+        plans = list(prof.plans)
+        if not plans:
+            return
+        planned = [(c, r) for c, r in zip(calls, results)
+                   if c.name in PLANNED_CALLS]
+        h2d = int(prof.h2d_bytes)
+        for plan, (call, result) in zip(plans, planned):
+            ests = plan.get("estimates") or []
+            est = ests[0].get("est") if ests else None
+            actual = None
+            if isinstance(result, bool):
+                actual = None
+            elif isinstance(result, (int, float)):
+                actual = int(result)
+            calibration.record({
+                "ts": round(time.time(), 3),  # wall-clock: export ts
+                "call": plan.get("call"),
+                "expr": ests[0].get("expr") if ests else None,
+                "exact": ests[0].get("exact") if ests else None,
+                "est": est,
+                "actual": actual,
+                "h2dBytes": h2d,
+                "elapsedMs": prof.elapsed_ms or None,
+            })
+            h2d = 0  # query-level bytes ride the first entry only
+    except Exception:  # noqa: BLE001 — calibration must never break a
+        pass  # query's response path

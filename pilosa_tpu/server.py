@@ -405,6 +405,7 @@ class Server:
         self.api.cluster_usage_fn = self.cluster_usage
         self.api.cluster_heat_fn = self.cluster_heat
         self.api.cluster_events_fn = self.cluster_events
+        self.api.cluster_hbm_fn = self.cluster_hbm
         # last health score seen by the sampler: a change emits a
         # health.transition event onto the timeline
         self._last_health: Optional[str] = None
@@ -1417,12 +1418,17 @@ class Server:
                 "" if complete else " — anti-entropy will finish the heal")
         return replayed, dropped, complete
 
-    def _xla_storm_event(self, family: str, new_keys: int) -> None:
+    def _xla_storm_event(self, family: str, new_keys: int,
+                         sig_diff=None) -> None:
         """XLACounters storm hook: a recompile storm is a health incident
-        the merged timeline must show (utils/telemetry.py)."""
+        the merged timeline must show (utils/telemetry.py). `sig_diff`
+        is the old-vs-new dispatch signature diff — the leaf whose
+        shape/dtype churned — so the timeline entry is actionable."""
         try:
-            self.events.emit("xla.recompile_storm", family=family,
-                             newShapes=int(new_keys))
+            payload = {"family": family, "newShapes": int(new_keys)}
+            if sig_diff:
+                payload["signatureDiff"] = sig_diff
+            self.events.emit("xla.recompile_storm", **payload)
         except Exception:  # noqa: BLE001 — recording must never break
             pass  # the dispatch path that tripped the storm
 
@@ -2261,9 +2267,20 @@ class Server:
             ms = dev["memoryStats"]
             if ms and "bytes_in_use" in ms:
                 # first device with a reporting backend (TPU HBM);
-                # CPU backends return null stats and are skipped
+                # CPU backends return null stats and are skipped —
+                # the dashboard's HBM sparkline degrades to absent
                 g["device.bytes_in_use"] = float(ms["bytes_in_use"])
+                g["device.hbm_bytes_in_use"] = float(ms["bytes_in_use"])
+                g["device.hbm_limit"] = float(ms.get("bytes_limit", 0))
                 break
+        # device kernel attribution (telemetry.KernelStats): dispatch and
+        # h2d throughput plus windowed per-dispatch wall / queue-wait
+        ks = _telemetry.kernels.totals()
+        raw["kernels.dispatches"] = ks["dispatches"]
+        raw["kernels.dispatch_ms"] = ks["dispatch_ms_total"]
+        raw["kernels.wait_ms"] = ks["wait_ms_total"]
+        raw["kernels.waited"] = ks["waited"]
+        raw["kernels.h2d_bytes"] = ks["h2d_bytes"]
 
         prev, prev_t = self._telemetry_prev
         dt = max(1e-9, now - prev_t)
@@ -2342,6 +2359,24 @@ class Server:
         g["hybrid.run_share"] = self._last_hybrid_run_share
         g["http.errors_per_s"] = rate("http.errors")
         g["xla.compiles_per_s"] = rate("xla.compiles")
+        g["kernels.dispatches_per_s"] = rate("kernels.dispatches")
+        g["kernels.h2d_bytes_per_s"] = rate("kernels.h2d_bytes")
+        # windowed per-dispatch dispatch wall and per-request queue wait
+        # (dashboard sparklines): delta-over-delta, same discipline as
+        # batcher.avg_wait_ms above
+        g["kernels.avg_dispatch_ms"] = 0.0
+        g["kernels.avg_wait_ms"] = 0.0
+        if prev is not None:
+            dd = raw["kernels.dispatches"] - prev.get(
+                "kernels.dispatches", 0)
+            dms = raw["kernels.dispatch_ms"] - prev.get(
+                "kernels.dispatch_ms", 0.0)
+            if dd > 0:
+                g["kernels.avg_dispatch_ms"] = max(0.0, dms) / dd
+            dw = raw["kernels.waited"] - prev.get("kernels.waited", 0)
+            dwm = raw["kernels.wait_ms"] - prev.get("kernels.wait_ms", 0.0)
+            if dw > 0:
+                g["kernels.avg_wait_ms"] = max(0.0, dwm) / dw
         g["usage.queries_per_s"] = rate("usage.queries")
         g["usage.device_ms_per_s"] = rate("usage.device_ms")
         g["usage.rpc_bytes_per_s"] = rate("usage.rpc_bytes")
@@ -2610,6 +2645,63 @@ class Server:
             "principals": ordered,
             "totals": totals,
             "spilledPrincipals": spilled,
+            "nodes": nodes,
+            "generatedBy": self.node_id,
+            "asOf": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        }
+
+    def cluster_hbm(self) -> dict:
+        """The fleet's HBM residency maps (GET /cluster/hbm): every live
+        peer's /debug/hbm document collected concurrently, with fleet
+        byte totals summed across nodes — "what is resident where, and
+        how much headroom is left" from any node. Same degradation
+        contract as cluster_stats: peers that 404 the route are "legacy"
+        (never an error), down peers are skipped without an RPC,
+        transient fetch failures leave the merge partial-but-honest."""
+        docs: dict[str, dict] = {}
+        nodes: list[dict] = []
+        timeout = max(2.0, self.probe_timeout)
+        fetchers: list[tuple] = []
+        for n in list(self.cluster.nodes):
+            if n.id == self.node_id:
+                docs[n.id] = self.executor.hbm_snapshot()
+                nodes.append({"id": n.id, "uri": self.uri, "status": "ok"})
+                continue
+            if self.cluster.is_down(n.id) or not n.uri:
+                nodes.append({"id": n.id, "uri": n.uri or "",
+                              "status": "down"})
+                continue
+            entry = {"id": n.id, "uri": n.uri, "status": "pending"}
+            nodes.append(entry)
+
+            def fetch(node=n, entry=entry):
+                try:
+                    docs[node.id] = self.client.debug_hbm(node.uri, timeout)
+                    entry["status"] = "ok"
+                except ClientError as e:
+                    entry["status"] = ("legacy" if e.status == 404
+                                       else "error")
+                except Exception:  # noqa: BLE001 — never fail the merge
+                    entry["status"] = "error"
+
+            fetchers.append((entry, _threads.spawn(fetch)))
+        for entry, t in fetchers:
+            t.join(timeout + 1.0)
+            if entry["status"] == "pending":
+                entry["status"] = "error"
+        totals = {"residentBytes": 0, "budgetBytes": 0, "headroomBytes": 0,
+                  "planCacheBytes": 0, "entries": 0}
+        drift = None
+        for doc in docs.values():
+            for f in totals:
+                totals[f] += int(doc.get(f, 0) or 0)
+            d = doc.get("hbmDriftBytes")
+            if d is not None:
+                drift = (drift or 0) + int(d)
+        return {
+            "byNode": docs,
+            "totals": totals,
+            "hbmDriftBytes": drift,
             "nodes": nodes,
             "generatedBy": self.node_id,
             "asOf": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),

@@ -212,3 +212,17 @@ def test_mesh_from_config_variants():
         mesh_from_config(devices="bogus")
     with pytest.raises(ValueError, match="available"):
         mesh_from_config(devices="999")
+
+
+def test_profile_capture_cli(live_server, capsys, monkeypatch):
+    """pilosa-tpu profile-capture drives POST /debug/device-profile:
+    a capture round-trips (CPU backends trace too), --json emits the raw
+    doc, and the kill switch surfaces as a non-zero exit."""
+    assert main(["profile-capture", "--host", live_server,
+                 "--seconds", "0.05"]) == 0
+    out = capsys.readouterr().out
+    assert "captured" in out and "tensorboard --logdir" in out
+    assert main(["profile-capture", "--host", live_server,
+                 "--seconds", "0.05", "--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["status"] == "ok" and doc["captures"] >= 2

@@ -1,0 +1,646 @@
+"""Device observability plane (ISSUE 18).
+
+Four surfaces under test:
+
+* kernel latency attribution (utils/telemetry.py KernelStats +
+  counted_jit timing): per-(family, rep, arity) histograms, the
+  dispatch-vs-wait split, byte attribution, the tracer-nesting
+  no-double-book contract, recompile-storm signature diffs;
+* the HBM residency map (executor.hbm_snapshot + /debug/hbm +
+  /cluster/hbm federation): byte-exact accounting against the residency
+  LRU, per-rep padding waste, legacy-peer degradation;
+* on-demand device profile capture (DeviceProfiler): kill switch,
+  single-flight busy contract, spool byte cap;
+* PQL EXPLAIN (executor.explain_call + api.explain): the parity fuzz —
+  explain-then-execute makes the representation choices EXPLAIN
+  predicted, with zero device dispatches counter-asserted — plus the
+  planner calibration ring and the kernel-family lint rule.
+"""
+
+import json
+import os
+import urllib.request
+
+import numpy as np
+import pytest
+
+from pilosa_tpu.analysis.lint import lint_source
+from pilosa_tpu.constants import KERNEL_FAMILY_REPS, SHARD_WIDTH
+from pilosa_tpu.executor import Executor
+from pilosa_tpu.models.holder import Holder
+from pilosa_tpu.pql.parser import parse_string
+from pilosa_tpu.utils import telemetry as T
+
+W = SHARD_WIDTH // 32
+
+
+def _dispatch_counts():
+    """(device programs entered, kernel-stats dispatches) — the two
+    counters EXPLAIN must leave untouched."""
+    x = T.xla.snapshot()
+    return (x["cachedDispatches"] + x["compiles"],
+            T.kernels.totals()["dispatches"])
+
+
+# ------------------------------------------------------- KernelStats unit
+
+
+def test_kernel_stats_records_and_snapshots():
+    ks = T.KernelStats()
+    ks.record_call("bitwise", "dense", 3, ms=1.5, h2d_bytes=256)
+    ks.record_call("bitwise", "dense", 3, ms=0.5)
+    ks.record_call("sparse", "sparse", 2)          # counted, untimed
+    ks.record_wait("batcher", 12.0, n=4)
+    ks.record_bytes("sparse", h2d=1024, d2h=64)
+    t = ks.totals()
+    assert t["dispatches"] == 3
+    assert t["dispatch_ms_total"] == pytest.approx(2.0)
+    assert t["wait_ms_total"] == pytest.approx(12.0) and t["waited"] == 4
+    assert t["h2d_bytes"] == 256 + 1024 and t["d2h_bytes"] == 64
+    snap = ks.snapshot()
+    assert snap["dispatches"] == 3
+    by_key = {(c["family"], c["rep"], c["arity"]): c
+              for c in snap["calls"]}
+    c = by_key[("bitwise", "dense", 3)]
+    assert c["dispatches"] == 2 and c["timed"] == 2
+    assert c["minMs"] == 0.5 and c["maxMs"] == 1.5
+    assert sum(c["buckets"].values()) == 2
+    c = by_key[("sparse", "sparse", 2)]
+    assert c["dispatches"] == 1 and c["timed"] == 0 and c["minMs"] is None
+    assert snap["wait"]["batcher"]["avgMs"] == pytest.approx(3.0)
+    ks.reset()
+    assert ks.totals()["dispatches"] == 0
+    assert ks.snapshot()["calls"] == []
+
+
+def test_kernel_stats_metrics_view_key_syntax():
+    """metrics_view emits StatsClient-syntax keys with the rep tag —
+    the exact series /metrics zero-fills, so the syntax IS the contract."""
+    ks = T.KernelStats()
+    ks.record_call("bitwise", "dense", 2, ms=1.0)
+    ks.record_call("sparse", "sparse", 2, ms=4.0)
+    ks.record_wait("batcher", 6.0, n=2)
+    ks.record_bytes("run", h2d=128)
+    counts, timings = ks.metrics_view()
+    assert counts["kernelsDispatches/bitwise,rep:dense"] == 1
+    assert counts["kernelsWaited/batcher,rep:dense"] == 2
+    assert counts["kernelsH2dBytes/run,rep:run"] == 128
+    tk = timings["kernelDispatchMs/sparse,rep:sparse"]
+    assert tk["count"] == 1 and tk["sum"] == pytest.approx(4.0)
+    assert tk["buckets"]  # log2 buckets render as a histogram
+
+
+def test_kernel_rep_follows_inventory():
+    assert T.kernel_rep("sparse") == "sparse"
+    assert T.kernel_rep("run") == "run"
+    assert T.kernel_rep("bitwise") == "dense"
+    assert T.kernel_rep("never-registered") == "dense"
+    # every registered family maps to a rep the metrics zero-fill knows
+    assert set(KERNEL_FAMILY_REPS.values()) <= {"dense", "sparse", "run"}
+
+
+# --------------------------------------------- counted_jit timing contract
+
+
+def test_counted_jit_times_direct_calls():
+    import jax.numpy as jnp
+    before = T.kernels.snapshot()
+    prior = {(c["family"], c["rep"], c["arity"]): c["dispatches"]
+             for c in before["calls"]}
+
+    @T.counted_jit("bitwise")
+    def k(a, b):
+        return a & b
+
+    x = np.full((1, 4), 7, dtype=np.uint32)
+    k(jnp.asarray(x), jnp.asarray(x))
+    k(jnp.asarray(x), jnp.asarray(x))
+    after = T.kernels.snapshot()
+    cur = {(c["family"], c["rep"], c["arity"]): c
+           for c in after["calls"]}
+    c = cur[("bitwise", "dense", 2)]
+    assert c["dispatches"] - prior.get(("bitwise", "dense", 2), 0) == 2
+    assert c["timed"] >= 2 and c["msTotal"] > 0
+
+
+def test_counted_jit_host_array_books_h2d_bytes():
+    before = T.kernels.snapshot()["bytes"].get("bitwise", {}).get("h2d", 0)
+
+    @T.counted_jit("bitwise")
+    def k(a):
+        return a | a
+
+    host = np.zeros((2, W), dtype=np.uint32)
+    k(host)  # a host ndarray crosses the h2d link at dispatch
+    after = T.kernels.snapshot()["bytes"]["bitwise"]["h2d"]
+    assert after - before >= host.nbytes
+
+
+def test_counted_jit_no_double_booking_under_tracer_nesting():
+    """A counted_jit kernel called from inside another jit sees tracer
+    arguments and must record NOTHING — the outer dispatch is the one
+    real device program."""
+    import jax
+    import jax.numpy as jnp
+
+    @T.counted_jit("bitwise")
+    def inner(a):
+        return a ^ a
+
+    @jax.jit
+    def outer(a):
+        return inner(inner(a))
+
+    arr = jnp.zeros((1, 4), dtype=jnp.uint32)
+    outer(arr)  # compile: inner traces twice, must not book
+    d0, k0 = _dispatch_counts()
+    outer(arr)
+    outer(arr)
+    d1, k1 = _dispatch_counts()
+    assert k1 - k0 == 0  # zero kernel-stats entries from nested calls
+    assert d1 - d0 == 0  # and no per-family xla bookings either
+
+
+def test_kernel_stats_kill_switch(monkeypatch):
+    import jax.numpy as jnp
+    monkeypatch.setenv("PILOSA_TPU_KERNEL_STATS", "0")
+    assert not T.kernel_stats_enabled()
+
+    @T.counted_jit("bitwise")
+    def k(a):
+        return ~a
+
+    k0 = T.kernels.totals()["dispatches"]
+    k(jnp.zeros((1, 4), dtype=jnp.uint32))
+    assert T.kernels.totals()["dispatches"] == k0
+    monkeypatch.delenv("PILOSA_TPU_KERNEL_STATS")
+    k(jnp.zeros((1, 4), dtype=jnp.uint32))
+    assert T.kernels.totals()["dispatches"] == k0 + 1
+
+
+# -------------------------------------------------- storm signature diff
+
+
+def test_recompile_storm_carries_signature_diff():
+    x = T.XLACounters()
+    sig = lambda shape: ("tree", (("arr", shape, "uint32"),))  # noqa: E731
+    x.record("bitwise", sig((8, 4096)))
+    with pytest.warns(RuntimeWarning, match="recompile storm"):
+        for i in range(1, 12):
+            x.record("bitwise", sig((8 + i, 4096)))
+    snap = x.snapshot()
+    fam = snap["families"]["bitwise"]
+    assert snap["storms"] >= 1
+    diff = fam["lastSignatureDiff"]
+    assert diff is not None
+    # the diff names the churning leaf: old shape -> new shape
+    assert any("4096" in str(d) for d in diff["changed"])
+
+
+# --------------------------------------------------------- DeviceProfiler
+
+
+def test_device_profiler_kill_switch(tmp_path, monkeypatch):
+    p = T.DeviceProfiler(spool_dir=str(tmp_path / "spool"))
+    monkeypatch.setenv("PILOSA_TPU_DEVICE_PROFILE", "0")
+    doc = p.capture(0.1)
+    assert doc["status"] == "disabled" and p.captures == 0
+
+
+def test_device_profiler_busy_single_flight(tmp_path):
+    p = T.DeviceProfiler(spool_dir=str(tmp_path / "spool"))
+    assert p._busy.acquire(blocking=False)
+    try:
+        assert p.capture(0.05)["status"] == "busy"
+    finally:
+        p._busy.release()
+
+
+def test_device_profiler_capture_and_cap(tmp_path):
+    spool = tmp_path / "spool"
+    p = T.DeviceProfiler(spool_dir=str(spool), cap_bytes=1)
+    doc = p.capture(0.05)
+    assert doc["status"] == "ok", doc
+    assert doc["spoolDir"] == str(spool)
+    assert os.path.isdir(doc["dir"])
+    first = doc["dir"]
+    doc2 = p.capture(0.05)
+    assert doc2["status"] == "ok"
+    # 1-byte cap: the older capture is evicted, the newest survives
+    assert os.path.isdir(doc2["dir"]) and not os.path.isdir(first)
+    snap = p.snapshot()
+    assert snap["captures"] == 2 and not snap["busy"]
+
+
+# --------------------------------------------------------- CalibrationRing
+
+
+def test_calibration_ring_stats_and_limit_zero():
+    from pilosa_tpu.planner import CalibrationRing
+    r = CalibrationRing(size=8)
+    r.record({"call": "Count", "est": 150, "actual": 100})   # +50%
+    r.record({"call": "Count", "est": 50, "actual": 100})    # -50%
+    r.record({"call": "TopN", "est": 10, "actual": None})    # uncompared
+    snap = r.snapshot()
+    assert snap["recorded"] == 3 and snap["compared"] == 2
+    assert snap["meanAbsRelErr"] == pytest.approx(0.5)
+    assert snap["maxAbsRelErr"] == pytest.approx(0.5)
+    assert len(snap["entries"]) == 3
+    assert snap["entries"][0]["call"] == "TopN"  # newest first
+    # limit=0 is summary-only: the EXPLAIN response must not drag the
+    # whole ring across the wire
+    s0 = r.snapshot(limit=0)
+    assert s0["entries"] == [] and s0["compared"] == 2
+    r.reset()
+    assert r.snapshot()["recorded"] == 0
+
+
+# ----------------------------------------------------- EXPLAIN + HBM map
+
+
+@pytest.fixture()
+def obs_ex(tmp_path):
+    """Holder with one row per representation band: sparse (150 bits),
+    dense (high-cardinality scattered), run (contiguous intervals)."""
+    h = Holder(str(tmp_path / "data")).open()
+    idx = h.create_index("obs", track_existence=True)
+    f = idx.create_field("f")
+    rng = np.random.default_rng(11)
+    sets = {}
+    cols = rng.choice(2 * SHARD_WIDTH, size=150, replace=False)
+    f.import_bits([0] * cols.size, cols.tolist())
+    sets[0] = set(cols.tolist())
+    cols = rng.choice(2 * SHARD_WIDTH, size=50_000, replace=False)
+    f.import_bits([1] * cols.size, cols.tolist())
+    sets[1] = set(cols.tolist())
+    runs = [c for s in range(0, 8000, 2000)
+            for c in range(s * 3, s * 3 + 2000)]
+    f.import_bits([2] * len(runs), runs)
+    sets[2] = set(runs)
+    ex = Executor(h)
+    yield h, ex, idx, sets
+    h.close()
+
+
+def _leaf_reps(node, out=None):
+    """DFS leaf (field, rowId, rep) triples from an EXPLAIN tree."""
+    if out is None:
+        out = []
+    if node.get("kind") == "op":
+        for ch in node.get("children", ()):
+            _leaf_reps(ch, out)
+    elif node.get("kind") == "row":
+        out.append((node.get("field"), node.get("rowId"), node["rep"]))
+    return out
+
+
+def test_explain_zero_dispatch_and_rep_prediction(obs_ex):
+    h, ex, idx, sets = obs_ex
+    call = parse_string(
+        "Count(Union(Row(f=0), Intersect(Row(f=1), Row(f=2))))").calls[0]
+    d0, k0 = _dispatch_counts()
+    doc = ex.explain_call(idx, call, None)
+    d1, k1 = _dispatch_counts()
+    assert (d1 - d0, k1 - k0) == (0, 0), "EXPLAIN dispatched a program"
+    reps = {rid: rep for _, rid, rep in _leaf_reps(doc["tree"])}
+    assert reps == {0: "sparse", 1: "dense", 2: "run"}
+    # nothing resident yet: every leaf pays its upload estimate
+    assert doc["estimatedH2dBytes"] > 0
+    for _, _, rep in _leaf_reps(doc["tree"]):
+        assert rep in ("dense", "sparse", "run")
+    # a hybrid tree routes per-rep kernel families (not the fused path)
+    fams = {n["kernelFamily"] for n in _explain_leaves(doc["tree"])}
+    assert fams == {"bitwise", "sparse", "run"}
+
+
+def _explain_leaves(node):
+    if node.get("kind") == "op":
+        for ch in node.get("children", ()):
+            yield from _explain_leaves(ch)
+    else:
+        yield node
+
+
+def test_explain_all_dense_predicts_fused_program(obs_ex):
+    h, ex, idx, sets = obs_ex
+    call = parse_string("Count(Row(f=1))").calls[0]
+    doc = ex.explain_call(idx, call, None)
+    (leaf,) = list(_explain_leaves(doc["tree"]))
+    assert leaf["rep"] == "dense" and leaf["kernelFamily"] == "program"
+
+
+def test_explain_vacant_row_plans_without_dispatch(obs_ex):
+    """A row id with no bits set still plans (cardinality 0, cheapest
+    band) — and EXPLAIN still dispatches nothing for it."""
+    h, ex, idx, sets = obs_ex
+    call = parse_string("Count(Row(f=999))").calls[0]
+    d0, k0 = _dispatch_counts()
+    doc = ex.explain_call(idx, call, None)
+    assert _dispatch_counts() == (d0, k0)
+    (leaf,) = list(_explain_leaves(doc["tree"]))
+    assert leaf["maxShardCardinality"] == 0
+    assert leaf["rep"] == "sparse"  # 0 bits sits below the sparse band
+    assert not leaf["residency"]["resident"]
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3])
+def test_explain_execute_parity_fuzz(obs_ex, seed):
+    """The acceptance fuzz: EXPLAIN's representation choices equal the
+    choices a subsequent execution actually makes (peek mode never
+    advances hysteresis), estimates drop to zero once leaves are
+    resident, and the count matches the set oracle."""
+    rng = np.random.default_rng(seed)
+    h, ex, idx, sets = obs_ex
+    ops = ["Union", "Intersect", "Difference", "Xor"]
+
+    def tree(depth):
+        if depth == 0 or rng.random() < 0.3:
+            rid = int(rng.integers(0, 3))
+            return f"Row(f={rid})", sets[rid]
+        op = ops[int(rng.integers(0, len(ops)))]
+        (lp, ls), (rp, rs) = tree(depth - 1), tree(depth - 1)
+        pql = f"{op}({lp}, {rp})"
+        val = {"Union": ls | rs, "Intersect": ls & rs,
+               "Difference": ls - rs, "Xor": ls ^ rs}[op]
+        return pql, val
+
+    pql, oracle = tree(2)
+    call = parse_string(f"Count({pql})").calls[0]
+    d0, k0 = _dispatch_counts()
+    doc = ex.explain_call(idx, call, None)
+    assert _dispatch_counts() == (d0, k0)
+    predicted = _leaf_reps(doc["tree"])
+    (n,) = ex.execute("obs", f"Count({pql})")
+    assert n == len(oracle)
+    # every predicted leaf is now resident under the predicted rep
+    kinds = {"dense": "row", "sparse": "sparse", "run": "run"}
+    entries = ex.residency.entries_snapshot()
+    for field, rid, rep in predicted:
+        assert any(k[0] == kinds[rep] and k[2] == field and k[4] == rid
+                   for k, _ in entries), (field, rid, rep)
+    # a second EXPLAIN sees resident generation-matched leaves: zero
+    # upload estimate, same reps (execution didn't flip the choice)
+    doc2 = ex.explain_call(idx, call, None)
+    assert _leaf_reps(doc2["tree"]) == predicted
+    assert doc2["estimatedH2dBytes"] == 0
+    for leaf in _explain_leaves(doc2["tree"]):
+        assert leaf["residency"]["resident"]
+        assert leaf["residency"]["generationMatch"]
+
+
+def test_explain_not_includes_existence_leaf(obs_ex):
+    h, ex, idx, sets = obs_ex
+    call = parse_string("Count(Not(Row(f=0)))").calls[0]
+    doc = ex.explain_call(idx, call, None)
+    node = doc["tree"]
+    assert node["op"] == "Not" and len(node["children"]) == 2
+
+
+def test_explain_stale_generation_detected(obs_ex):
+    """Executor-path writes patch the resident leaf in place (EXPLAIN
+    keeps seeing a generation match); a write that bypasses the executor
+    bumps storage generations underneath it, and EXPLAIN reports the
+    entry resident-but-stale and charges the re-upload."""
+    h, ex, idx, sets = obs_ex
+    ex.execute("obs", "Count(Row(f=0))")
+    call = parse_string("Count(Row(f=0))").calls[0]
+    doc = ex.explain_call(idx, call, None)
+    (leaf,) = list(_explain_leaves(doc["tree"]))
+    assert leaf["residency"]["generationMatch"]
+    # a direct import bypasses the executor's device-leaf patching —
+    # column inside the existing shard set (a new shard would change the
+    # query's shard tuple, which is a different leaf key entirely)
+    col = next(c for c in range(100) if c not in sets[0])
+    idx.field("f").import_bits([0], [col])
+    doc = ex.explain_call(idx, call, None)
+    (leaf,) = list(_explain_leaves(doc["tree"]))
+    assert leaf["residency"]["resident"]
+    assert not leaf["residency"]["generationMatch"]
+    assert leaf["estimatedH2dBytes"] > 0
+
+
+def _api_for(h, ex):
+    from pilosa_tpu.api import API
+    from pilosa_tpu.parallel.cluster import Cluster, Node
+    cluster = Cluster("n1")
+    cluster.set_static([Node(id="n1", uri="http://localhost:0")])
+    return API(h, cluster, executor=ex)
+
+
+def test_api_explain_notes_and_calibration(obs_ex, tmp_path):
+    h, ex, idx, sets = obs_ex
+    api = _api_for(h, ex)
+    doc = api.explain("obs", "Set(1, f=0)\nCount(Row(f=0))")
+    assert doc["explain"][0]["planned"] is False
+    assert "write call" in doc["explain"][0]["note"]
+    assert doc["explain"][1]["call"] == "Count"
+    assert "calibration" in doc
+    assert doc["calibration"]["entries"] == []  # summary-only on the wire
+
+
+def test_executed_profiled_query_feeds_calibration(obs_ex):
+    from pilosa_tpu import planner as _planner
+    h, ex, idx, sets = obs_ex
+    api = _api_for(h, ex)
+    before = _planner.calibration.snapshot()["recorded"]
+    api.query_results("obs", "Count(Row(f=0))", profile=True)
+    snap = _planner.calibration.snapshot()
+    assert snap["recorded"] > before
+    e = snap["entries"][0]
+    assert e["call"] == "Count" and e["actual"] == len(sets[0])
+
+
+# ----------------------------------------------------------- HBM snapshot
+
+
+def test_hbm_snapshot_byte_exact_accounting(obs_ex):
+    h, ex, idx, sets = obs_ex
+    ex.execute("obs", "Count(Union(Row(f=0), Row(f=1), Row(f=2)))")
+    doc = ex.hbm_snapshot(top=0)
+    res = ex.residency.snapshot()
+    assert doc["residentBytes"] == res["bytes"]
+    assert doc["entries"] == res["entries"]
+    # every resident byte is attributed: field groups + other kinds
+    grouped = sum(g["bytes"] for g in doc["byField"]) \
+        + sum(k["bytes"] for k in doc["otherKinds"])
+    assert grouped == doc["residentBytes"]
+    assert doc["accountedBytes"] == \
+        doc["residentBytes"] + doc["planCacheBytes"]
+    assert doc["headroomBytes"] == \
+        doc["budgetBytes"] - doc["residentBytes"]
+    # the three rep bands are present with real padded bytes
+    reps = {g["rep"] for g in doc["byField"]}
+    assert {"dense", "sparse", "run"} <= reps
+    for g in doc["byField"]:
+        assert g["bytes"] > 0 and g["wasteBytes"] >= 0
+        assert g["wasteBytes"] <= g["bytes"]
+    # sparse/run pay power-of-two slot padding; the waste map sees it
+    assert doc["wasteByRep"]["sparse"] >= 0
+
+
+def test_hbm_snapshot_top_truncates(obs_ex):
+    h, ex, idx, sets = obs_ex
+    ex.execute("obs", "Count(Union(Row(f=0), Row(f=1), Row(f=2)))")
+    doc = ex.hbm_snapshot(top=1)
+    assert len(doc["byField"]) == 1
+    assert doc["byFieldTruncated"] >= 1
+    # truncation never loses bytes from the headline numbers
+    full = ex.hbm_snapshot(top=0)
+    assert doc["residentBytes"] == full["residentBytes"]
+
+
+# ------------------------------------------------------------ lint rule
+
+
+def test_lint_kernel_family_counted_jit_literal():
+    bad = ("from pilosa_tpu.utils.telemetry import counted_jit\n"
+           "@counted_jit('nosuchfamily')\n"
+           "def k(a):\n    return a\n")
+    assert [f.rule for f in lint_source("pilosa_tpu/ops/x.py", bad)] \
+        == ["kernel-family"]
+    good = bad.replace("nosuchfamily", "bitwise")
+    assert lint_source("pilosa_tpu/ops/x.py", good) == []
+
+
+def test_lint_kernel_family_rejects_non_literal():
+    src = ("from pilosa_tpu.utils.telemetry import counted_jit\n"
+           "fam = 'bitwise'\n"
+           "@counted_jit(fam)\n"
+           "def k(a):\n    return a\n")
+    assert "kernel-family" in [f.rule
+                               for f in lint_source("pilosa_tpu/x.py", src)]
+
+
+def test_lint_kernel_family_class_attr():
+    bad = "class B:\n    KERNEL_FAMILY = 'unregistered'\n"
+    assert [f.rule for f in lint_source("pilosa_tpu/x.py", bad)] \
+        == ["kernel-family"]
+    assert lint_source("pilosa_tpu/x.py",
+                       "class B:\n    KERNEL_FAMILY = 'batcher'\n") == []
+    # None opts a host-side batcher out of attribution — legal
+    assert lint_source("pilosa_tpu/x.py",
+                       "class B:\n    KERNEL_FAMILY = None\n") == []
+
+
+def test_lint_kernel_family_ignores_unrelated_record_dispatch():
+    """QueryProfile.record_dispatch takes a dispatch KIND, not a kernel
+    family — only telemetry's record_dispatch is in scope."""
+    src = "r.profile.record_dispatch('fanout', 3)\n"
+    assert lint_source("pilosa_tpu/x.py", src) == []
+    flagged = "telemetry.record_dispatch('nosuchfamily')\n"
+    assert [f.rule for f in lint_source("pilosa_tpu/x.py", flagged)] \
+        == ["kernel-family"]
+
+
+def test_every_registered_family_has_known_rep():
+    from pilosa_tpu.constants import KERNEL_FAMILIES
+    assert KERNEL_FAMILIES == frozenset(KERNEL_FAMILY_REPS)
+    assert "batcher" in KERNEL_FAMILIES and "ingest" in KERNEL_FAMILIES
+
+
+# ------------------------------------------------------------ live HTTP
+
+
+@pytest.fixture(scope="module")
+def trio(tmp_path_factory):
+    """3-node cluster with resident device leaves on every node — the
+    /cluster/hbm acceptance topology."""
+    from pilosa_tpu.server import Server
+
+    tmp = tmp_path_factory.mktemp("devobs")
+    servers = [Server(str(tmp / f"n{i}"), port=0,
+                      node_id=chr(ord("a") + i)).open() for i in range(3)]
+    uris = [s.uri for s in servers]
+    for s in servers:
+        s.cluster_hosts = uris
+        s.refresh_membership()
+
+    def jpost(path, payload=None, raw=None, node=0, query=""):
+        body = raw if raw is not None else json.dumps(payload or {}).encode()
+        req = urllib.request.Request(uris[node] + path + query, data=body,
+                                     method="POST")
+        with urllib.request.urlopen(req, timeout=30) as r:
+            return json.loads(r.read())
+
+    def jget(path, node=0):
+        with urllib.request.urlopen(uris[node] + path, timeout=30) as r:
+            return json.loads(r.read())
+
+    jpost("/index/m", {})
+    jpost("/index/m/field/f", {})
+    cols = list(range(0, 6 * SHARD_WIDTH, 997))
+    jpost("/index/m/field/f/import",
+          {"rowIDs": [0] * len(cols), "columnIDs": cols})
+    for _ in range(2):
+        jpost("/index/m/query", raw=b"Count(Row(f=0))")
+    yield servers, uris, jpost, jget
+    for s in servers:
+        s.close()
+
+
+def test_http_explain_zero_dispatch(trio):
+    servers, uris, jpost, jget = trio
+    d0, k0 = _dispatch_counts()
+    doc = jpost("/index/m/query", raw=b"Count(Row(f=0))",
+                query="?explain=true")
+    # remote fan-out planning happens on peers; this node's own device
+    # counters must not move (acceptance: zero dispatches)
+    assert _dispatch_counts() == (d0, k0)
+    assert doc["index"] == "m"
+    (entry,) = doc["explain"]
+    assert entry["call"] == "Count"
+    assert "estimatedH2dBytes" in entry
+    assert "calibration" in doc
+
+
+def test_http_debug_hbm_and_vars(trio):
+    servers, uris, jpost, jget = trio
+    doc = jget("/debug/hbm")
+    assert doc["residentBytes"] >= 0
+    assert doc["accountedBytes"] == \
+        doc["residentBytes"] + doc["planCacheBytes"]
+    v = jget("/debug/vars")
+    assert "kernels" in v and "deviceProfiler" in v
+    assert v["kernels"]["enabled"] in (True, False)
+    assert v["hbm"] is None or "residentBytes" in v["hbm"]
+    assert "calibration" in v.get("planner", {})
+
+
+def test_http_cluster_hbm_federation_byte_exact(trio):
+    servers, uris, jpost, jget = trio
+    doc = jget("/cluster/hbm")
+    assert {n["status"] for n in doc["nodes"]} == {"ok"}
+    assert len(doc["byNode"]) == 3
+    # fleet totals equal the sum of every node's own map, byte-exact
+    want = sum(jget("/debug/hbm", node=i)["residentBytes"]
+               for i in range(3))
+    assert doc["totals"]["residentBytes"] == want
+    # and every node's bytes are fully attributed inside its doc
+    for node_doc in doc["byNode"].values():
+        grouped = sum(g["bytes"] for g in node_doc["byField"]) \
+            + sum(k["bytes"] for k in node_doc["otherKinds"])
+        assert grouped == node_doc["residentBytes"]
+
+
+def test_http_cluster_hbm_legacy_degrade(trio, monkeypatch):
+    from pilosa_tpu.net.client import ClientError
+    servers, uris, jpost, jget = trio
+
+    def legacy(uri, timeout=None):
+        raise ClientError("not found", status=404)
+
+    monkeypatch.setattr(servers[0].client, "debug_hbm", legacy)
+    doc = servers[0].cluster_hbm()
+    statuses = {n["id"]: n["status"] for n in doc["nodes"]}
+    assert statuses["a"] == "ok"
+    assert set(statuses.values()) == {"ok", "legacy"}
+    # the merge stays partial-but-honest: local bytes still counted
+    assert doc["totals"]["residentBytes"] == \
+        jget("/debug/hbm")["residentBytes"]
+
+
+def test_http_device_profile_disabled(trio, monkeypatch):
+    servers, uris, jpost, jget = trio
+    monkeypatch.setenv("PILOSA_TPU_DEVICE_PROFILE", "0")
+    doc = jpost("/debug/device-profile", raw=b"", query="?seconds=0.1")
+    assert doc["status"] == "disabled"

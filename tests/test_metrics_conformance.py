@@ -671,3 +671,64 @@ def test_metrics_endpoint_without_stats_client(pair):
     status, ctype, body = h.dispatch("GET", "/metrics", {}, b"")
     assert status == 200 and ctype.startswith("text/plain")
     parse_exposition(body.decode())
+
+
+def test_live_metrics_kernel_families_full_keyspace(pair):
+    """pilosa_kernels* families are emitted UNCONDITIONALLY across the
+    full kernel-family × rep keyspace (zeros included) — "sparse kernels
+    stalled" alerts must never race the first sparse dispatch."""
+    from pilosa_tpu.constants import KERNEL_FAMILY_REPS
+    servers, uris = pair
+    with urllib.request.urlopen(uris[0] + "/metrics", timeout=10) as r:
+        text = r.read().decode()
+    types, samples = check_conformance(text)
+    for fam in ("pilosa_kernelsDispatches_total",
+                "pilosa_kernelsWaitMs_total", "pilosa_kernelsWaited_total",
+                "pilosa_kernelsH2dBytes_total",
+                "pilosa_kernelsD2hBytes_total"):
+        assert types.get(fam) == "counter", f"{fam} missing"
+        series = {(ls.get("key"), ls.get("rep"))
+                  for n, ls, _ in samples if n == fam}
+        for family, rep in KERNEL_FAMILY_REPS.items():
+            assert (family, rep) in series, \
+                f"{fam}: no series for family {family!r} rep {rep!r}"
+    # real traffic dispatched real kernels: at least one non-zero series
+    assert any(v > 0 for n, _, v in samples
+               if n == "pilosa_kernelsDispatches_total")
+    # and the dispatch-latency histogram rendered for a live family
+    assert types.get("pilosa_kernelDispatchMs") == "histogram"
+
+
+def test_live_metrics_hbm_families(pair):
+    """pilosa_hbm* gauges: unconditional across the rep keyspace, and
+    the resident-bytes series byte-exact against /debug/hbm."""
+    servers, uris = pair
+    with urllib.request.urlopen(uris[0] + "/metrics", timeout=10) as r:
+        text = r.read().decode()
+    types, samples = check_conformance(text)
+    for fam in ("pilosa_hbmResidentBytes", "pilosa_hbmResidentEntries"):
+        assert types.get(fam) == "gauge", f"{fam} missing"
+        reps = {ls.get("rep") for n, ls, _ in samples if n == fam}
+        assert {"dense", "sparse", "run", "other"} <= reps
+    for fam in ("pilosa_hbmPlanCacheBytes", "pilosa_hbmBudgetBytes",
+                "pilosa_hbmHeadroomBytes", "pilosa_hbmDriftBytes"):
+        assert types.get(fam) == "gauge", f"{fam} missing"
+    with urllib.request.urlopen(uris[0] + "/debug/hbm?top=0",
+                                timeout=10) as r:
+        hbm = json.loads(r.read())
+    total = sum(v for n, ls, v in samples if n == "pilosa_hbmResidentBytes")
+    assert total == hbm["residentBytes"]
+
+
+def test_kernel_family_inventory_drift_guard():
+    """Every kernel family named at a counted_jit / telemetry
+    record_dispatch site or KERNEL_FAMILY attribute anywhere under
+    pilosa_tpu/ is registered in constants.KERNEL_FAMILY_REPS — a future
+    PR cannot dispatch under a family the attribution plane, the
+    /metrics zero-fill and the dashboards have never heard of."""
+    import os
+
+    from pilosa_tpu.analysis import run_all
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    findings = [f for f in run_all(root) if f.rule == "kernel-family"]
+    assert findings == [], "\n".join(f.render() for f in findings)

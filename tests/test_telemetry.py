@@ -76,8 +76,9 @@ def test_xla_counters_compile_cached_and_storm():
     assert not msgs
     c.record("fam", ("k3",))  # 3rd new key in window -> storm
     snap = c.snapshot()
-    assert snap["families"]["fam"] == {"compiles": 3, "cached": 1,
-                                       "storms": 1}
+    fam = snap["families"]["fam"]
+    assert (fam["compiles"], fam["cached"], fam["storms"]) == (3, 1, 1)
+    assert "lastSignatureDiff" in fam  # old-vs-new diff rides the snapshot
     assert c.storms == 1 and c.storm_active()
     assert len(msgs) == 1 and "recompile storm" in msgs[0]
     # a second storm inside the same window does not re-warn (rate limit)
